@@ -1,0 +1,63 @@
+"""Environment-configurable statics.
+
+Mirrors the role of the reference's `SURREAL_*` env-parsed config statics
+(reference: core/src/cnf/mod.rs:17-97). Values are read once at import.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+# Execution limits
+MAX_COMPUTATION_DEPTH = _env_int("SURREAL_MAX_COMPUTATION_DEPTH", 120)
+MAX_CONCURRENT_TASKS = _env_int("SURREAL_MAX_CONCURRENT_TASKS", 64)
+IDIOM_RECURSION_LIMIT = _env_int("SURREAL_IDIOM_RECURSION_LIMIT", 256)
+MAX_QUERY_PARSING_DEPTH = _env_int("SURREAL_MAX_QUERY_PARSING_DEPTH", 1100)
+MAX_OBJECT_PARSING_DEPTH = _env_int("SURREAL_MAX_OBJECT_PARSING_DEPTH", 100)
+
+# KV scan batching
+NORMAL_FETCH_SIZE = _env_int("SURREAL_NORMAL_FETCH_SIZE", 500)
+MAX_STREAM_BATCH_SIZE = _env_int("SURREAL_MAX_STREAM_BATCH_SIZE", 1000)
+EXPORT_BATCH_SIZE = _env_int("SURREAL_EXPORT_BATCH_SIZE", 1000)
+INDEXING_BATCH_SIZE = _env_int("SURREAL_INDEXING_BATCH_SIZE", 250)
+COUNT_BATCH_SIZE = _env_int("SURREAL_COUNT_BATCH_SIZE", 10_000)
+
+# Result handling
+EXTERNAL_SORTING_BUFFER_LIMIT = _env_int("SURREAL_EXTERNAL_SORTING_BUFFER_LIMIT", 50_000)
+GENERATION_ALLOCATION_LIMIT = _env_int("SURREAL_GENERATION_ALLOCATION_LIMIT", 2**20)
+
+# Caches
+TRANSACTION_CACHE_SIZE = _env_int("SURREAL_TRANSACTION_CACHE_SIZE", 10_000)
+REGEX_CACHE_SIZE = _env_int("SURREAL_REGEX_CACHE_SIZE", 1_000)
+
+# TPU device-mirror settings (new — no reference analog; this framework's own knobs)
+TPU_BATCH_MIN_TILE = _env_int("SURREAL_TPU_BATCH_MIN_TILE", 128)
+TPU_VECTOR_DTYPE = os.environ.get("SURREAL_TPU_VECTOR_DTYPE", "bfloat16")
+TPU_KNN_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_KNN_ONDEVICE_THRESHOLD", 64)
+TPU_DISABLE = _env_bool("SURREAL_TPU_DISABLE", False)
+
+# Changefeeds
+CHANGEFEED_GC_INTERVAL_SECS = _env_int("SURREAL_CHANGEFEED_GC_INTERVAL", 10)
+
+# Websocket / server
+WEBSOCKET_MAX_CONCURRENT_REQUESTS = _env_int(
+    "SURREAL_WEBSOCKET_MAX_CONCURRENT_REQUESTS", 24
+)
+
+# Version of the storage format written by this build
+STORAGE_VERSION = 1

@@ -1,0 +1,89 @@
+"""Datastore: the engine root.
+
+Role of the reference's Datastore (reference: core/src/kvs/ds.rs:60): owns the
+storage backend, hands out transactions, runs queries (execute/process), holds
+the node identity, the versionstamp oracle, the device-side index store
+registry, and the live-query notification channel.
+"""
+
+from __future__ import annotations
+
+import uuid as _uuid
+from typing import Any, Dict, List, Optional
+
+from surrealdb_tpu.err import KvsError
+from .api import BackendDatastore
+from .mem import MemDatastore
+from .tx import Transaction
+from .vs import Oracle, SystemClock
+
+
+class Datastore:
+    def __init__(self, path: str = "memory", clock=None):
+        self.path = path
+        self.backend = self._open(path)
+        self.clock = clock or SystemClock()
+        self.oracle = Oracle()
+        self.node_id = _uuid.uuid4()
+        # device-resident index mirrors (vector / graph / ft columnar snapshots)
+        from surrealdb_tpu.idx.store import IndexStores
+
+        self.index_stores = IndexStores()
+        # live queries: uuid(hex) -> LiveSubscription (registered in M10)
+        self.notifications = None  # set by enable_notifications()
+        self.auth_enabled = False
+
+    @staticmethod
+    def _open(path: str) -> BackendDatastore:
+        scheme, _, rest = path.partition("://")
+        if path in ("memory", "mem") or scheme in ("mem", "memory"):
+            return MemDatastore()
+        if scheme in ("file", "surrealkv", "rocksdb"):
+            from .file import FileDatastore
+
+            return FileDatastore(rest)
+        raise KvsError(f"Unknown datastore path {path!r}")
+
+    # ------------------------------------------------------------ txns
+    def transaction(self, write: bool = False) -> Transaction:
+        return Transaction(self.backend.transaction(write), self.oracle, self.clock)
+
+    # ------------------------------------------------------------ notifications
+    def enable_notifications(self) -> None:
+        from surrealdb_tpu.dbs.notification import NotificationHub
+
+        if self.notifications is None:
+            self.notifications = NotificationHub()
+
+    # ------------------------------------------------------------ execution
+    def execute(
+        self,
+        text: str,
+        session=None,
+        vars: Optional[Dict[str, Any]] = None,
+    ) -> List[dict]:
+        """Parse and run a SurrealQL query string; returns a list of response
+        dicts {status, result|error, time} (reference kvs/ds.rs:768)."""
+        from surrealdb_tpu.syn import parse_query
+        from surrealdb_tpu.dbs.executor import Executor
+        from surrealdb_tpu.dbs.session import Session
+
+        ast = parse_query(text)
+        return self.process(ast, session or Session.owner(), vars)
+
+    def process(self, ast, session, vars: Optional[Dict[str, Any]] = None) -> List[dict]:
+        from surrealdb_tpu.dbs.executor import Executor
+
+        ex = Executor(self, session, vars or {})
+        return ex.execute(ast)
+
+    def compute(self, expr, session, vars: Optional[Dict[str, Any]] = None):
+        """Evaluate one expression against a fresh read transaction
+        (reference kvs/ds.rs compute/evaluate)."""
+        from surrealdb_tpu.dbs.executor import Executor
+
+        ex = Executor(self, session, vars or {})
+        return ex.compute_expression(expr)
+
+    def close(self) -> None:
+        self.backend.close()

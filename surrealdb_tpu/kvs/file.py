@@ -1,0 +1,80 @@
+"""File-backed datastore: MVCC memstore + snapshot persistence.
+
+Stands in for the reference's rocksdb/surrealkv persistent backends behind the
+same trait (reference: core/src/kvs/rocksdb/, kvs/surrealkv/). The full store
+is loaded at open and snapshotted to disk on every commit batch boundary
+(cheap for the embedded use; a C++ LSM backend can slot in behind
+`BackendDatastore` later without touching callers).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+from .api import BackendDatastore, BackendTransaction
+from .mem import MemDatastore, MemTransaction
+
+MAGIC = b"STPU1\n"
+
+
+class FileDatastore(BackendDatastore):
+    def __init__(self, path: str):
+        self.path = path
+        self.mem = MemDatastore()
+        self._dirty = 0
+        self._lock = threading.Lock()
+        if os.path.exists(path):
+            self._load()
+
+    def _load(self) -> None:
+        with open(self.path, "rb") as f:
+            data = f.read()
+        if not data.startswith(MAGIC):
+            raise ValueError(f"{self.path} is not a surrealdb_tpu datastore")
+        pos = len(MAGIC)
+        n = len(data)
+        while pos < n:
+            klen, vlen = struct.unpack_from(">II", data, pos)
+            pos += 8
+            k = data[pos : pos + klen]
+            pos += klen
+            v = data[pos : pos + vlen]
+            pos += vlen
+            self.mem.data[k] = [(0, v)]
+
+    def flush(self) -> None:
+        with self._lock:
+            with self.mem.lock:
+                snapshot = [
+                    (k, chain[-1][1])
+                    for k, chain in self.mem.data.items()
+                    if chain[-1][1] is not None
+                ]
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(MAGIC)
+                for k, v in snapshot:
+                    f.write(struct.pack(">II", len(k), len(v)))
+                    f.write(k)
+                    f.write(v)
+            os.replace(tmp, self.path)
+
+    def transaction(self, write: bool) -> BackendTransaction:
+        return FileTransaction(self, write)
+
+    def close(self) -> None:
+        self.flush()
+
+
+class FileTransaction(MemTransaction):
+    def __init__(self, store: FileDatastore, write: bool):
+        super().__init__(store.mem, write)
+        self.fstore = store
+
+    def commit(self) -> None:
+        had_writes = bool(self.writes)
+        super().commit()
+        if had_writes:
+            self.fstore.flush()

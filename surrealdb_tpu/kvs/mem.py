@@ -1,0 +1,183 @@
+"""In-memory MVCC ordered KV store.
+
+Role of the reference's `mem` backend (reference: core/src/kvs/mem/mod.rs) but
+designed differently: a single SortedDict of key -> version chain gives true
+snapshot isolation (each transaction reads as-of its begin version) plus
+versioned reads (`scan_all_versions` analog), with optimistic first-committer-
+wins conflict detection at commit — the semantics SurrealDB gets from
+surrealkv. Single-process; commits are applied atomically (no awaits inside).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from sortedcontainers import SortedDict
+
+from surrealdb_tpu.err import TxConflictError
+from .api import KV, BackendDatastore, BackendTransaction
+
+
+class MemDatastore(BackendDatastore):
+    def __init__(self):
+        # key -> list[(version, value|None)] ascending by version; None = tombstone
+        self.data: SortedDict = SortedDict()
+        self.version: int = 0
+        self.lock = threading.RLock()
+        self.active: Dict[int, int] = {}  # snapshot version -> refcount
+
+    # -- snapshots ---------------------------------------------------------
+    def _acquire_snapshot(self) -> int:
+        with self.lock:
+            v = self.version
+            self.active[v] = self.active.get(v, 0) + 1
+            return v
+
+    def _release_snapshot(self, v: int) -> None:
+        with self.lock:
+            n = self.active.get(v, 0) - 1
+            if n <= 0:
+                self.active.pop(v, None)
+            else:
+                self.active[v] = n
+
+    def transaction(self, write: bool) -> "MemTransaction":
+        return MemTransaction(self, write)
+
+    # -- version-chain helpers --------------------------------------------
+    def _read_at(self, key: bytes, snapshot: int) -> Optional[bytes]:
+        chain = self.data.get(key)
+        if not chain:
+            return None
+        # chains are short; linear scan from the end
+        for ver, val in reversed(chain):
+            if ver <= snapshot:
+                return val
+        return None
+
+    def _latest_version(self, key: bytes) -> int:
+        chain = self.data.get(key)
+        return chain[-1][0] if chain else 0
+
+    def gc(self) -> None:
+        """Drop version-chain entries older than the oldest active snapshot."""
+        with self.lock:
+            horizon = min(self.active) if self.active else self.version
+            dead = []
+            for key, chain in self.data.items():
+                if len(chain) > 1:
+                    keep_from = 0
+                    for i in range(len(chain) - 1, -1, -1):
+                        if chain[i][0] <= horizon:
+                            keep_from = i
+                            break
+                    if keep_from > 0:
+                        del chain[:keep_from]
+                if len(chain) == 1 and chain[0][1] is None and chain[0][0] <= horizon:
+                    dead.append(key)
+            for key in dead:
+                del self.data[key]
+
+
+class MemTransaction(BackendTransaction):
+    def __init__(self, store: MemDatastore, write: bool):
+        super().__init__(write)
+        self.store = store
+        self.snapshot = store._acquire_snapshot()
+        self.writes: Dict[bytes, Optional[bytes]] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def commit(self) -> None:
+        self._check_open(self.write and bool(self.writes))
+        store = self.store
+        with store.lock:
+            # first-committer-wins: conflict iff any written key changed
+            # after our snapshot
+            for key in self.writes:
+                if store._latest_version(key) > self.snapshot:
+                    self._finish()
+                    raise TxConflictError()
+            if self.writes:
+                store.version += 1
+                ver = store.version
+                for key, val in self.writes.items():
+                    chain = store.data.get(key)
+                    if chain is None:
+                        store.data[key] = [(ver, val)]
+                    else:
+                        chain.append((ver, val))
+        self._finish()
+
+    def cancel(self) -> None:
+        if not self.done:
+            self._finish()
+
+    def _finish(self) -> None:
+        self.done = True
+        self.store._release_snapshot(self.snapshot)
+        self.writes = {}
+
+    # -- point ops ---------------------------------------------------------
+    def get(self, key: bytes, version: Optional[int] = None) -> Optional[bytes]:
+        self._check_open()
+        if version is not None:
+            return self.store._read_at(key, version)
+        if key in self.writes:
+            return self.writes[key]
+        return self.store._read_at(key, self.snapshot)
+
+    def set(self, key: bytes, val: bytes) -> None:
+        self._check_open(True)
+        self.writes[key] = val
+
+    def delete(self, key: bytes) -> None:
+        self._check_open(True)
+        self.writes[key] = None
+
+    # -- range ops ---------------------------------------------------------
+    def _merged_range(self, beg: bytes, end: bytes):
+        """Iterate live (key, value) pairs in [beg, end) merging local writes."""
+        store = self.store
+        data = store.data
+        with store.lock:
+            committed_keys = list(data.irange(beg, end, inclusive=(True, False)))
+        local = sorted(k for k in self.writes if beg <= k < end)
+        ci = li = 0
+        while ci < len(committed_keys) or li < len(local):
+            if li >= len(local) or (
+                ci < len(committed_keys) and committed_keys[ci] < local[li]
+            ):
+                k = committed_keys[ci]
+                ci += 1
+                if k in self.writes:
+                    continue  # will come from local side
+                v = store._read_at(k, self.snapshot)
+                if v is not None:
+                    yield k, v
+            else:
+                k = local[li]
+                li += 1
+                if ci < len(committed_keys) and committed_keys[ci] == k:
+                    ci += 1
+                v = self.writes[k]
+                if v is not None:
+                    yield k, v
+
+    def keys(self, beg: bytes, end: bytes, limit: int = -1) -> List[bytes]:
+        self._check_open()
+        out = []
+        for k, _ in self._merged_range(beg, end):
+            out.append(k)
+            if limit >= 0 and len(out) >= limit:
+                break
+        return out
+
+    def scan(self, beg: bytes, end: bytes, limit: int = -1) -> List[KV]:
+        self._check_open()
+        out = []
+        for kv in self._merged_range(beg, end):
+            out.append(kv)
+            if limit >= 0 and len(out) >= limit:
+                break
+        return out

@@ -1,0 +1,440 @@
+"""Transaction: catalog-aware wrapper over a backend transaction.
+
+Role of the reference's cached Transaction + Transactor pair (reference:
+core/src/kvs/tx.rs:42, core/src/kvs/tr.rs:76): raw KV verbs plus ~70 typed
+catalog accessors with a per-transaction cache, changefeed buffering completed
+at commit, and record/graph helpers.
+
+Definitions (namespace/database/table/field/index/...) are stored as plain
+dicts (produced by the DEFINE statement AST) packed with the value codec.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from surrealdb_tpu import key as keys
+from surrealdb_tpu.err import DbNotFoundError, NsNotFoundError, TbNotFoundError
+from surrealdb_tpu.utils.ser import pack, unpack
+
+from .api import KV, BackendTransaction
+from .vs import Oracle
+
+
+class Transaction:
+    def __init__(self, backend: BackendTransaction, oracle: Oracle, clock):
+        self.tr = backend
+        self.oracle = oracle
+        self.clock = clock
+        self.cache: Dict[bytes, Any] = {}
+        # changefeed buffer: (ns, db, tb) -> list of mutation dicts
+        self.cf_buffer: Dict[Tuple[str, str, str], List[dict]] = {}
+        self.write = backend.write
+
+    # ------------------------------------------------------------ lifecycle
+    def commit(self) -> None:
+        self.tr.commit()
+
+    def cancel(self) -> None:
+        self.tr.cancel()
+
+    @property
+    def done(self) -> bool:
+        return self.tr.done
+
+    # ------------------------------------------------------------ raw verbs
+    def get(self, key: bytes, version: Optional[int] = None) -> Optional[bytes]:
+        return self.tr.get(key, version)
+
+    def set(self, key: bytes, val: bytes) -> None:
+        self.tr.set(key, val)
+
+    def put(self, key: bytes, val: bytes) -> None:
+        self.tr.put(key, val)
+
+    def putc(self, key: bytes, val: bytes, chk: Optional[bytes]) -> None:
+        self.tr.putc(key, val, chk)
+
+    def delete(self, key: bytes) -> None:
+        self.tr.delete(key)
+
+    def delc(self, key: bytes, chk: Optional[bytes]) -> None:
+        self.tr.delc(key, chk)
+
+    def exists(self, key: bytes) -> bool:
+        return self.tr.exists(key)
+
+    def keys(self, beg: bytes, end: bytes, limit: int = -1) -> List[bytes]:
+        return self.tr.keys(beg, end, limit)
+
+    def scan(self, beg: bytes, end: bytes, limit: int = -1) -> List[KV]:
+        return self.tr.scan(beg, end, limit)
+
+    def batch(self, beg: bytes, end: bytes, batch_size: int) -> Iterable[List[KV]]:
+        return self.tr.batch(beg, end, batch_size)
+
+    def delr(self, beg: bytes, end: bytes) -> None:
+        self.tr.delr(beg, end)
+
+    def scan_prefix(self, prefix: bytes, limit: int = -1) -> List[KV]:
+        from surrealdb_tpu.key.encode import prefix_end
+
+        return self.tr.scan(prefix, prefix_end(prefix), limit)
+
+    # ------------------------------------------------------------ obj verbs
+    def get_obj(self, key: bytes) -> Optional[Any]:
+        raw = self.tr.get(key)
+        return None if raw is None else unpack(raw)
+
+    def set_obj(self, key: bytes, val: Any) -> None:
+        self.tr.set(key, pack(val))
+
+    def _cached(self, key: bytes, loader):
+        if key in self.cache:
+            return self.cache[key]
+        v = loader()
+        self.cache[key] = v
+        return v
+
+    def _get_obj_cached(self, key: bytes) -> Optional[Any]:
+        return self._cached(key, lambda: self.get_obj(key))
+
+    def _scan_objs(self, prefix: bytes) -> List[Any]:
+        from surrealdb_tpu.key.encode import prefix_end
+
+        return [unpack(v) for _, v in self.tr.scan(prefix, prefix_end(prefix))]
+
+    # ------------------------------------------------------------ namespaces
+    def all_ns(self) -> List[dict]:
+        return self._scan_objs(keys.namespace_prefix())
+
+    def get_ns(self, ns: str) -> Optional[dict]:
+        return self._get_obj_cached(keys.namespace(ns))
+
+    def expect_ns(self, ns: str) -> dict:
+        d = self.get_ns(ns)
+        if d is None:
+            raise NsNotFoundError(ns)
+        return d
+
+    def put_ns(self, ns: str, d: dict) -> None:
+        k = keys.namespace(ns)
+        self.set_obj(k, d)
+        self.cache[k] = d
+
+    def del_ns(self, ns: str) -> None:
+        k = keys.namespace(ns)
+        self.tr.delete(k)
+        self.cache.pop(k, None)
+
+    def ensure_ns(self, ns: str) -> dict:
+        d = self.get_ns(ns)
+        if d is None:
+            d = {"name": ns, "comment": None}
+            self.put_ns(ns, d)
+        return d
+
+    # ------------------------------------------------------------ databases
+    def all_db(self, ns: str) -> List[dict]:
+        return self._scan_objs(keys.database_prefix(ns))
+
+    def get_db(self, ns: str, db: str) -> Optional[dict]:
+        return self._get_obj_cached(keys.database(ns, db))
+
+    def expect_db(self, ns: str, db: str) -> dict:
+        d = self.get_db(ns, db)
+        if d is None:
+            raise DbNotFoundError(db)
+        return d
+
+    def put_db(self, ns: str, db: str, d: dict) -> None:
+        k = keys.database(ns, db)
+        self.set_obj(k, d)
+        self.cache[k] = d
+
+    def del_db(self, ns: str, db: str) -> None:
+        k = keys.database(ns, db)
+        self.tr.delete(k)
+        self.cache.pop(k, None)
+
+    def ensure_db(self, ns: str, db: str) -> dict:
+        self.ensure_ns(ns)
+        d = self.get_db(ns, db)
+        if d is None:
+            d = {"name": db, "comment": None, "changefeed": None}
+            self.put_db(ns, db, d)
+        return d
+
+    # ------------------------------------------------------------ tables
+    def all_tb(self, ns: str, db: str) -> List[dict]:
+        return self._scan_objs(keys.table_prefix(ns, db))
+
+    def get_tb(self, ns: str, db: str, tb: str) -> Optional[dict]:
+        return self._get_obj_cached(keys.table(ns, db, tb))
+
+    def expect_tb(self, ns: str, db: str, tb: str) -> dict:
+        d = self.get_tb(ns, db, tb)
+        if d is None:
+            raise TbNotFoundError(tb)
+        return d
+
+    def put_tb(self, ns: str, db: str, tb: str, d: dict) -> None:
+        k = keys.table(ns, db, tb)
+        self.set_obj(k, d)
+        self.cache[k] = d
+
+    def del_tb(self, ns: str, db: str, tb: str) -> None:
+        k = keys.table(ns, db, tb)
+        self.tr.delete(k)
+        self.cache.pop(k, None)
+
+    def ensure_tb(self, ns: str, db: str, tb: str) -> dict:
+        self.ensure_db(ns, db)
+        d = self.get_tb(ns, db, tb)
+        if d is None:
+            d = {
+                "name": tb,
+                "drop": False,
+                "schemafull": False,
+                "kind": "ANY",  # ANY | NORMAL | RELATION
+                "relation_in": None,
+                "relation_out": None,
+                "enforced": False,
+                "view": None,
+                "permissions": None,
+                "changefeed": None,
+                "comment": None,
+            }
+            self.put_tb(ns, db, tb, d)
+        return d
+
+    # ------------------------------------------------------------ fields
+    def all_tb_fields(self, ns: str, db: str, tb: str) -> List[dict]:
+        return self._cached(
+            keys.field_prefix(ns, db, tb),
+            lambda: self._scan_objs(keys.field_prefix(ns, db, tb)),
+        )
+
+    def get_tb_field(self, ns: str, db: str, tb: str, fd: str) -> Optional[dict]:
+        return self.get_obj(keys.field(ns, db, tb, fd))
+
+    def put_tb_field(self, ns: str, db: str, tb: str, fd: str, d: dict) -> None:
+        self.set_obj(keys.field(ns, db, tb, fd), d)
+        self.cache.pop(keys.field_prefix(ns, db, tb), None)
+
+    def del_tb_field(self, ns: str, db: str, tb: str, fd: str) -> None:
+        self.tr.delete(keys.field(ns, db, tb, fd))
+        self.cache.pop(keys.field_prefix(ns, db, tb), None)
+
+    # ------------------------------------------------------------ indexes
+    def all_tb_indexes(self, ns: str, db: str, tb: str) -> List[dict]:
+        return self._cached(
+            keys.index_def_prefix(ns, db, tb),
+            lambda: self._scan_objs(keys.index_def_prefix(ns, db, tb)),
+        )
+
+    def get_tb_index(self, ns: str, db: str, tb: str, ix: str) -> Optional[dict]:
+        return self.get_obj(keys.index_def(ns, db, tb, ix))
+
+    def put_tb_index(self, ns: str, db: str, tb: str, ix: str, d: dict) -> None:
+        self.set_obj(keys.index_def(ns, db, tb, ix), d)
+        self.cache.pop(keys.index_def_prefix(ns, db, tb), None)
+
+    def del_tb_index(self, ns: str, db: str, tb: str, ix: str) -> None:
+        self.tr.delete(keys.index_def(ns, db, tb, ix))
+        self.cache.pop(keys.index_def_prefix(ns, db, tb), None)
+
+    # ------------------------------------------------------------ events
+    def all_tb_events(self, ns: str, db: str, tb: str) -> List[dict]:
+        return self._cached(
+            keys.event_prefix(ns, db, tb),
+            lambda: self._scan_objs(keys.event_prefix(ns, db, tb)),
+        )
+
+    def get_tb_event(self, ns: str, db: str, tb: str, ev: str) -> Optional[dict]:
+        return self.get_obj(keys.event(ns, db, tb, ev))
+
+    def put_tb_event(self, ns: str, db: str, tb: str, ev: str, d: dict) -> None:
+        self.set_obj(keys.event(ns, db, tb, ev), d)
+        self.cache.pop(keys.event_prefix(ns, db, tb), None)
+
+    def del_tb_event(self, ns: str, db: str, tb: str, ev: str) -> None:
+        self.tr.delete(keys.event(ns, db, tb, ev))
+        self.cache.pop(keys.event_prefix(ns, db, tb), None)
+
+    # ------------------------------------------------------------ views
+    def all_tb_views(self, ns: str, db: str, tb: str) -> List[dict]:
+        """Foreign tables: views defined AS SELECT ... FROM tb."""
+        return self._cached(
+            keys.foreign_table_prefix(ns, db, tb),
+            lambda: self._scan_objs(keys.foreign_table_prefix(ns, db, tb)),
+        )
+
+    def put_tb_view(self, ns: str, db: str, tb: str, ft: str, d: dict) -> None:
+        self.set_obj(keys.foreign_table(ns, db, tb, ft), d)
+        self.cache.pop(keys.foreign_table_prefix(ns, db, tb), None)
+
+    def del_tb_view(self, ns: str, db: str, tb: str, ft: str) -> None:
+        self.tr.delete(keys.foreign_table(ns, db, tb, ft))
+        self.cache.pop(keys.foreign_table_prefix(ns, db, tb), None)
+
+    # ------------------------------------------------------------ analyzers
+    def all_az(self, ns: str, db: str) -> List[dict]:
+        return self._scan_objs(keys.analyzer_prefix(ns, db))
+
+    def get_az(self, ns: str, db: str, az: str) -> Optional[dict]:
+        return self._get_obj_cached(keys.analyzer(ns, db, az))
+
+    def put_az(self, ns: str, db: str, az: str, d: dict) -> None:
+        k = keys.analyzer(ns, db, az)
+        self.set_obj(k, d)
+        self.cache[k] = d
+
+    def del_az(self, ns: str, db: str, az: str) -> None:
+        k = keys.analyzer(ns, db, az)
+        self.tr.delete(k)
+        self.cache.pop(k, None)
+
+    # ------------------------------------------------------------ functions
+    def all_fc(self, ns: str, db: str) -> List[dict]:
+        return self._scan_objs(keys.function_prefix(ns, db))
+
+    def get_fc(self, ns: str, db: str, fc: str) -> Optional[dict]:
+        return self._get_obj_cached(keys.function(ns, db, fc))
+
+    def put_fc(self, ns: str, db: str, fc: str, d: dict) -> None:
+        k = keys.function(ns, db, fc)
+        self.set_obj(k, d)
+        self.cache[k] = d
+
+    def del_fc(self, ns: str, db: str, fc: str) -> None:
+        k = keys.function(ns, db, fc)
+        self.tr.delete(k)
+        self.cache.pop(k, None)
+
+    # ------------------------------------------------------------ params
+    def all_pa(self, ns: str, db: str) -> List[dict]:
+        return self._scan_objs(keys.param_prefix(ns, db))
+
+    def get_pa(self, ns: str, db: str, pa: str) -> Optional[dict]:
+        return self._get_obj_cached(keys.param(ns, db, pa))
+
+    def put_pa(self, ns: str, db: str, pa: str, d: dict) -> None:
+        k = keys.param(ns, db, pa)
+        self.set_obj(k, d)
+        self.cache[k] = d
+
+    def del_pa(self, ns: str, db: str, pa: str) -> None:
+        k = keys.param(ns, db, pa)
+        self.tr.delete(k)
+        self.cache.pop(k, None)
+
+    # ------------------------------------------------------------ models
+    def all_ml(self, ns: str, db: str) -> List[dict]:
+        return self._scan_objs(keys.model_prefix(ns, db))
+
+    def get_ml(self, ns: str, db: str, ml: str, version: str) -> Optional[dict]:
+        return self._get_obj_cached(keys.model(ns, db, ml, version))
+
+    def put_ml(self, ns: str, db: str, ml: str, version: str, d: dict) -> None:
+        k = keys.model(ns, db, ml, version)
+        self.set_obj(k, d)
+        self.cache[k] = d
+
+    def del_ml(self, ns: str, db: str, ml: str, version: str) -> None:
+        k = keys.model(ns, db, ml, version)
+        self.tr.delete(k)
+        self.cache.pop(k, None)
+
+    # ------------------------------------------------------------ users
+    def get_root_user(self, user: str) -> Optional[dict]:
+        return self.get_obj(keys.root_user(user))
+
+    def all_root_users(self) -> List[dict]:
+        return self._scan_objs(keys.root_user_prefix())
+
+    def put_root_user(self, user: str, d: dict) -> None:
+        self.set_obj(keys.root_user(user), d)
+
+    def del_root_user(self, user: str) -> None:
+        self.tr.delete(keys.root_user(user))
+
+    def get_ns_user(self, ns: str, user: str) -> Optional[dict]:
+        return self.get_obj(keys.ns_user(ns, user))
+
+    def all_ns_users(self, ns: str) -> List[dict]:
+        return self._scan_objs(keys.ns_user_prefix(ns))
+
+    def put_ns_user(self, ns: str, user: str, d: dict) -> None:
+        self.set_obj(keys.ns_user(ns, user), d)
+
+    def del_ns_user(self, ns: str, user: str) -> None:
+        self.tr.delete(keys.ns_user(ns, user))
+
+    def get_db_user(self, ns: str, db: str, user: str) -> Optional[dict]:
+        return self.get_obj(keys.db_user(ns, db, user))
+
+    def all_db_users(self, ns: str, db: str) -> List[dict]:
+        return self._scan_objs(keys.db_user_prefix(ns, db))
+
+    def put_db_user(self, ns: str, db: str, user: str, d: dict) -> None:
+        self.set_obj(keys.db_user(ns, db, user), d)
+
+    def del_db_user(self, ns: str, db: str, user: str) -> None:
+        self.tr.delete(keys.db_user(ns, db, user))
+
+    # ------------------------------------------------------------ accesses
+    def get_access(self, level: tuple, ac: str) -> Optional[dict]:
+        return self.get_obj(self._access_key(level, ac))
+
+    def all_accesses(self, level: tuple) -> List[dict]:
+        if len(level) == 0:
+            return self._scan_objs(keys.root_access_prefix())
+        if len(level) == 1:
+            return self._scan_objs(keys.ns_access_prefix(level[0]))
+        return self._scan_objs(keys.db_access_prefix(level[0], level[1]))
+
+    def put_access(self, level: tuple, ac: str, d: dict) -> None:
+        self.set_obj(self._access_key(level, ac), d)
+
+    def del_access(self, level: tuple, ac: str) -> None:
+        self.tr.delete(self._access_key(level, ac))
+
+    @staticmethod
+    def _access_key(level: tuple, ac: str) -> bytes:
+        if len(level) == 0:
+            return keys.root_access(ac)
+        if len(level) == 1:
+            return keys.ns_access(level[0], ac)
+        return keys.db_access(level[0], level[1], ac)
+
+    # ------------------------------------------------------------ records
+    def get_record(self, ns: str, db: str, tb: str, id_: Any) -> Optional[dict]:
+        raw = self.tr.get(keys.thing(ns, db, tb, id_))
+        return None if raw is None else unpack(raw)
+
+    def set_record(self, ns: str, db: str, tb: str, id_: Any, doc: dict) -> None:
+        self.tr.set(keys.thing(ns, db, tb, id_), pack(doc))
+
+    def del_record(self, ns: str, db: str, tb: str, id_: Any) -> None:
+        self.tr.delete(keys.thing(ns, db, tb, id_))
+
+    def record_exists(self, ns: str, db: str, tb: str, id_: Any) -> bool:
+        return self.tr.exists(keys.thing(ns, db, tb, id_))
+
+    # ------------------------------------------------------------ changefeed
+    def buffer_change(self, ns: str, db: str, tb: str, mutation: dict) -> None:
+        self.cf_buffer.setdefault((ns, db, tb), []).append(mutation)
+
+    def complete_changes(self) -> None:
+        """Write buffered changefeed mutations under versionstamped keys
+        (reference Transactor::complete_changes, kvs/tr.rs:600)."""
+        if not self.cf_buffer:
+            return
+        by_db: Dict[Tuple[str, str], Dict[str, List[dict]]] = {}
+        for (ns, db, tb), muts in self.cf_buffer.items():
+            by_db.setdefault((ns, db), {}).setdefault(tb, []).extend(muts)
+        for (ns, db), tables in by_db.items():
+            vs = self.oracle.next_vs(self.clock.now_nanos())
+            self.tr.set(keys.change(ns, db, vs), pack({"vs": vs, "tables": tables}))
+        self.cf_buffer = {}

@@ -1,0 +1,97 @@
+"""Binary serialization of Values for KV storage.
+
+The reference stores records with a versioned bincode-style format
+(`revisioned`); we use msgpack with extension types for the SurrealQL-specific
+value kinds. This is the storage codec, not a wire format.
+"""
+
+from __future__ import annotations
+
+import uuid as _uuid
+from typing import Any
+
+import msgpack
+
+from surrealdb_tpu.sql.value import (
+    NONE,
+    Closure,
+    Datetime,
+    Duration,
+    Geometry,
+    Null,
+    Range,
+    Table,
+    Thing,
+    Uuid,
+    is_none,
+    is_null,
+)
+
+EXT_NONE = 1
+EXT_THING = 2
+EXT_DURATION = 3
+EXT_DATETIME = 4
+EXT_UUID = 5
+EXT_GEOMETRY = 6
+EXT_RANGE = 7
+EXT_TABLE = 8
+
+
+def _default(v: Any):
+    if is_none(v):
+        return msgpack.ExtType(EXT_NONE, b"")
+    if is_null(v):
+        return None  # NULL round-trips as msgpack nil
+    if isinstance(v, Thing):
+        return msgpack.ExtType(EXT_THING, pack({"tb": v.tb, "id": v.id}))
+    if isinstance(v, Duration):
+        return msgpack.ExtType(EXT_DURATION, msgpack.packb(v.nanos))
+    if isinstance(v, Datetime):
+        return msgpack.ExtType(EXT_DATETIME, msgpack.packb(v.nanos))
+    if isinstance(v, Uuid):
+        return msgpack.ExtType(EXT_UUID, v.value.bytes)
+    if isinstance(v, _uuid.UUID):
+        return msgpack.ExtType(EXT_UUID, v.bytes)
+    if isinstance(v, Geometry):
+        return msgpack.ExtType(EXT_GEOMETRY, pack({"k": v.kind, "c": v.coords}))
+    if isinstance(v, Range):
+        return msgpack.ExtType(
+            EXT_RANGE,
+            pack({"b": v.beg, "e": v.end, "bi": v.beg_incl, "ei": v.end_incl}),
+        )
+    if isinstance(v, Table):
+        return msgpack.ExtType(EXT_TABLE, str(v).encode())
+    if isinstance(v, tuple):
+        return list(v)
+    raise TypeError(f"cannot serialize {type(v).__name__}")
+
+
+def _ext_hook(code: int, data: bytes):
+    if code == EXT_NONE:
+        return NONE
+    if code == EXT_THING:
+        d = unpack(data)
+        return Thing(d["tb"], d["id"])
+    if code == EXT_DURATION:
+        return Duration(msgpack.unpackb(data))
+    if code == EXT_DATETIME:
+        return Datetime(msgpack.unpackb(data))
+    if code == EXT_UUID:
+        return Uuid(_uuid.UUID(bytes=data))
+    if code == EXT_GEOMETRY:
+        d = unpack(data)
+        return Geometry(d["k"], d["c"])
+    if code == EXT_RANGE:
+        d = unpack(data)
+        return Range(d["b"], d["e"], d["bi"], d["ei"])
+    if code == EXT_TABLE:
+        return Table(data.decode())
+    return msgpack.ExtType(code, data)
+
+
+def pack(v: Any) -> bytes:
+    return msgpack.packb(v, default=_default, use_bin_type=True, strict_types=True)
+
+
+def unpack(b: bytes) -> Any:
+    return msgpack.unpackb(b, ext_hook=_ext_hook, raw=False, strict_map_key=False)

@@ -1,0 +1,138 @@
+"""Key encoding: order preservation and round-trips."""
+
+import random
+
+import pytest
+
+from surrealdb_tpu import key as keys
+from surrealdb_tpu.key.encode import (
+    dec_value_key,
+    enc_f64,
+    enc_i64,
+    enc_str,
+    enc_value_key,
+    prefix_end,
+)
+from surrealdb_tpu.sql.value import NONE, Datetime, Duration, Null, Thing, Uuid
+
+
+def test_str_ordering_and_escape():
+    vals = ["", "a", "a\x00b", "a\x00", "ab", "b", "ñ"]
+    encs = [enc_str(v) for v in vals]
+    assert sorted(encs) == [enc_str(v) for v in sorted(vals)]
+
+
+def test_i64_ordering():
+    vals = [-(2**62), -1000, -1, 0, 1, 7, 2**62]
+    encs = [enc_i64(v) for v in vals]
+    assert encs == sorted(encs)
+
+
+def test_f64_ordering():
+    vals = [float("-inf"), -1e300, -1.5, -0.0, 0.0, 1e-300, 2.5, 1e300, float("inf")]
+    encs = [enc_f64(v) for v in vals]
+    assert encs == sorted(encs)
+
+
+def test_numbers_interleave():
+    vals = [-5, -1.5, 0, 0.5, 1, 2.5, 3, 100]
+    encs = [enc_value_key(v) for v in vals]
+    assert encs == sorted(encs)
+
+
+def test_value_roundtrip():
+    cases = [
+        NONE,
+        Null,
+        True,
+        False,
+        42,
+        -17,
+        3.25,
+        "hello",
+        "with\x00nul",
+        Duration.parse("1h30m"),
+        Datetime.parse("2024-01-01T00:00:00Z"),
+        Uuid("9d8e6da2-5f7c-4c8f-9bb1-0002b1b384b4"),
+        [1, "two", [3.0]],
+        {"a": 1, "b": [True]},
+        b"\x01\x02\x00\x03",
+        Thing("person", 1),
+        Thing("person", "tobie"),
+        Thing("person", ["london", 1]),
+    ]
+    for v in cases:
+        enc = enc_value_key(v)
+        dec, pos = dec_value_key(enc, 0)
+        assert pos == len(enc)
+        if v is NONE or v is Null:
+            assert dec is v
+        else:
+            assert dec == v, f"roundtrip failed for {v!r}: {dec!r}"
+
+
+def test_array_ordering():
+    a = enc_value_key([1])
+    b = enc_value_key([1, 0])
+    c = enc_value_key([2])
+    assert a < b < c
+
+
+def test_record_key_roundtrip():
+    for id_ in [1, -3, "tobie", ["a", 1], Uuid.v4()]:
+        k = keys.thing("ns", "db", "person", id_)
+        assert keys.decode_thing_id(k, "ns", "db", "person") == id_
+
+
+def test_record_range_scan_order():
+    ids = list(range(-50, 50)) + [f"u{i}" for i in range(20)]
+    ks = [keys.thing("n", "d", "t", i) for i in ids]
+    random.shuffle(ks)
+    srt = sorted(ks)
+    decoded = [keys.decode_thing_id(k, "n", "d", "t") for k in srt]
+    nums = [d for d in decoded if isinstance(d, int)]
+    strs = [d for d in decoded if isinstance(d, str)]
+    assert nums == sorted(nums)
+    assert strs == sorted(strs)
+    # numbers sort before strings (type ordinal)
+    assert decoded.index(strs[0]) > decoded.index(nums[-1])
+
+
+def test_graph_key_roundtrip():
+    k = keys.graph("n", "d", "person", 1, keys.DIR_OUT, "knows", 77)
+    id_, d, ft, fk = keys.decode_graph(k, "n", "d", "person")
+    assert (id_, d, ft, fk) == (1, keys.DIR_OUT, "knows", 77)
+
+
+def test_graph_prefix_covers_directions():
+    pre = keys.graph_prefix("n", "d", "person", 1, keys.DIR_OUT, "knows")
+    k1 = keys.graph("n", "d", "person", 1, keys.DIR_OUT, "knows", 1)
+    k2 = keys.graph("n", "d", "person", 1, keys.DIR_IN, "knows", 1)
+    assert k1.startswith(pre)
+    assert not k2.startswith(pre)
+
+
+def test_index_entry_roundtrip():
+    k = keys.index_entry("n", "d", "t", "ix1", ["x", 5], 9)
+    vals, id_ = keys.decode_index_entry_id(k, "n", "d", "t", "ix1", 2)
+    assert vals == ["x", 5] and id_ == 9
+
+
+def test_prefix_end():
+    assert prefix_end(b"abc") == b"abd"
+    assert prefix_end(b"a\xff") == b"b"
+    p = keys.thing_prefix("n", "d", "t")
+    k = keys.thing("n", "d", "t", 10**6)
+    assert p < k < prefix_end(p)
+
+
+def test_keyspace_separation():
+    """Table's records / edges / defs / index keys live in disjoint ranges."""
+    rec = keys.thing("n", "d", "t", 1)
+    edge = keys.graph("n", "d", "t", 1, keys.DIR_OUT, "e", 1)
+    fd = keys.field("n", "d", "t", "name")
+    ix = keys.index_entry("n", "d", "t", "i", [1], 1)
+    rp, ep = keys.thing_prefix("n", "d", "t"), keys.graph_prefix("n", "d", "t")
+    assert rec.startswith(rp) and not edge.startswith(rp)
+    assert edge.startswith(ep) and not rec.startswith(ep)
+    assert not fd.startswith(rp) and not ix.startswith(rp)

@@ -1,0 +1,223 @@
+"""KV layer: raw ops, snapshot isolation, conflicts, catalog accessors."""
+
+import pytest
+
+from surrealdb_tpu.err import (
+    TxConditionNotMetError,
+    TxConflictError,
+    TxFinishedError,
+    TxKeyAlreadyExistsError,
+    TxReadonlyError,
+)
+from surrealdb_tpu.kvs.ds import Datastore
+from surrealdb_tpu.kvs.mem import MemDatastore
+
+
+def test_basic_crud():
+    st = MemDatastore()
+    tx = st.transaction(write=True)
+    tx.set(b"a", b"1")
+    tx.set(b"b", b"2")
+    assert tx.get(b"a") == b"1"  # read-your-writes
+    tx.commit()
+
+    tx = st.transaction(write=False)
+    assert tx.get(b"a") == b"1"
+    assert tx.get(b"missing") is None
+    tx.cancel()
+
+
+def test_readonly_rejects_writes():
+    st = MemDatastore()
+    tx = st.transaction(write=False)
+    with pytest.raises(TxReadonlyError):
+        tx.set(b"a", b"1")
+    tx.cancel()
+
+
+def test_finished_tx_rejects_ops():
+    st = MemDatastore()
+    tx = st.transaction(write=True)
+    tx.commit()
+    with pytest.raises(TxFinishedError):
+        tx.get(b"a")
+
+
+def test_put_only_if_absent():
+    st = MemDatastore()
+    tx = st.transaction(write=True)
+    tx.put(b"k", b"v")
+    with pytest.raises(TxKeyAlreadyExistsError):
+        tx.put(b"k", b"v2")
+    tx.commit()
+
+
+def test_putc_delc_conditions():
+    st = MemDatastore()
+    tx = st.transaction(write=True)
+    tx.putc(b"k", b"v1", None)
+    tx.putc(b"k", b"v2", b"v1")
+    with pytest.raises(TxConditionNotMetError):
+        tx.putc(b"k", b"v3", b"WRONG")
+    tx.delc(b"k", b"v2")
+    assert tx.get(b"k") is None
+    tx.commit()
+
+
+def test_snapshot_isolation():
+    st = MemDatastore()
+    tx = st.transaction(write=True)
+    tx.set(b"x", b"old")
+    tx.commit()
+
+    reader = st.transaction(write=False)
+    assert reader.get(b"x") == b"old"
+
+    writer = st.transaction(write=True)
+    writer.set(b"x", b"new")
+    writer.set(b"y", b"born")
+    writer.commit()
+
+    # reader still sees its snapshot
+    assert reader.get(b"x") == b"old"
+    assert reader.get(b"y") is None
+    assert reader.scan(b"", b"\xff") == [(b"x", b"old")]
+    reader.cancel()
+
+    after = st.transaction(write=False)
+    assert after.get(b"x") == b"new"
+    after.cancel()
+
+
+def test_write_conflict_first_committer_wins():
+    st = MemDatastore()
+    t0 = st.transaction(write=True)
+    t0.set(b"k", b"0")
+    t0.commit()
+
+    t1 = st.transaction(write=True)
+    t2 = st.transaction(write=True)
+    t1.set(b"k", b"1")
+    t2.set(b"k", b"2")
+    t1.commit()
+    with pytest.raises(TxConflictError):
+        t2.commit()
+    final = st.transaction(write=False)
+    assert final.get(b"k") == b"1"
+    final.cancel()
+
+
+def test_disjoint_writes_no_conflict():
+    st = MemDatastore()
+    t1 = st.transaction(write=True)
+    t2 = st.transaction(write=True)
+    t1.set(b"a", b"1")
+    t2.set(b"b", b"2")
+    t1.commit()
+    t2.commit()
+
+
+def test_scan_merges_local_writes():
+    st = MemDatastore()
+    tx = st.transaction(write=True)
+    tx.set(b"a", b"1")
+    tx.set(b"c", b"3")
+    tx.commit()
+
+    tx = st.transaction(write=True)
+    tx.set(b"b", b"2")
+    tx.delete(b"a")
+    tx.set(b"c", b"3x")
+    assert tx.scan(b"", b"\xff") == [(b"b", b"2"), (b"c", b"3x")]
+    assert tx.keys(b"", b"\xff", limit=1) == [b"b"]
+    tx.cancel()
+
+    tx = st.transaction(write=False)
+    assert tx.scan(b"", b"\xff") == [(b"a", b"1"), (b"c", b"3")]
+    tx.cancel()
+
+
+def test_batch_stream():
+    st = MemDatastore()
+    tx = st.transaction(write=True)
+    for i in range(25):
+        tx.set(f"k{i:03d}".encode(), str(i).encode())
+    tx.commit()
+    tx = st.transaction(write=False)
+    batches = list(tx.batch(b"k", b"l", 10))
+    assert [len(b) for b in batches] == [10, 10, 5]
+    assert batches[0][0][0] == b"k000"
+    tx.cancel()
+
+
+def test_versioned_reads():
+    st = MemDatastore()
+    t = st.transaction(write=True)
+    t.set(b"k", b"v1")
+    t.commit()
+    v1 = st.version
+    t = st.transaction(write=True)
+    t.set(b"k", b"v2")
+    t.commit()
+    t = st.transaction(write=False)
+    assert t.get(b"k", version=v1) == b"v1"
+    assert t.get(b"k") == b"v2"
+    t.cancel()
+
+
+def test_gc_compacts_chains():
+    st = MemDatastore()
+    for i in range(5):
+        t = st.transaction(write=True)
+        t.set(b"k", str(i).encode())
+        t.commit()
+    assert len(st.data[b"k"]) == 5
+    st.gc()
+    assert len(st.data[b"k"]) == 1
+    t = st.transaction(write=False)
+    assert t.get(b"k") == b"4"
+    t.cancel()
+
+
+def test_datastore_catalog():
+    ds = Datastore("memory")
+    tx = ds.transaction(write=True)
+    tx.ensure_tb("my_ns", "my_db", "person")
+    tx.commit()
+
+    tx = ds.transaction(write=False)
+    assert tx.get_ns("my_ns")["name"] == "my_ns"
+    assert tx.get_db("my_ns", "my_db")["name"] == "my_db"
+    assert tx.get_tb("my_ns", "my_db", "person")["name"] == "person"
+    assert [t["name"] for t in tx.all_tb("my_ns", "my_db")] == ["person"]
+    assert tx.get_tb("my_ns", "my_db", "nope") is None
+    tx.cancel()
+
+
+def test_records_roundtrip():
+    from surrealdb_tpu.sql.value import Thing
+
+    ds = Datastore("memory")
+    tx = ds.transaction(write=True)
+    doc = {"id": Thing("person", 1), "name": "Tobie", "tags": ["a", "b"]}
+    tx.set_record("n", "d", "person", 1, doc)
+    tx.commit()
+    tx = ds.transaction(write=False)
+    got = tx.get_record("n", "d", "person", 1)
+    assert got["name"] == "Tobie"
+    assert got["id"] == Thing("person", 1)
+    tx.cancel()
+
+
+def test_file_datastore_persists(tmp_path):
+    path = str(tmp_path / "data.stpu")
+    ds = Datastore(f"file://{path}")
+    tx = ds.transaction(write=True)
+    tx.set_record("n", "d", "t", 1, {"v": 42})
+    tx.commit()
+    ds.close()
+
+    ds2 = Datastore(f"file://{path}")
+    tx = ds2.transaction(write=False)
+    assert tx.get_record("n", "d", "t", 1) == {"v": 42}
+    tx.cancel()
