@@ -124,11 +124,13 @@ def enc_value_key(v: Any) -> bytes:
         # Ints and floats share one numeric ordering and one representation:
         # f64 ordering bytes + clamped i64 tie-break, so 1 and 1.0 (equal in
         # SurrealQL) produce identical key bytes. -0.0 normalizes to 0.
+        if isinstance(v, int) and not (-(2**63) <= v < 2**63):
+            raise ValueError("integer key component out of i64 range")
         f = 0.0 if v == 0 else float(v)
-        if math.isfinite(f):
-            tie = max(min(int(v), 2**63 - 1), -(2**63))
+        if math.isfinite(f) and -(2**63) <= v < 2**63:
+            tie = int(v)
         else:
-            tie = 0  # inf/nan have no integral tie-break
+            tie = 0  # inf/nan/out-of-i64 floats have no integral tie-break
         return bytes([T_NUMBER]) + enc_f64(f) + enc_i64(tie)
     if isinstance(v, str):
         return bytes([T_STRAND]) + enc_str(v)

@@ -47,18 +47,20 @@ class MemDatastore(BackendDatastore):
 
     # -- version-chain helpers --------------------------------------------
     def _read_at(self, key: bytes, snapshot: int) -> Optional[bytes]:
-        chain = self.data.get(key)
-        if not chain:
+        with self.lock:  # gc() truncates chains in place
+            chain = self.data.get(key)
+            if not chain:
+                return None
+            # chains are short; linear scan from the end
+            for ver, val in reversed(chain):
+                if ver <= snapshot:
+                    return val
             return None
-        # chains are short; linear scan from the end
-        for ver, val in reversed(chain):
-            if ver <= snapshot:
-                return val
-        return None
 
     def _latest_version(self, key: bytes) -> int:
-        chain = self.data.get(key)
-        return chain[-1][0] if chain else 0
+        with self.lock:
+            chain = self.data.get(key)
+            return chain[-1][0] if chain else 0
 
     def gc(self) -> None:
         """Drop version-chain entries older than the oldest active snapshot."""

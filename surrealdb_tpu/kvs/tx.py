@@ -33,6 +33,7 @@ class Transaction:
 
     # ------------------------------------------------------------ lifecycle
     def commit(self) -> None:
+        self.complete_changes()
         self.tr.commit()
 
     def cancel(self) -> None:

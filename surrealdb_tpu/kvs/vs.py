@@ -29,12 +29,16 @@ class Oracle:
     """Monotone versionstamp source, one per datastore."""
 
     def __init__(self):
+        import threading
+
         self._last = 0
+        self._lock = threading.Lock()
 
     def next_vs(self, now_nanos: int) -> bytes:
-        v = max(now_nanos, self._last + 1)
-        self._last = v
-        return versionstamp(v)
+        with self._lock:
+            v = max(now_nanos, self._last + 1)
+            self._last = v
+            return versionstamp(v)
 
 
 class SystemClock:

@@ -1,0 +1,431 @@
+"""Parser: token-level and AST-shape tests."""
+
+import pytest
+
+from surrealdb_tpu.err import ParseError
+from surrealdb_tpu.sql import ast as A
+from surrealdb_tpu.sql import statements as S
+from surrealdb_tpu.sql import path as P
+from surrealdb_tpu.sql.value import Duration, NONE, Null, Thing
+from surrealdb_tpu.syn import parse_query, parse_thing, parse_value
+
+
+def one(text):
+    q = parse_query(text)
+    assert len(q.statements) == 1
+    return q.statements[0]
+
+
+# ---------------------------------------------------------------- literals
+def test_literals():
+    assert parse_value("42").value == 42
+    assert parse_value("-17").compute(None) == -17
+    assert parse_value("3.5").value == 3.5
+    assert parse_value("1e3").value == 1000.0
+    assert parse_value("5f").value == 5.0
+    assert parse_value("'hello'").value == "hello"
+    assert parse_value('"world"').value == "world"
+    assert parse_value("true").value is True
+    assert parse_value("false").value is False
+    assert parse_value("NULL").value is Null
+    assert parse_value("NONE").value is NONE
+    assert parse_value("1h30m").value == Duration.parse("1h30m")
+    assert parse_value("[1, 2, 3]").compute(None) == [1, 2, 3]
+    assert parse_value("{ a: 1, b: 'x' }").compute(None) == {"a": 1, "b": "x"}
+    assert parse_value("{}").compute(None) == {}
+
+
+def test_string_escapes():
+    assert parse_value(r"'it\'s'").value == "it's"
+    assert parse_value(r'"a\nb"').value == "a\nb"
+    assert parse_value(r"'A'").value == "A"
+
+
+def test_datetime_uuid_literals():
+    v = parse_value("d'2024-01-01T00:00:00Z'").value
+    assert v.nanos == 1704067200 * 10**9
+    u = parse_value("u'018e6c3f-8b84-7b67-b2d5-6ae5c2b7a1a2'").value
+    assert str(u.value) == "018e6c3f-8b84-7b67-b2d5-6ae5c2b7a1a2"
+
+
+def test_record_ids():
+    t = parse_thing("person:1")
+    assert t == Thing("person", 1)
+    assert parse_thing("person:tobie") == Thing("person", "tobie")
+    assert parse_thing("person:⟨complex id⟩") == Thing("person", "complex id")
+    e = parse_value("person:['London', 1]")
+    assert isinstance(e, A.ThingLit)
+
+
+def test_thing_range():
+    e = parse_value("person:1..100")
+    assert isinstance(e, A.ThingLit)
+    v = e.compute(None)
+    assert isinstance(v, A.ThingRange)
+    assert v.rng.beg == 1 and v.rng.end == 100
+
+
+# ---------------------------------------------------------------- operators
+def test_precedence():
+    e = parse_value("1 + 2 * 3")
+    assert e.compute(None) == 7
+    assert parse_value("(1 + 2) * 3").compute(None) == 9
+    assert parse_value("2 ** 3 ** 2").compute(None) == 512  # right-assoc
+    assert parse_value("10 - 2 - 3").compute(None) == 5
+    assert parse_value("1 < 2 AND 3 < 4").compute(None) is True
+    assert parse_value("true OR false AND false").compute(None) is True
+
+
+def test_comparison_ops():
+    assert parse_value("1 = 1.0").compute(None) is True
+    assert parse_value("1 == 1.0").compute(None) is True
+    assert parse_value("'a' != 'b'").compute(None) is True
+    assert parse_value("[1,2] ?= 2").compute(None) is True
+    assert parse_value("[2,2] *= 2").compute(None) is True
+    assert parse_value("2 IN [1,2,3]").compute(None) is True
+    assert parse_value("5 NOT IN [1,2,3]").compute(None) is True
+    assert parse_value("[1,2,3] CONTAINS 2").compute(None) is True
+    assert parse_value("[1,2,3] CONTAINSALL [1,3]").compute(None) is True
+    assert parse_value("[1,2,3] CONTAINSNONE [7,8]").compute(None) is True
+    assert parse_value("'hello world' ~ 'WORLD'").compute(None) is True
+
+
+def test_arith_semantics():
+    assert parse_value("7 / 2").compute(None) == 3.5
+    assert parse_value("8 / 2").compute(None) == 4
+    assert parse_value("'a' + 'b'").compute(None) == "ab"
+    assert parse_value("[1] + [2]").compute(None) == [1, 2]
+    assert parse_value("[1,2,3] - 2").compute(None) == [1, 3]
+    assert parse_value("10 % 3").compute(None) == 1
+
+
+def test_nullish_ops():
+    assert parse_value("NONE ?? 'x'").compute(None) == "x"
+    assert parse_value("NULL ?? 'x'").compute(None) == "x"
+    assert parse_value("'a' ?? 'x'").compute(None) == "a"
+    assert parse_value("'' ?: 'fallback'").compute(None) == "fallback"
+    assert parse_value("NOT true").compute(None) is False
+    assert parse_value("!true").compute(None) is False
+    assert parse_value("!!1").compute(None) is True
+
+
+def test_is_operator():
+    assert parse_value("1 IS 1").compute(None) is True
+    assert parse_value("1 IS NOT 2").compute(None) is True
+
+
+def test_range_values():
+    r = parse_value("1..5").compute(None)
+    assert r.beg == 1 and r.end == 5 and not r.end_incl
+    r = parse_value("1..=5").compute(None)
+    assert r.end_incl
+    assert parse_value("3 IN 1..5").compute(None) is True
+
+
+def test_cast():
+    assert parse_value("<int> '42'").compute(None) == 42
+    assert parse_value("<string> 42").compute(None) == "42"
+    assert parse_value("<float> 2").compute(None) == 2.0
+    assert parse_value("<bool> 'true'").compute(None) is True
+    assert parse_value("<array> 1").compute(None) == [1]
+
+
+def test_knn_operator_shape():
+    e = parse_value("pt <|10|> [1,2,3]")
+    assert isinstance(e, A.KnnOp) and e.k == 10 and e.ef is None
+    e = parse_value("pt <|10,40|> [1,2,3]")
+    assert e.k == 10 and e.ef == 40
+    e = parse_value("pt <|3,COSINE|> $q")
+    assert e.k == 3 and e.dist == "cosine"
+
+
+def test_matches_operator_shape():
+    e = parse_value("content @1@ 'hello world'")
+    assert isinstance(e, A.MatchesOp) and e.ref == 1
+    e = parse_value("content @@ 'hello'")
+    assert e.ref is None
+
+
+# ---------------------------------------------------------------- idioms
+def test_idiom_shapes():
+    e = parse_value("a.b.c")
+    assert isinstance(e, P.Idiom)
+    assert [type(p).__name__ for p in e.parts] == ["PField", "PField", "PField"]
+    e = parse_value("a[0].b")
+    assert isinstance(e.parts[1], P.PIndex)
+    e = parse_value("a[*]")
+    assert isinstance(e.parts[1], P.PAll)
+    e = parse_value("a[$]")
+    assert isinstance(e.parts[1], P.PLast)
+    e = parse_value("a[WHERE x > 1]")
+    assert isinstance(e.parts[1], P.PWhere)
+
+
+def test_graph_idioms():
+    e = parse_value("->knows->person")
+    assert isinstance(e, P.Idiom)
+    assert [p.dir for p in e.parts] == ["out", "out"]
+    assert e.parts[0].what == ["knows"]
+    e = parse_value("<-knows<-person")
+    assert [p.dir for p in e.parts] == ["in", "in"]
+    e = parse_value("->(knows WHERE weight > 5)->person")
+    assert e.parts[0].cond is not None
+    e = parse_value("person:1->knows->person")
+    assert isinstance(e.parts[0], P.PStart)
+
+
+def test_param_idiom():
+    e = parse_value("$a.b")
+    assert isinstance(e, P.Idiom)
+    assert isinstance(e.parts[0], P.PStart)
+
+
+# ---------------------------------------------------------------- statements
+def test_select_clauses():
+    s = one(
+        "SELECT name, age AS years FROM person, animal WHERE age > 18 "
+        "SPLIT tags GROUP BY city ORDER BY age DESC LIMIT 5 START 10 "
+        "FETCH friend TIMEOUT 5s PARALLEL"
+    )
+    assert isinstance(s, S.SelectStatement)
+    assert len(s.fields) == 2
+    assert s.fields[1].alias is not None
+    assert len(s.what) == 2
+    assert s.cond is not None
+    assert s.split and s.group and s.order
+    assert not s.order[0].asc
+    assert s.parallel
+    assert s.timeout == Duration.parse("5s")
+
+
+def test_select_value_and_only():
+    s = one("SELECT VALUE name FROM person")
+    assert s.value_mode
+    s = one("SELECT * FROM ONLY person:1")
+    assert s.only
+
+
+def test_select_explain():
+    s = one("SELECT * FROM person EXPLAIN FULL")
+    assert s.explain and s.explain_full
+
+
+def test_create_forms():
+    s = one("CREATE person SET name = 'x', age += 1")
+    assert isinstance(s, S.CreateStatement)
+    assert s.data.kind == "set"
+    s = one("CREATE person:1 CONTENT { name: 'x' } RETURN NONE")
+    assert s.data.kind == "content"
+    assert s.output.kind == "none"
+
+
+def test_update_upsert_delete():
+    s = one("UPDATE person SET age = 30 WHERE name = 'x' RETURN DIFF")
+    assert isinstance(s, S.UpdateStatement)
+    assert s.output.kind == "diff"
+    s = one("UPSERT person:1 MERGE { a: 1 }")
+    assert isinstance(s, S.UpsertStatement)
+    assert s.data.kind == "merge"
+    s = one("DELETE person WHERE age < 18")
+    assert isinstance(s, S.DeleteStatement)
+
+
+def test_insert_forms():
+    s = one("INSERT INTO person { name: 'x' }")
+    assert isinstance(s, S.InsertStatement)
+    s = one("INSERT INTO person (name, age) VALUES ('a', 1), ('b', 2)")
+    assert s.data.kind == "values"
+    cols, rows = s.data.items
+    assert len(cols) == 2 and len(rows) == 2
+    s = one("INSERT IGNORE INTO person { id: 1 }")
+    assert s.ignore
+    s = one(
+        "INSERT INTO person { id: 1 } ON DUPLICATE KEY UPDATE count += 1"
+    )
+    assert s.update is not None
+
+
+def test_relate():
+    s = one("RELATE person:1->knows->person:2 SET weight = 5")
+    assert isinstance(s, S.RelateStatement)
+    assert s.data.kind == "set"
+
+
+def test_define_table():
+    s = one("DEFINE TABLE person SCHEMAFULL PERMISSIONS NONE")
+    assert s.kind == "table"
+    assert s.args["schemafull"]
+    assert s.args["permissions"]["select"] == "NONE"
+    s = one("DEFINE TABLE likes TYPE RELATION IN person OUT person ENFORCED")
+    assert s.args["kind"] == "RELATION"
+    assert s.args["relation_in"] == ["person"]
+    assert s.args["enforced"]
+    s = one("DEFINE TABLE IF NOT EXISTS t")
+    assert s.args["if_not_exists"]
+
+
+def test_define_field():
+    s = one(
+        "DEFINE FIELD age ON TABLE person TYPE number ASSERT $value > 0 DEFAULT 1"
+    )
+    assert s.kind == "field"
+    assert s.args["kind"].name == "number"
+    assert s.args["assert"] is not None
+    s = one("DEFINE FIELD tags ON person TYPE option<array<string>>")
+    k = s.args["kind"]
+    assert k.name == "option" and k.args[0].name == "array"
+
+
+def test_define_index_kinds():
+    s = one("DEFINE INDEX uniq_email ON person FIELDS email UNIQUE")
+    assert s.args["index"]["type"] == "uniq"
+    s = one(
+        "DEFINE INDEX ft ON page FIELDS body SEARCH ANALYZER simple BM25 HIGHLIGHTS"
+    )
+    assert s.args["index"]["type"] == "search"
+    assert s.args["index"]["analyzer"] == "simple"
+    s = one("DEFINE INDEX v ON doc FIELDS emb HNSW DIMENSION 4 DIST COSINE EFC 200 M 16")
+    ix = s.args["index"]
+    assert ix["type"] == "hnsw" and ix["dimension"] == 4 and ix["dist"] == "cosine"
+    assert ix["efc"] == 200 and ix["m"] == 16
+    s = one("DEFINE INDEX v ON doc FIELDS emb MTREE DIMENSION 3")
+    assert s.args["index"]["type"] == "mtree"
+
+
+def test_define_analyzer_event_function_param():
+    s = one(
+        "DEFINE ANALYZER simple TOKENIZERS blank, class FILTERS lowercase, snowball(english)"
+    )
+    assert s.args["tokenizers"] == ["blank", "class"]
+    assert s.args["filters"][1]["name"] == "snowball"
+    s = one("DEFINE EVENT e ON TABLE person WHEN $event = 'CREATE' THEN (CREATE log)")
+    assert s.kind == "event"
+    s = one("DEFINE FUNCTION fn::greet($name: string) { RETURN 'hi ' + $name }")
+    assert s.kind == "function" and s.args["name"] == "greet"
+    s = one("DEFINE PARAM $minimum VALUE 18")
+    assert s.kind == "param"
+
+
+def test_define_user_access():
+    s = one("DEFINE USER root ON ROOT PASSWORD 'secret' ROLES OWNER")
+    assert s.args["base"] == "root" and s.args["roles"] == ["Owner"]
+    s = one(
+        "DEFINE ACCESS account ON DATABASE TYPE RECORD "
+        "SIGNUP (CREATE user SET email = $email) "
+        "SIGNIN (SELECT * FROM user WHERE email = $email) DURATION FOR TOKEN 15m"
+    )
+    assert s.args["access_type"] == "record"
+    assert s.args["token_duration"] == Duration.parse("15m").nanos
+
+
+def test_remove_statements():
+    s = one("REMOVE TABLE person")
+    assert s.kind == "table" and s.name == "person"
+    s = one("REMOVE INDEX idx ON person")
+    assert s.kind == "index" and s.table == "person"
+    s = one("REMOVE FIELD age ON TABLE person")
+    assert s.kind == "field"
+    s = one("REMOVE FUNCTION fn::greet")
+    assert s.kind == "function" and s.name == "greet"
+
+
+def test_control_statements():
+    s = one("LET $x = 40 + 2")
+    assert isinstance(s, S.LetStatement)
+    s = one("RETURN $x * 2")
+    assert isinstance(s, S.ReturnStatement)
+    s = one("IF $x > 1 { RETURN 'big' } ELSE { RETURN 'small' }")
+    assert isinstance(s, S.IfStatement)
+    s = one("FOR $i IN [1,2,3] { CREATE thing SET n = $i }")
+    assert isinstance(s, S.ForStatement)
+    s = one("THROW 'bad'")
+    assert isinstance(s, S.ThrowStatement)
+    s = one("BEGIN TRANSACTION")
+    assert isinstance(s, S.BeginStatement)
+    s = one("INFO FOR DB")
+    assert isinstance(s, S.InfoStatement)
+
+
+def test_live_kill():
+    s = one("LIVE SELECT * FROM person WHERE age > 18")
+    assert isinstance(s, S.LiveStatement)
+    s = one("LIVE SELECT DIFF FROM person")
+    assert s.diff
+    s = one("KILL u'63c1f0f0-0000-4000-8000-000000000000'")
+    assert isinstance(s, S.KillStatement)
+
+
+def test_multi_statements_and_comments():
+    q = parse_query(
+        """
+        -- a comment
+        LET $a = 1;
+        /* block
+           comment */
+        RETURN $a; # trailing comment
+        """
+    )
+    assert len(q.statements) == 2
+
+
+def test_subquery_and_block():
+    e = parse_value("(SELECT * FROM person)")
+    assert isinstance(e, A.Subquery)
+    s = one("RETURN { LET $v = 2; RETURN $v * 2 }")
+    assert isinstance(s.what, A.Block)
+
+
+def test_closures_and_mock():
+    e = parse_value("|$a: int| $a + 1")
+    assert isinstance(e, A.ClosureLit)
+    assert e.params[0][0] == "a"
+    e = parse_value("|person:100|")
+    assert isinstance(e, A.MockExpr) and e.count == 100
+    e = parse_value("|person:1..50|")
+    assert e.range == (1, 50)
+
+
+def test_future():
+    e = parse_value("<future> { 1 + 2 }")
+    assert isinstance(e, A.FutureLit)
+
+
+def test_functions():
+    e = parse_value("count()")
+    assert isinstance(e, A.FunctionCall)
+    e = parse_value("array::len([1,2])")
+    assert e.name == "array::len"
+    e = parse_value("fn::my::func(1)")
+    assert isinstance(e, A.CustomFunctionCall) and e.name == "my::func"
+    e = parse_value("math::pi")
+    assert isinstance(e, A.Constant)
+    import math
+
+    assert e.compute(None) == math.pi
+
+
+def test_parse_errors():
+    with pytest.raises(ParseError):
+        parse_query("SELECT FROM")
+    with pytest.raises(ParseError):
+        parse_query("CREATE person SET = 5")
+    with pytest.raises(ParseError):
+        parse_value("'unterminated")
+    with pytest.raises(ParseError):
+        parse_query("DEFINE WIDGET x")
+
+
+def test_repr_round_trip():
+    """repr of parsed statements must re-parse to the same repr."""
+    cases = [
+        "SELECT name, age FROM person WHERE age > 18 ORDER BY age DESC LIMIT 5",
+        "CREATE person:1 SET name = 'x'",
+        "UPDATE person SET age += 1 WHERE name = 'y' RETURN AFTER",
+        "DELETE person WHERE age < 2",
+        "RELATE person:1 -> knows -> person:2",
+        "SELECT ->knows->person FROM person:1",
+    ]
+    for text in cases:
+        r1 = repr(one(text))
+        r2 = repr(one(r1))
+        assert r1 == r2, f"unstable repr for {text!r}: {r1!r} vs {r2!r}"
