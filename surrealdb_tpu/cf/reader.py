@@ -1,0 +1,61 @@
+"""Changefeed reading: SHOW CHANGES FOR TABLE ... SINCE ...
+
+Role of the reference's cf reader (reference: core/src/cf/reader.rs): scan
+the versionstamped change keys of the database and surface each ChangeSet as
+{versionstamp, changes: [...]}.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from surrealdb_tpu import key as keys
+from surrealdb_tpu.err import SurrealError
+from surrealdb_tpu.key.encode import prefix_end
+from surrealdb_tpu.kvs.vs import vs_to_u64, u64_to_vs
+from surrealdb_tpu.sql.value import Datetime
+from surrealdb_tpu.utils.ser import unpack
+
+
+def show_changes(ctx, stm) -> List[dict]:
+    ns, db = ctx.ns_db()
+    txn = ctx.txn()
+
+    db_def = txn.get_db(ns, db)
+    tb_def = txn.get_tb(ns, db, stm.table) if stm.table else None
+    has_cf = (db_def or {}).get("changefeed") or (tb_def or {}).get("changefeed")
+    if not has_cf:
+        raise SurrealError(
+            f"Change feed for table '{stm.table}' is not enabled"
+            if stm.table
+            else f"Change feed for database '{db}' is not enabled"
+        )
+
+    since_vs = 0
+    if stm.since is not None:
+        v = stm.since.compute(ctx) if hasattr(stm.since, "compute") else stm.since
+        if isinstance(v, Datetime):
+            since_vs = 0  # datetime SINCE: replay all retained (ts→vs map later)
+        else:
+            since_vs = int(v)
+
+    beg = keys.change(ns, db, u64_to_vs(since_vs))
+    end = prefix_end(keys.change_prefix(ns, db))
+    limit = stm.limit if stm.limit is not None else -1
+
+    out: List[dict] = []
+    for k, raw in txn.scan(beg, end, limit):
+        entry = unpack(raw)
+        vs = keys.decode_change(k, ns, db)
+        changes: List[Any] = []
+        for tb, muts in entry.get("tables", {}).items():
+            if stm.table and tb != stm.table:
+                continue
+            for m in muts:
+                if m.get("delete"):
+                    changes.append({"delete": {"id": m["id"]}})
+                else:
+                    changes.append({"update": m.get("update")})
+        if changes:
+            out.append({"versionstamp": vs_to_u64(vs), "changes": changes})
+    return out

@@ -1,0 +1,237 @@
+"""Statement loop and transaction management.
+
+Role of the reference's Executor (reference: core/src/dbs/executor.rs:34-593):
+runs each statement of a query, opening one transaction per bare statement or
+one shared transaction for an explicit BEGIN..COMMIT block; buffers responses
+inside an explicit transaction so a failure/cancel can retroactively flip
+them; flushes live-query notifications only on successful commit.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from surrealdb_tpu.err import (
+    ControlFlow,
+    QueryCancelledError,
+    ReturnError,
+    SurrealError,
+)
+from surrealdb_tpu.sql.statements import (
+    BeginStatement,
+    CancelStatement,
+    CommitStatement,
+    KillStatement,
+    LiveStatement,
+    OptionStatement,
+    Query,
+    UseStatement,
+)
+from surrealdb_tpu.sql.value import NONE, is_none
+
+from .context import Context
+from .session import Session
+
+# Expression recursion is depth-limited by MAX_COMPUTATION_DEPTH (120), but
+# each level can span many Python frames; mirror the reference's big-stack
+# runtime setup (reference: src/main.rs:38-49 RUNTIME_STACK_SIZE).
+if sys.getrecursionlimit() < 20_000:
+    sys.setrecursionlimit(20_000)
+
+_FAILED_TX = "The query was not executed due to a failed transaction"
+_CANCELLED_TX = "The query was not executed due to a cancelled transaction"
+
+
+def _fmt_time(seconds: float) -> str:
+    if seconds < 1e-3:
+        return f"{seconds * 1e6:.1f}µs"
+    if seconds < 1.0:
+        return f"{seconds * 1e3:.2f}ms"
+    return f"{seconds:.3f}s"
+
+
+class Executor:
+    def __init__(self, ds, session: Session, vars: Optional[Dict[str, Any]] = None):
+        self.ds = ds
+        self.session = session
+        self.vars = vars or {}
+        self.txn = None
+        self.explicit = False  # inside BEGIN..COMMIT
+        self.failed: Optional[str] = None  # error text that poisoned the txn
+        self._buffered: List[dict] = []  # responses inside the explicit txn
+        self._notifications: List[Any] = []
+
+    # ------------------------------------------------------------ txns
+    def current_txn(self):
+        return self.txn
+
+    def _open(self, write: bool) -> None:
+        if self.txn is None or self.txn.done:
+            self.txn = self.ds.transaction(write)
+
+    def _commit(self) -> None:
+        if self.txn is not None and not self.txn.done:
+            self.txn.commit()
+            self._flush_notifications()
+        self.txn = None
+
+    def _cancel(self) -> None:
+        if self.txn is not None and not self.txn.done:
+            self.txn.cancel()
+        self.txn = None
+        self._notifications = []
+
+    # ------------------------------------------------------------ notifications
+    def buffer_notification(self, n) -> None:
+        self._notifications.append(n)
+
+    def _flush_notifications(self) -> None:
+        hub = self.ds.notifications
+        if hub is not None:
+            for n in self._notifications:
+                hub.publish(n)
+        self._notifications = []
+
+    # ------------------------------------------------------------ main loop
+    def execute(self, query: Query) -> List[dict]:
+        out: List[dict] = []
+        ctx = Context(self, self.session)
+        for name, value in self.vars.items():
+            ctx.set_param(name, value)
+
+        for stm in query.statements:
+            t0 = time.perf_counter()
+
+            if isinstance(stm, BeginStatement):
+                if not self.explicit:
+                    self._open(True)
+                    self.explicit = True
+                    self.failed = None
+                    self._buffered = []
+                continue
+
+            if isinstance(stm, CommitStatement):
+                if self.explicit:
+                    if self.failed is None:
+                        try:
+                            self._commit()
+                        except SurrealError as e:
+                            self.failed = str(e)
+                            self._cancel()
+                    else:
+                        self._cancel()
+                    if self.failed is not None:
+                        for r in self._buffered:
+                            if r["status"] == "OK":
+                                r["status"] = "ERR"
+                                r["result"] = _FAILED_TX
+                    out.extend(self._buffered)
+                    self._buffered = []
+                    self.explicit = False
+                    self.failed = None
+                continue
+
+            if isinstance(stm, CancelStatement):
+                if self.explicit:
+                    self._cancel()
+                    for r in self._buffered:
+                        r["status"] = "ERR"
+                        r["result"] = _CANCELLED_TX
+                    out.extend(self._buffered)
+                    self._buffered = []
+                    self.explicit = False
+                    self.failed = None
+                continue
+
+            # inside a poisoned explicit transaction: report, don't run
+            if self.explicit and self.failed is not None:
+                self._push(out, {"status": "ERR", "result": _FAILED_TX, "time": _fmt_time(0)})
+                continue
+
+            resp = self._run_statement(ctx, stm)
+            resp["time"] = _fmt_time(time.perf_counter() - t0)
+            self._push(out, resp)
+
+        # an unterminated BEGIN block: treat like CANCEL (reference cancels on drop)
+        if self.explicit:
+            self._cancel()
+            for r in self._buffered:
+                r["status"] = "ERR"
+                r["result"] = _CANCELLED_TX
+            out.extend(self._buffered)
+            self._buffered = []
+            self.explicit = False
+
+        return out
+
+    def _push(self, out: List[dict], resp: dict) -> None:
+        if self.explicit:
+            self._buffered.append(resp)
+        else:
+            out.append(resp)
+
+    def _run_statement(self, ctx: Context, stm) -> dict:
+        # session-state statements need no transaction
+        if isinstance(stm, (UseStatement, OptionStatement)):
+            try:
+                stm.compute(ctx)
+                return {"status": "OK", "result": NONE}
+            except SurrealError as e:
+                return {"status": "ERR", "result": str(e)}
+
+        writeable = stm.writeable()
+        own_txn = not self.explicit
+        if own_txn:
+            self._open(writeable)
+        try:
+            try:
+                result = stm.compute(ctx)
+            except ReturnError as r:
+                result = r.value
+            if own_txn:
+                if writeable:
+                    self._commit()
+                else:
+                    self._cancel()
+            return {"status": "OK", "result": result}
+        except ControlFlow as e:
+            # BREAK/CONTINUE outside a loop etc.
+            if own_txn:
+                self._cancel()
+            if self.explicit:
+                self.failed = str(e)
+            return {"status": "ERR", "result": f"Unexpected control flow: {e}"}
+        except SurrealError as e:
+            if own_txn:
+                self._cancel()
+            if self.explicit:
+                self.failed = str(e)
+            return {"status": "ERR", "result": str(e)}
+        except Exception as e:
+            # engine bugs must not leak transactions or abort the whole call
+            if own_txn:
+                self._cancel()
+            if self.explicit:
+                self.failed = str(e)
+            return {"status": "ERR", "result": f"Internal error: {type(e).__name__}: {e}"}
+
+    # ------------------------------------------------------------ expressions
+    def compute_expression(self, expr) -> Any:
+        """Evaluate one expression in its own transaction
+        (reference kvs/ds.rs compute)."""
+        ctx = Context(self, self.session)
+        for name, value in self.vars.items():
+            ctx.set_param(name, value)
+        self._open(getattr(expr, "writeable", lambda: False)())
+        try:
+            try:
+                v = expr.compute(ctx)
+            except ReturnError as r:
+                v = r.value
+            self._commit()
+            return v
+        except BaseException:
+            self._cancel()
+            raise

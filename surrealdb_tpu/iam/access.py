@@ -1,0 +1,17 @@
+"""ACCESS statement execution (grant/show/revoke/purge of bearer grants).
+
+Role of the reference's AccessStatement compute (reference:
+core/src/sql/statements/access.rs). Bearer-grant management lands with the
+auth milestone; the statement surface is wired so parsing and dispatch are
+complete.
+"""
+
+from __future__ import annotations
+
+from surrealdb_tpu.err import SurrealError
+
+
+def access_compute(ctx, stm):
+    raise SurrealError(
+        f"ACCESS {stm.op.upper()} is not yet supported on this build"
+    )

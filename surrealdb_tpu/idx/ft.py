@@ -1,0 +1,20 @@
+"""Full-text index write path (SEARCH index definitions).
+
+Role of the reference's FtIndex::index_document (reference:
+core/src/idx/ft/mod.rs). The inverted index (analyzers, term dictionary,
+postings, doc lengths, batched BM25 scoring on device) is built in the
+full-text milestone; until ft_index lands this is a tolerant no-op so SEARCH
+index definitions don't break writes.
+"""
+
+from __future__ import annotations
+
+from surrealdb_tpu.sql.value import Thing
+
+
+def update_ft_index(ctx, ix: dict, rid: Thing, old_vals, new_vals) -> None:
+    try:
+        from surrealdb_tpu.idx.ft_index import FtIndex
+    except ImportError:
+        return
+    FtIndex.for_index(ctx, ix).index_document(ctx, rid, old_vals, new_vals)

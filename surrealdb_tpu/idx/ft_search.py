@@ -1,0 +1,78 @@
+"""MATCHES (@@) query plan.
+
+Role of the reference's MatchesThingIterator + per-doc matches() check
+(reference: core/src/idx/planner/iterators.rs:849-904, executor.rs:878-937).
+Until the inverted-index milestone lands this executes as a streamed scan
+with naive whitespace/lowercase analysis; the plan object already implements
+the QueryExecutor protocol (matches / score / highlight hooks) so the
+operator wiring is final.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+from surrealdb_tpu.sql.value import Thing
+
+_TOKEN = re.compile(r"\w+", re.UNICODE)
+
+
+def _analyze(text: str) -> List[str]:
+    return [t.lower() for t in _TOKEN.findall(text)]
+
+
+class MatchesPlan:
+    def __init__(self, tb: str, ix: dict, op, query):
+        self.tb = tb
+        self.ix = ix
+        self.op = op
+        self.query = query if isinstance(query, str) else str(query)
+        self.terms = _analyze(self.query)
+        self._matched: Dict[Any, float] = {}
+
+    def explain(self) -> dict:
+        return {
+            "index": self.ix["name"],
+            "operator": f"@{self.op.ref if self.op.ref is not None else ''}@",
+            "query": self.query,
+        }
+
+    # ------------------------------------------------------------ iteration
+    def iterate(self, ctx):
+        ctx.qe = self
+        from surrealdb_tpu.dbs.iterator import scan_table
+
+        field = self.op.l
+        for rid, doc in scan_table(ctx, self.tb):
+            with ctx.with_doc_value(doc, rid=rid) as c:
+                v = field.compute(c)
+            texts = v if isinstance(v, list) else [v]
+            toks: List[str] = []
+            for t in texts:
+                if isinstance(t, str):
+                    toks.extend(_analyze(t))
+            if toks and all(t in toks for t in self.terms):
+                score = float(sum(toks.count(t) for t in self.terms))
+                self._matched[(rid.tb, repr(rid.id))] = score
+                yield rid, doc, {"score": score}
+
+    # ------------------------------------------------------------ executor protocol
+    def _key(self, rid: Thing):
+        return (rid.tb, repr(rid.id))
+
+    def matches(self, ctx, doc, op) -> bool:
+        rid = doc.rid
+        return rid is not None and self._key(rid) in self._matched
+
+    def knn(self, ctx, doc, op) -> bool:
+        return False
+
+    def knn_distance(self, rid) -> Optional[float]:
+        return None
+
+    def score(self, ctx, doc, ref=None) -> Optional[float]:
+        rid = doc.rid
+        if rid is None:
+            return None
+        return self._matched.get(self._key(rid))

@@ -1,0 +1,281 @@
+"""Query planner: choose index-backed iteration over table scans.
+
+Role of the reference's QueryPlanner (reference: core/src/idx/planner/mod.rs:
+93-232, plan.rs:27-93, tree.rs): analyze the WHERE/WITH clauses per table and
+replace ITable sources with IIndex plans. Plan taxonomy mirrors the
+reference: SingleIndex / SingleIndexRange / MultiIndex / TableIterator, plus
+the kNN/MATCHES operator wiring.
+
+v1 supports equality/range/kNN plans over 'idx', 'uniq', 'hnsw' and 'mtree'
+indexes; unsupported shapes fall back to a table scan (always correct).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from surrealdb_tpu import key as keys
+from surrealdb_tpu.key.encode import prefix_end
+from surrealdb_tpu.sql.ast import BinaryOp, Expr, KnnOp, Literal, MatchesOp, Param
+from surrealdb_tpu.sql.path import Idiom
+from surrealdb_tpu.sql.value import Range, Thing, is_nullish
+from surrealdb_tpu.utils.ser import unpack
+
+from .knn import KnnPlan
+from .ft_search import MatchesPlan
+
+
+# ------------------------------------------------------------------ plans
+class IndexEqualPlan:
+    """WHERE field = value over an 'idx'/'uniq' index
+    (reference ThingIterator::IndexEqual/UniqueEqual)."""
+
+    def __init__(self, tb: str, ix: dict, values: List[Any]):
+        self.tb = tb
+        self.ix = ix
+        self.values = values
+
+    def explain(self) -> dict:
+        return {
+            "index": self.ix["name"],
+            "operator": "=",
+            "value": self.values[0] if len(self.values) == 1 else self.values,
+        }
+
+    def iterate(self, ctx):
+        ns, db = ctx.ns_db()
+        txn = ctx.txn()
+        name = self.ix["name"]
+        if self.ix["index"]["type"] == "uniq":
+            raw = txn.get(keys.unique_entry(ns, db, self.tb, name, self.values))
+            if raw is not None:
+                rid = unpack(raw)
+                yield rid, None, None
+            return
+        pre = keys.index_entry_prefix(ns, db, self.tb, name, self.values)
+        for chunk in txn.batch(pre, prefix_end(pre), 1000):
+            for k, _ in chunk:
+                _, rid = keys.decode_index_entry_id(
+                    k, ns, db, self.tb, name, len(self.values)
+                )
+                yield rid, None, None
+
+
+class IndexRangePlan:
+    """WHERE field >/</BETWEEN over an ordered index
+    (reference ThingIterator::IndexRange/UniqueRange)."""
+
+    def __init__(self, tb: str, ix: dict, beg, end, beg_incl: bool, end_incl: bool):
+        self.tb = tb
+        self.ix = ix
+        self.beg, self.end = beg, end
+        self.beg_incl, self.end_incl = beg_incl, end_incl
+
+    def explain(self) -> dict:
+        rng: dict = {}
+        if self.beg is not None:
+            rng["from"] = {"inclusive": self.beg_incl, "value": self.beg}
+        if self.end is not None:
+            rng["to"] = {"inclusive": self.end_incl, "value": self.end}
+        return {"index": self.ix["name"], "operator": "range", "range": rng}
+
+    def iterate(self, ctx):
+        ns, db = ctx.ns_db()
+        txn = ctx.txn()
+        name = self.ix["name"]
+        uniq = self.ix["index"]["type"] == "uniq"
+        mk_pre = keys.unique_entry_prefix if uniq else keys.index_entry_prefix
+        base = mk_pre(ns, db, self.tb, name)
+        from surrealdb_tpu.key.encode import enc_value_key
+
+        if self.beg is None:
+            beg = base
+        else:
+            bk = base + enc_value_key(self.beg)
+            beg = bk if self.beg_incl else prefix_end(bk)
+        if self.end is None:
+            end = prefix_end(base)
+        else:
+            ek = base + enc_value_key(self.end)
+            end = prefix_end(ek) if self.end_incl else ek
+        for chunk in txn.batch(beg, end, 1000):
+            for k, v in chunk:
+                if uniq:
+                    rid = unpack(v)
+                else:
+                    _, rid = keys.decode_index_entry_id(k, ns, db, self.tb, name, 1)
+                yield rid, None, None
+
+
+class TableScanPlan:
+    def __init__(self, tb: str):
+        self.tb = tb
+
+    def explain(self) -> dict:
+        return {"table": self.tb}
+
+
+# ------------------------------------------------------------------ analysis
+def plan_sources(ctx, stm, sources: List[Any]) -> List[Any]:
+    """Rewrite ITable sources into IIndex plans where the WHERE/kNN shape
+    allows (reference QueryPlanner::add_iterables)."""
+    from surrealdb_tpu.dbs.iterator import IIndex, ITable
+
+    with_ = getattr(stm, "with_", None)
+    if with_ is not None and with_.noindex:
+        return sources
+
+    out: List[Any] = []
+    for s in sources:
+        if not isinstance(s, ITable):
+            out.append(s)
+            continue
+        plan = build_plan(ctx, stm, s.tb, with_)
+        if plan is None:
+            out.append(s)
+        else:
+            out.append(IIndex(s.tb, plan))
+    return out
+
+
+def build_plan(ctx, stm, tb: str, with_) -> Optional[Any]:
+    ns, db = ctx.ns_db()
+    txn = ctx.txn()
+    indexes = txn.all_tb_indexes(ns, db, tb)
+    if not indexes:
+        return None
+    if with_ is not None and with_.indexes:
+        indexes = [ix for ix in indexes if ix["name"] in with_.indexes]
+
+    cond = getattr(stm, "cond", None)
+
+    # kNN / MATCHES operators take priority (reference executor entries)
+    knn = _find_operator(cond, KnnOp)
+    if knn is not None:
+        plan = _plan_knn(ctx, tb, indexes, knn)
+        if plan is not None:
+            return plan
+    matches = _find_operator(cond, MatchesOp)
+    if matches is not None:
+        plan = _plan_matches(ctx, tb, indexes, matches, stm)
+        if plan is not None:
+            return plan
+
+    if cond is None:
+        return None
+    return _plan_condition(ctx, tb, indexes, cond)
+
+
+def _find_operator(expr, klass):
+    """Locate a kNN/MATCHES operator reachable through ANDs."""
+    if expr is None:
+        return None
+    if isinstance(expr, klass):
+        return expr
+    if isinstance(expr, BinaryOp) and expr.op in ("&&", "AND"):
+        return _find_operator(expr.l, klass) or _find_operator(expr.r, klass)
+    return None
+
+
+def _plan_knn(ctx, tb: str, indexes: List[dict], knn: KnnOp):
+    if not isinstance(knn.l, Idiom):
+        return None
+    field_txt = repr(knn.l)
+    target = knn.r.compute(ctx)
+    for ix in indexes:
+        if ix["index"]["type"] not in ("hnsw", "mtree"):
+            continue
+        if not ix["fields"] or repr(ix["fields"][0]) != field_txt:
+            continue
+        return KnnPlan(tb, ix, knn, target)
+    # no vector index: brute-force kNN plan over the table
+    from .knn import BruteForceKnnPlan
+
+    return BruteForceKnnPlan(tb, knn, target)
+
+
+def _plan_matches(ctx, tb: str, indexes: List[dict], m: MatchesOp, stm):
+    if not isinstance(m.l, Idiom):
+        return None
+    field_txt = repr(m.l)
+    for ix in indexes:
+        if ix["index"]["type"] != "search":
+            continue
+        if not ix["fields"] or repr(ix["fields"][0]) != field_txt:
+            continue
+        return MatchesPlan(tb, ix, m, m.r.compute(ctx))
+    return None
+
+
+def _plan_condition(ctx, tb: str, indexes: List[dict], cond):
+    """Match simple `field op literal` shapes against single-column indexes."""
+    shape = _extract_shape(ctx, cond)
+    if shape is None:
+        return None
+    field_txt, op, value = shape
+    for ix in indexes:
+        if ix["index"]["type"] not in ("idx", "uniq"):
+            continue
+        if len(ix["fields"]) != 1 or repr(ix["fields"][0]) != field_txt:
+            continue
+        if op == "=":
+            return IndexEqualPlan(tb, ix, [value])
+        if op == "<":
+            return IndexRangePlan(tb, ix, None, value, True, False)
+        if op == "<=":
+            return IndexRangePlan(tb, ix, None, value, True, True)
+        if op == ">":
+            return IndexRangePlan(tb, ix, value, None, False, False)
+        if op == ">=":
+            return IndexRangePlan(tb, ix, value, None, True, False)
+    return None
+
+
+def _extract_shape(ctx, cond) -> Optional[Tuple[str, str, Any]]:
+    """`field op constant` (either side) where the WHERE clause is exactly
+    one comparison. Broader trees fall back to scans in v1."""
+    if not isinstance(cond, BinaryOp):
+        return None
+    op = cond.op
+    if op not in ("=", "<", "<=", ">", ">="):
+        return None
+    l, r = cond.l, cond.r
+    if isinstance(l, Idiom) and _is_const(r):
+        return repr(l), op, r.compute(ctx)
+    if isinstance(r, Idiom) and _is_const(l):
+        flip = {"=": "=", "<": ">", "<=": ">=", ">": "<", ">=": "<="}
+        return repr(r), flip[op], l.compute(ctx)
+    return None
+
+
+def _is_const(e) -> bool:
+    return isinstance(e, (Literal, Param))
+
+
+# ------------------------------------------------------------------ explain
+def explain(ctx, stm, sources: List[Any], full: bool = False) -> List[dict]:
+    """EXPLAIN output (reference: core/src/dbs/plan.rs)."""
+    from surrealdb_tpu.dbs.iterator import (
+        IIndex,
+        IRange,
+        ITable,
+        IThing,
+        IValue,
+    )
+
+    planned = plan_sources(ctx, stm, sources)
+    out: List[dict] = []
+    for s in planned:
+        if isinstance(s, IIndex):
+            out.append({"detail": {"plan": s.plan.explain(), "table": s.tb}, "operation": "Iterate Index"})
+        elif isinstance(s, ITable):
+            out.append({"detail": {"table": s.tb}, "operation": "Iterate Table"})
+        elif isinstance(s, IRange):
+            out.append({"detail": {"table": s.tb}, "operation": "Iterate Range"})
+        elif isinstance(s, IThing):
+            out.append({"detail": {"thing": s.t}, "operation": "Iterate Thing"})
+        elif isinstance(s, IValue):
+            out.append({"detail": {"value": s.v}, "operation": "Iterate Value"})
+    if full:
+        out.append({"detail": {"type": "Memory"}, "operation": "Collector"})
+    return out

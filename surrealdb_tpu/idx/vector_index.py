@@ -1,0 +1,82 @@
+"""Vector index write path (MTREE / HNSW definitions).
+
+Role of the reference's MTreeIndex/HnswIndex index_document (reference:
+core/src/idx/trees/mtree.rs:85, trees/hnsw/index.rs:89). TPU-first design:
+vectors are persisted row-wise in the KV under the index's state keyspace,
+and the device-resident mirror (a padded [N, D] matrix used by the batched
+distance/top-k kernels in idx/knn.py) refreshes by generation, mirroring the
+reference's TreeCache generation swap (trees/store/cache.rs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from surrealdb_tpu import key as keys
+from surrealdb_tpu.err import TypeError_
+from surrealdb_tpu.key.encode import enc_value_key, dec_value_key, prefix_end
+from surrealdb_tpu.sql.value import Thing, is_nullish
+from surrealdb_tpu.utils.ser import pack, unpack
+
+_ROW = b"v"  # per-record vector row
+_GEN = b"g"  # state generation counter
+
+
+def check_vector(ix: dict, val: Any) -> Optional[List[float]]:
+    """Validate/coerce a field value into the index's vector shape."""
+    if is_nullish(val) or val is None:
+        return None
+    if not isinstance(val, (list, tuple)):
+        raise TypeError_("Vector index field must be an array of numbers")
+    dim = ix["index"].get("dimension", 0)
+    if dim and len(val) != dim:
+        raise TypeError_(
+            f"Incorrect vector dimension ({len(val)}). Expected a vector of {dim} dimension."
+        )
+    out = []
+    for x in val:
+        if isinstance(x, bool) or not isinstance(x, (int, float)):
+            raise TypeError_("Vector index field must be an array of numbers")
+        out.append(float(x))
+    return out
+
+
+def _row_key(ns, db, tb, name, rid: Thing) -> bytes:
+    return keys.index_state(ns, db, tb, name, _ROW + enc_value_key(rid))
+
+
+def bump_generation(txn, ns, db, tb, name) -> None:
+    k = keys.index_state(ns, db, tb, name, _GEN)
+    raw = txn.get(k)
+    gen = (unpack(raw) if raw is not None else 0) + 1
+    txn.set(k, pack(gen))
+
+
+def read_generation(txn, ns, db, tb, name) -> int:
+    raw = txn.get(keys.index_state(ns, db, tb, name, _GEN))
+    return unpack(raw) if raw is not None else 0
+
+
+def update_vector_index(ctx, ix: dict, rid: Thing, old_vals, new_vals) -> None:
+    ns, db = ctx.ns_db()
+    txn = ctx.txn()
+    tb, name = ix["table"], ix["name"]
+    old_vec = check_vector(ix, old_vals[0]) if old_vals else None
+    new_vec = check_vector(ix, new_vals[0]) if new_vals else None
+    if old_vec is None and new_vec is None:
+        return
+    k = _row_key(ns, db, tb, name, rid)
+    if new_vec is None:
+        txn.delete(k)
+    else:
+        txn.set(k, pack(new_vec))
+    bump_generation(txn, ns, db, tb, name)
+
+
+def scan_vectors(txn, ns, db, tb, name):
+    """Yield (rid, vector) rows from the persisted index state."""
+    pre = keys.index_state(ns, db, tb, name, _ROW)
+    for chunk in txn.batch(pre, prefix_end(pre), 1000):
+        for k, v in chunk:
+            rid, _ = dec_value_key(k, len(pre))
+            yield rid, unpack(v)

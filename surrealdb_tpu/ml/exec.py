@@ -1,0 +1,24 @@
+"""ML model execution (ml::name<version>(args)).
+
+Role of the reference's Model::compute (reference: core/src/sql/model.rs).
+Model storage + the TPU inference path (jax-jitted forward over batched
+table scans) land with the ML milestone; DEFINE MODEL metadata already
+persists via the catalog.
+"""
+
+from __future__ import annotations
+
+from surrealdb_tpu.err import SurrealError
+
+
+def run_model(ctx, name: str, version: str, args):
+    ns, db = ctx.ns_db()
+    ml = ctx.txn().get_ml(ns, db, name, version)
+    if ml is None:
+        raise SurrealError(f"The model 'ml::{name}<{version}>' does not exist")
+    runner = ml.get("runner")
+    if runner is None:
+        raise SurrealError(
+            f"The model 'ml::{name}<{version}>' has no stored weights"
+        )
+    return runner(ctx, args)

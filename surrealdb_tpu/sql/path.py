@@ -262,7 +262,8 @@ def get_path(ctx, value, parts: List[Part]):
 
     if isinstance(p, PGraph):
         things = value if isinstance(value, list) else [value]
-        things = [t for t in things if isinstance(t, Thing)]
+        things = [_as_thing(t) for t in things]
+        things = [t for t in things if t is not None]
         return _graph_part(ctx, things, p, rest)
 
     if isinstance(p, PRecurse):
@@ -363,6 +364,15 @@ def _method_call(ctx, value, p: PMethod, rest):
     args = [a.compute(ctx) for a in p.args]
     out = fnc.run_method(ctx, p.name, value, args)
     return get_path(ctx, out, rest)
+
+
+def _as_thing(v) -> Optional[Thing]:
+    """A record pointer: a Thing itself or a fetched document's id."""
+    if isinstance(v, Thing):
+        return v
+    if isinstance(v, dict) and isinstance(v.get("id"), Thing):
+        return v["id"]
+    return None
 
 
 # ------------------------------------------------------------------- graph

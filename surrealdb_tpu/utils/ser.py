@@ -35,6 +35,7 @@ EXT_UUID = 5
 EXT_GEOMETRY = 6
 EXT_RANGE = 7
 EXT_TABLE = 8
+EXT_PYOBJ = 32  # AST nodes inside catalog definitions (Kind, Expr, ...)
 
 
 def _default(v: Any):
@@ -63,6 +64,13 @@ def _default(v: Any):
         return msgpack.ExtType(EXT_TABLE, str(v).encode())
     if isinstance(v, tuple):
         return list(v)
+    # catalog definitions embed AST nodes (field kinds, VALUE/ASSERT exprs,
+    # view selects); these are engine-internal values, pickled as-is
+    mod = type(v).__module__
+    if mod.startswith("surrealdb_tpu."):
+        import pickle
+
+        return msgpack.ExtType(EXT_PYOBJ, pickle.dumps(v))
     raise TypeError(f"cannot serialize {type(v).__name__}")
 
 
@@ -86,6 +94,10 @@ def _ext_hook(code: int, data: bytes):
         return Range(d["b"], d["e"], d["bi"], d["ei"])
     if code == EXT_TABLE:
         return Table(data.decode())
+    if code == EXT_PYOBJ:
+        import pickle
+
+        return pickle.loads(data)
     return msgpack.ExtType(code, data)
 
 
