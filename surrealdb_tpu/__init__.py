@@ -1,0 +1,18 @@
+"""surrealdb_tpu — a TPU-native multi-model database framework.
+
+Same capability surface as SurrealDB (document + graph + vector + full-text,
+SurrealQL, live queries, changefeeds, auth), with the data-parallel query
+iterators (kNN, BM25, graph-frontier expansion) executing as JAX/XLA kernels
+on TPU. See SURVEY.md for the blueprint and the reference mapping.
+"""
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # lazy to keep `import surrealdb_tpu` light (jax loads only when used)
+    if name == "Surreal":
+        from .sdk import Surreal
+
+        return Surreal
+    raise AttributeError(name)

@@ -1,0 +1,194 @@
+"""Command-line interface.
+
+Role of the reference's clap CLI (reference: src/cli/mod.rs:1-16 subcommands
+start, sql, import, export, ml, isready, upgrade, validate, fix, version).
+
+    python -m surrealdb_tpu start [--bind 127.0.0.1:8000] [--path memory]
+                                  [--user root --pass root] [--unauthenticated]
+    python -m surrealdb_tpu sql   [--endpoint mem://] [--ns t --db t]
+    python -m surrealdb_tpu import <file> --endpoint ... --ns ... --db ...
+    python -m surrealdb_tpu export <file> --endpoint ... --ns ... --db ...
+    python -m surrealdb_tpu validate <file...>
+    python -m surrealdb_tpu isready --endpoint http://...
+    python -m surrealdb_tpu version
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from surrealdb_tpu import __version__
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="surrealdb-tpu")
+    sub = ap.add_subparsers(dest="cmd")
+
+    p_start = sub.add_parser("start", help="start the server")
+    p_start.add_argument("path", nargs="?", default="memory")
+    p_start.add_argument("--bind", "-b", default="127.0.0.1:8000")
+    p_start.add_argument("--user", "-u")
+    p_start.add_argument("--pass", "-p", dest="password")
+    p_start.add_argument("--unauthenticated", action="store_true")
+
+    p_sql = sub.add_parser("sql", help="interactive SurrealQL shell")
+    p_sql.add_argument("--endpoint", "-e", default="mem://")
+    p_sql.add_argument("--ns", default=None)
+    p_sql.add_argument("--db", default=None)
+    p_sql.add_argument("--user", "-u")
+    p_sql.add_argument("--pass", "-p", dest="password")
+    p_sql.add_argument("--pretty", action="store_true")
+
+    p_imp = sub.add_parser("import", help="import a .surql file")
+    p_imp.add_argument("file")
+    for p in (p_imp,):
+        p.add_argument("--endpoint", "-e", default="mem://")
+        p.add_argument("--ns", required=True)
+        p.add_argument("--db", required=True)
+        p.add_argument("--user", "-u")
+        p.add_argument("--pass", "-p", dest="password")
+
+    p_exp = sub.add_parser("export", help="export to a .surql file")
+    p_exp.add_argument("file", nargs="?", default="-")
+    p_exp.add_argument("--endpoint", "-e", default="mem://")
+    p_exp.add_argument("--ns", required=True)
+    p_exp.add_argument("--db", required=True)
+    p_exp.add_argument("--user", "-u")
+    p_exp.add_argument("--pass", "-p", dest="password")
+
+    p_val = sub.add_parser("validate", help="parse-check SurrealQL files")
+    p_val.add_argument("files", nargs="+")
+
+    p_ready = sub.add_parser("isready", help="check a server is responding")
+    p_ready.add_argument("--endpoint", "-e", default="http://127.0.0.1:8000")
+
+    sub.add_parser("version", help="print version")
+
+    args = ap.parse_args(argv)
+    if args.cmd is None:
+        ap.print_help()
+        return 1
+    return {
+        "start": _start,
+        "sql": _sql,
+        "import": _import,
+        "export": _export,
+        "validate": _validate,
+        "isready": _isready,
+        "version": _version,
+    }[args.cmd](args)
+
+
+def _version(args) -> int:
+    print(f"surrealdb-tpu {__version__}")
+    return 0
+
+
+def _start(args) -> int:
+    from surrealdb_tpu.net.server import serve
+    from surrealdb_tpu.dbs.session import Session
+
+    host, _, port = args.bind.partition(":")
+    srv = serve(
+        args.path, host or "127.0.0.1", int(port or 8000),
+        auth_enabled=not args.unauthenticated,
+    )
+    if args.user and args.password:
+        srv.httpd.RequestHandlerClass.ds.execute(
+            f"DEFINE USER {args.user} ON ROOT PASSWORD $p ROLES OWNER;",
+            Session.owner(None, None),
+            {"p": args.password},
+        )
+    print(f"Started surrealdb-tpu on {srv.url} (storage: {args.path})", file=sys.stderr)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        srv.shutdown()
+    return 0
+
+
+def _connect(args):
+    from surrealdb_tpu.sdk import Surreal
+
+    db = Surreal(args.endpoint)
+    if args.user and args.password:
+        db.signin(user=args.user, password=args.password)
+    if args.ns or args.db:
+        db.use(args.ns, args.db)
+    return db
+
+
+def _sql(args) -> int:
+    from surrealdb_tpu.sql.value import format_value
+
+    db = _connect(args)
+    print(f"surrealdb-tpu {__version__} — interactive shell (exit with ^D)", file=sys.stderr)
+    while True:
+        try:
+            line = input("> ")
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        if not line.strip():
+            continue
+        try:
+            for resp in db.query(line):
+                status = resp.get("status")
+                body = resp.get("result")
+                if status == "OK":
+                    print(format_value(body, pretty=args.pretty))
+                else:
+                    print(f"ERR: {body}", file=sys.stderr)
+        except Exception as e:
+            print(f"ERR: {e}", file=sys.stderr)
+
+
+def _import(args) -> int:
+    db = _connect(args)
+    with open(args.file) as f:
+        db.import_(f.read())
+    print("import completed", file=sys.stderr)
+    return 0
+
+
+def _export(args) -> int:
+    db = _connect(args)
+    dump = db.export()
+    if args.file == "-":
+        sys.stdout.write(dump)
+    else:
+        with open(args.file, "w") as f:
+            f.write(dump)
+    return 0
+
+
+def _validate(args) -> int:
+    from surrealdb_tpu.syn import parse_query
+    from surrealdb_tpu.err import ParseError
+
+    bad = 0
+    for path in args.files:
+        try:
+            with open(path) as f:
+                parse_query(f.read())
+            print(f"{path}: OK")
+        except ParseError as e:
+            print(f"{path}: {e}", file=sys.stderr)
+            bad += 1
+    return 1 if bad else 0
+
+
+def _isready(args) -> int:
+    import http.client
+    from urllib.parse import urlparse
+
+    u = urlparse(args.endpoint)
+    try:
+        conn = http.client.HTTPConnection(u.hostname, u.port or 8000, timeout=5)
+        conn.request("GET", "/health")
+        ok = conn.getresponse().status == 200
+    except OSError:
+        ok = False
+    print("OK" if ok else "not ready")
+    return 0 if ok else 1

@@ -48,7 +48,8 @@ REGEX_CACHE_SIZE = _env_int("SURREAL_REGEX_CACHE_SIZE", 1_000)
 # TPU device-mirror settings (new — no reference analog; this framework's own knobs)
 TPU_BATCH_MIN_TILE = _env_int("SURREAL_TPU_BATCH_MIN_TILE", 128)
 TPU_VECTOR_DTYPE = os.environ.get("SURREAL_TPU_VECTOR_DTYPE", "bfloat16")
-TPU_KNN_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_KNN_ONDEVICE_THRESHOLD", 64)
+TPU_KNN_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_KNN_ONDEVICE_THRESHOLD", 4096)
+TPU_FT_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_FT_ONDEVICE_THRESHOLD", 4096)
 TPU_DISABLE = _env_bool("SURREAL_TPU_DISABLE", False)
 
 # Changefeeds

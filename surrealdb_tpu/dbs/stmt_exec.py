@@ -155,7 +155,20 @@ def insert_compute(ctx, stm) -> Any:
         else:
             raise TypeError_(f"Cannot INSERT INTO {format_value(tv)}")
 
-    it = Iterator(ctx, stm, "insert")
+    if stm.relation:
+        # the rows themselves carry the data; process_relate must not
+        # re-apply the INSERT payload as a CONTENT clause
+        from surrealdb_tpu.doc.pipeline import _StmView
+
+        stm_view = _StmView(
+            data=None,
+            output=stm.output,
+            ignore=stm.ignore,
+            update=stm.update,
+        )
+        it = Iterator(ctx, stm_view, "insert")
+    else:
+        it = Iterator(ctx, stm, "insert")
     for row in rows:
         row = dict(row)
         rid_v = row.pop("id", None)

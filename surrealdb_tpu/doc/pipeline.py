@@ -591,6 +591,16 @@ def process_relate(
                     f"Cannot create a relation to a non-existent record `{t}`"
                 )
     existing = txn.get_record(ns, db, edge_rid.tb, edge_rid.id)
+    if existing is not None:
+        # INSERT RELATION duplicate handling (reference insert.rs semantics)
+        if getattr(stm, "ignore", False):
+            raise IgnoreError()
+        update = getattr(stm, "update", None)
+        if update is not None:
+            from surrealdb_tpu.sql.statements import Data
+
+            sub = _StmView(data=Data("set", update), output=getattr(stm, "output", None))
+            return process_update(ctx, edge_rid, existing, sub)
     before = copy_value(existing) if existing is not None else None
     current: dict = dict(existing) if existing is not None else {"id": edge_rid}
     if row:

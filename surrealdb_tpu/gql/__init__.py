@@ -1,0 +1,18 @@
+"""GraphQL endpoint (reference: core/src/gql/ — dynamic schema from table
+DEFINEs, gated by SURREAL_EXPERIMENTAL_GRAPHQL). The schema generator and
+query translator land in the GraphQL milestone; until then the endpoint
+reports itself disabled, matching the reference's default."""
+
+from __future__ import annotations
+
+from surrealdb_tpu.err import SurrealError
+
+
+def execute_graphql(ds, session, request: dict):
+    import os
+
+    if os.environ.get("SURREAL_EXPERIMENTAL_GRAPHQL", "").lower() not in ("1", "true"):
+        raise SurrealError("GraphQL is an experimental feature; set SURREAL_EXPERIMENTAL_GRAPHQL=true")
+    from .exec import run_graphql
+
+    return run_graphql(ds, session, request)

@@ -1,0 +1,113 @@
+"""JWT issue/verify for session tokens.
+
+Role of the reference's token machinery (reference: core/src/iam/token.rs,
+verify.rs, jwks.rs). HS256/HS384/HS512 are implemented with stdlib hmac
+(no external jwt dependency); RS/ES/PS algorithms and JWKS fetch are gated
+until an asymmetric-crypto backend is available.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from typing import Any, Dict, Optional
+
+from surrealdb_tpu.err import ExpiredTokenError, InvalidAuthError
+
+_HS = {"HS256": hashlib.sha256, "HS384": hashlib.sha384, "HS512": hashlib.sha512}
+
+
+def _b64url(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).decode().rstrip("=")
+
+
+def _unb64url(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def issue_token(claims: Dict[str, Any], key: str, alg: str = "HS512") -> str:
+    digest = _HS.get(alg.upper())
+    if digest is None:
+        raise InvalidAuthError(f"Unsupported token algorithm {alg}")
+    header = {"alg": alg.upper(), "typ": "JWT"}
+    h = _b64url(json.dumps(header, separators=(",", ":")).encode())
+    p = _b64url(json.dumps(claims, separators=(",", ":")).encode())
+    sig = hmac.new(key.encode(), f"{h}.{p}".encode(), digest).digest()
+    return f"{h}.{p}.{_b64url(sig)}"
+
+
+def verify_token(token: str, key: str, alg: Optional[str] = None) -> Dict[str, Any]:
+    try:
+        h, p, s = token.split(".")
+        header = json.loads(_unb64url(h))
+        claims = json.loads(_unb64url(p))
+    except (ValueError, json.JSONDecodeError) as e:
+        raise InvalidAuthError("Invalid token format") from e
+    a = header.get("alg", "HS512").upper()
+    if alg is not None and a != alg.upper():
+        raise InvalidAuthError("Token algorithm mismatch")
+    digest = _HS.get(a)
+    if digest is None:
+        raise InvalidAuthError(f"Unsupported token algorithm {a}")
+    expect = hmac.new(key.encode(), f"{h}.{p}".encode(), digest).digest()
+    if not hmac.compare_digest(expect, _unb64url(s)):
+        raise InvalidAuthError("Invalid token signature")
+    exp = claims.get("exp")
+    if exp is not None and time.time() > float(exp):
+        raise ExpiredTokenError()
+    return claims
+
+
+def authenticate(ds, session, token: str) -> None:
+    """AUTHENTICATE: restore a session from a token issued by signin/signup
+    (reference: core/src/iam/verify.rs token paths)."""
+    from surrealdb_tpu.dbs.session import Auth
+    from surrealdb_tpu.sql.value import Thing
+
+    # decode unverified to find the key-holding definition
+    try:
+        _, p, _ = token.split(".")
+        claims = json.loads(_unb64url(p))
+    except (ValueError, json.JSONDecodeError) as e:
+        raise InvalidAuthError("Invalid token format") from e
+
+    ns, db, ac = claims.get("NS"), claims.get("DB"), claims.get("AC")
+    txn = ds.transaction(False)
+    try:
+        if ac:
+            level = (ns, db) if db else ((ns,) if ns else ())
+            acc = txn.get_access(tuple(x for x in level if x), ac)
+            if acc is None or not acc.get("jwt_key"):
+                raise InvalidAuthError("Unknown access method")
+            claims = verify_token(token, acc["jwt_key"], acc.get("jwt_alg"))
+            rid = claims.get("ID")
+            session.ns, session.db = ns, db
+            session.auth = Auth(
+                "record", ns=ns, db=db, access=ac,
+                rid=Thing.parse(rid) if isinstance(rid, str) else rid,
+            )
+            session.token = claims
+            return
+        # user tokens are signed with the stored passhash as key material
+        user = claims.get("ID")
+        if db:
+            u = txn.get_db_user(ns, db, user)
+            level = "db"
+        elif ns:
+            u = txn.get_ns_user(ns, user)
+            level = "ns"
+        else:
+            u = txn.get_root_user(user)
+            level = "root"
+        if u is None:
+            raise InvalidAuthError("Unknown user")
+        claims = verify_token(token, u["hash"] or "")
+        session.ns = ns or session.ns
+        session.db = db or session.db
+        session.auth = Auth(level, ns=ns, db=db, user=user, roles=u.get("roles", []))
+        session.token = claims
+    finally:
+        txn.cancel()

@@ -1,0 +1,278 @@
+"""Persistent inverted index + device-batched BM25 search.
+
+Role of the reference's FtIndex (reference: core/src/idx/ft/ — terms.rs
+dictionary, postings.rs, doclength.rs, termdocs.rs, offsets.rs,
+docids.rs). TPU-first redesign: the KV layout is flat ordered keys rather
+than B-trees (the host store is already ordered), and scoring happens as one
+batched BM25 kernel over the whole candidate set (ops/bm25.py) instead of a
+per-document loop.
+
+Keyspace (under the index's state prefix `+{ix}!m`):
+    s                      stats {dc, tl, nt, nd}
+    t{term}                term meta {id, df}
+    p{tid}{did}            posting {tf, os: [[s,e],...]} (offsets if highlights)
+    l{did}                 doc length
+    d{rid}                 rid -> doc id
+    r{did}                 doc id -> rid
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from surrealdb_tpu import key as keys
+from surrealdb_tpu.key.encode import enc_str, enc_u64, dec_u64, enc_value_key, prefix_end
+from surrealdb_tpu.sql.value import Thing, is_nullish
+from surrealdb_tpu.utils.ser import pack, unpack
+
+from .ft_analyzer import Analyzer, analyzer_for
+
+
+def _tf(tokens) -> Dict[str, Tuple[int, List[List[int]]]]:
+    """Aggregate analyzed tokens into term -> (frequency, offsets)."""
+    out: Dict[str, Tuple[int, List[List[int]]]] = {}
+    for text, s, e in tokens:
+        count, offs = out.get(text, (0, []))
+        out[text] = (count + 1, offs + [[s, e]])
+    return out
+
+
+class FtIndex:
+    def __init__(self, tb: str, ix: dict):
+        self.tb = tb
+        self.ix = ix
+        self.name = ix["name"]
+        self.highlights = bool(ix["index"].get("highlights"))
+
+    @staticmethod
+    def for_index(ctx, ix: dict) -> "FtIndex":
+        return FtIndex(ix["table"], ix)
+
+    def analyzer(self, ctx) -> Analyzer:
+        return analyzer_for(ctx, self.ix["index"].get("analyzer"))
+
+    # ------------------------------------------------------------ keys
+    def _k(self, ctx, sub: bytes) -> bytes:
+        ns, db = ctx.ns_db()
+        return keys.index_state(ns, db, self.tb, self.name, sub)
+
+    def _stats(self, ctx) -> dict:
+        raw = ctx.txn().get(self._k(ctx, b"s"))
+        return unpack(raw) if raw else {"dc": 0, "tl": 0, "nt": 0, "nd": 0}
+
+    def _put_stats(self, ctx, st: dict) -> None:
+        ctx.txn().set(self._k(ctx, b"s"), pack(st))
+
+    # ------------------------------------------------------------ doc ids
+    def _doc_id(self, ctx, rid: Thing, st: dict, create: bool) -> Optional[int]:
+        txn = ctx.txn()
+        k = self._k(ctx, b"d" + enc_value_key(rid))
+        raw = txn.get(k)
+        if raw is not None:
+            return unpack(raw)
+        if not create:
+            return None
+        did = st["nd"]
+        st["nd"] += 1
+        txn.set(k, pack(did))
+        txn.set(self._k(ctx, b"r" + enc_u64(did)), pack(rid))
+        return did
+
+    def _rid_of(self, ctx, did: int) -> Optional[Thing]:
+        raw = ctx.txn().get(self._k(ctx, b"r" + enc_u64(did)))
+        return unpack(raw) if raw else None
+
+    # ------------------------------------------------------------ terms
+    def _term(self, ctx, term: str) -> Optional[dict]:
+        raw = ctx.txn().get(self._k(ctx, b"t" + enc_str(term)))
+        return unpack(raw) if raw else None
+
+    def _put_term(self, ctx, term: str, meta: dict) -> None:
+        ctx.txn().set(self._k(ctx, b"t" + enc_str(term)), pack(meta))
+
+    # ------------------------------------------------------------ write side
+    def index_document(self, ctx, rid: Thing, old_vals, new_vals) -> None:
+        st = self._stats(ctx)
+        txn = ctx.txn()
+        az = self.analyzer(ctx)
+
+        old_tokens = self._tokens_of(az, old_vals)
+        new_tokens = self._tokens_of(az, new_vals)
+        if old_tokens is None and new_tokens is None:
+            return
+
+        did = self._doc_id(ctx, rid, st, create=new_tokens is not None)
+        if did is None:
+            return
+
+        # remove the old posting set
+        if old_tokens is not None:
+            old_tf = _tf(old_tokens)
+            for term in old_tf:
+                meta = self._term(ctx, term)
+                if meta is None:
+                    continue
+                txn.delete(self._k(ctx, b"p" + enc_u64(meta["id"]) + enc_u64(did)))
+                meta["df"] -= 1
+                self._put_term(ctx, term, meta)
+            lraw = txn.get(self._k(ctx, b"l" + enc_u64(did)))
+            if lraw is not None:
+                st["tl"] -= unpack(lraw)
+                txn.delete(self._k(ctx, b"l" + enc_u64(did)))
+            st["dc"] -= 1
+
+        # write the new posting set
+        if new_tokens is not None:
+            tfs = _tf(new_tokens)
+            for term, (count, offs) in tfs.items():
+                meta = self._term(ctx, term)
+                if meta is None:
+                    meta = {"id": st["nt"], "df": 0}
+                    st["nt"] += 1
+                meta["df"] += 1
+                self._put_term(ctx, term, meta)
+                posting: Dict[str, Any] = {"tf": count}
+                if self.highlights:
+                    posting["os"] = offs
+                txn.set(
+                    self._k(ctx, b"p" + enc_u64(meta["id"]) + enc_u64(did)),
+                    pack(posting),
+                )
+            length = len(new_tokens)
+            txn.set(self._k(ctx, b"l" + enc_u64(did)), pack(length))
+            st["tl"] += length
+            st["dc"] += 1
+        else:
+            # document no longer has the field: drop the id mapping
+            txn.delete(self._k(ctx, b"d" + enc_value_key(rid)))
+            txn.delete(self._k(ctx, b"r" + enc_u64(did)))
+
+        self._put_stats(ctx, st)
+
+    def _tokens_of(self, az: Analyzer, vals) -> Optional[list]:
+        if vals is None:
+            return None
+        out = []
+        found = False
+        for v in vals:
+            items = v if isinstance(v, list) else [v]
+            for item in items:
+                if isinstance(item, str):
+                    found = True
+                    out.extend(az.analyze(item))
+        return out if found else None
+
+    # ------------------------------------------------------------ search
+    def search(self, ctx, query: str) -> "FtResults":
+        """AND-match all analyzed query terms, score the candidate set with
+        the batched BM25 kernel."""
+        az = self.analyzer(ctx)
+        terms = az.terms(query)
+        txn = ctx.txn()
+        st = self._stats(ctx)
+
+        term_metas = []
+        for t in dict.fromkeys(terms):
+            m = self._term(ctx, t)
+            if m is None or m["df"] <= 0:
+                return FtResults(self, {}, terms)  # a missing term → no matches
+            term_metas.append((t, m))
+        if not term_metas:
+            return FtResults(self, {}, terms)
+
+        # postings scan per term, rarest first for cheap intersection
+        term_metas.sort(key=lambda tm: tm[1]["df"])
+        candidate: Optional[Dict[int, List[int]]] = None  # did -> [tf per term]
+        for pos, (t, meta) in enumerate(term_metas):
+            pre = self._k(ctx, b"p" + enc_u64(meta["id"]))
+            found: Dict[int, dict] = {}
+            for k, raw in txn.scan(pre, prefix_end(pre)):
+                did, _ = dec_u64(k, len(pre))
+                found[did] = unpack(raw)
+            if candidate is None:
+                candidate = {did: [p["tf"]] for did, p in found.items()}
+            else:
+                nxt = {}
+                for did, tfs in candidate.items():
+                    if did in found:
+                        nxt[did] = tfs + [found[did]["tf"]]
+                candidate = nxt
+            if not candidate:
+                return FtResults(self, {}, terms)
+
+        dids = list(candidate.keys())
+        tf_mat = np.asarray([candidate[d] for d in dids], dtype=np.float32)
+        df = np.asarray([m["df"] for _, m in term_metas], dtype=np.float32)
+        lens = np.asarray(
+            [
+                unpack(txn.get(self._k(ctx, b"l" + enc_u64(d))) or pack(0))
+                for d in dids
+            ],
+            dtype=np.float32,
+        )
+
+        k1 = float(self.ix["index"].get("k1", 1.2))
+        b = float(self.ix["index"].get("b", 0.75))
+        from surrealdb_tpu import cnf
+
+        if len(dids) < cnf.TPU_FT_ONDEVICE_THRESHOLD:
+            # tiny candidate sets score on host — a device dispatch (and
+            # worse, a first-compile over a tunneled chip) costs far more
+            from surrealdb_tpu.ops.bm25 import bm25_scores_host
+
+            scores = bm25_scores_host(tf_mat, df, lens, st["dc"], st["tl"], k1, b)
+        else:
+            from surrealdb_tpu.ops.bm25 import bm25_scores
+
+            scores = np.asarray(
+                bm25_scores(
+                    tf_mat, df, lens,
+                    np.float32(st["dc"]), np.float32(st["tl"]), k1, b,
+                )
+            )
+        by_rid: Dict[Tuple[str, str], Tuple[Thing, float]] = {}
+        for did, s in zip(dids, scores):
+            rid = self._rid_of(ctx, did)
+            if rid is not None:
+                by_rid[(rid.tb, repr(rid.id))] = (rid, float(s))
+        return FtResults(self, by_rid, terms)
+
+    # ------------------------------------------------------------ highlight
+    def offsets_for(self, ctx, rid: Thing, terms: List[str]) -> List[Tuple[int, int]]:
+        if not self.highlights:
+            return []
+        txn = ctx.txn()
+        raw = txn.get(self._k(ctx, b"d" + enc_value_key(rid)))
+        if raw is None:
+            return []
+        did = unpack(raw)
+        offs: List[Tuple[int, int]] = []
+        for t in dict.fromkeys(terms):
+            meta = self._term(ctx, t)
+            if meta is None:
+                continue
+            p = txn.get(self._k(ctx, b"p" + enc_u64(meta["id"]) + enc_u64(did)))
+            if p is not None:
+                offs.extend((s, e) for s, e in unpack(p).get("os", []))
+        return sorted(set(offs))
+
+
+class FtResults:
+    """Matched doc set + scores for one MATCHES evaluation."""
+
+    def __init__(self, index: FtIndex, by_rid: dict, terms: List[str]):
+        self.index = index
+        self.by_rid = by_rid  # (tb, repr(id)) -> (Thing, score)
+        self.terms = terms
+
+    def __iter__(self):
+        return iter(self.by_rid.values())
+
+    def contains(self, rid: Thing) -> bool:
+        return (rid.tb, repr(rid.id)) in self.by_rid
+
+    def score(self, rid: Thing) -> Optional[float]:
+        v = self.by_rid.get((rid.tb, repr(rid.id)))
+        return v[1] if v else None

@@ -1,25 +1,19 @@
-"""MATCHES (@@) query plan.
+"""MATCHES (@@) query plan over the inverted index.
 
-Role of the reference's MatchesThingIterator + per-doc matches() check
-(reference: core/src/idx/planner/iterators.rs:849-904, executor.rs:878-937).
-Until the inverted-index milestone lands this executes as a streamed scan
-with naive whitespace/lowercase analysis; the plan object already implements
-the QueryExecutor protocol (matches / score / highlight hooks) so the
-operator wiring is final.
+Role of the reference's MatchesThingIterator + per-doc matches()/score()/
+highlight() hooks (reference: core/src/idx/planner/iterators.rs:849-904,
+executor.rs:878-1102, fnc/search.rs). The plan object implements the
+QueryExecutor protocol consulted by the MATCHES operator and the search::
+functions during document processing.
 """
 
 from __future__ import annotations
 
-import re
 from typing import Any, Dict, List, Optional
 
-from surrealdb_tpu.sql.value import Thing
+from surrealdb_tpu.sql.value import NONE, Thing
 
-_TOKEN = re.compile(r"\w+", re.UNICODE)
-
-
-def _analyze(text: str) -> List[str]:
-    return [t.lower() for t in _TOKEN.findall(text)]
+from .ft_index import FtIndex
 
 
 class MatchesPlan:
@@ -28,8 +22,8 @@ class MatchesPlan:
         self.ix = ix
         self.op = op
         self.query = query if isinstance(query, str) else str(query)
-        self.terms = _analyze(self.query)
-        self._matched: Dict[Any, float] = {}
+        self.ft = FtIndex.for_index(None, ix)
+        self.results = None  # FtResults after iterate()
 
     def explain(self) -> dict:
         return {
@@ -41,29 +35,16 @@ class MatchesPlan:
     # ------------------------------------------------------------ iteration
     def iterate(self, ctx):
         ctx.qe = self
-        from surrealdb_tpu.dbs.iterator import scan_table
-
-        field = self.op.l
-        for rid, doc in scan_table(ctx, self.tb):
-            with ctx.with_doc_value(doc, rid=rid) as c:
-                v = field.compute(c)
-            texts = v if isinstance(v, list) else [v]
-            toks: List[str] = []
-            for t in texts:
-                if isinstance(t, str):
-                    toks.extend(_analyze(t))
-            if toks and all(t in toks for t in self.terms):
-                score = float(sum(toks.count(t) for t in self.terms))
-                self._matched[(rid.tb, repr(rid.id))] = score
-                yield rid, doc, {"score": score}
+        self.results = self.ft.search(ctx, self.query)
+        ranked = sorted(self.results, key=lambda rs: -rs[1])
+        for rid, score in ranked:
+            yield rid, None, {"score": score}
 
     # ------------------------------------------------------------ executor protocol
-    def _key(self, rid: Thing):
-        return (rid.tb, repr(rid.id))
-
     def matches(self, ctx, doc, op) -> bool:
-        rid = doc.rid
-        return rid is not None and self._key(rid) in self._matched
+        if self.results is None or doc.rid is None:
+            return False
+        return self.results.contains(doc.rid)
 
     def knn(self, ctx, doc, op) -> bool:
         return False
@@ -72,7 +53,35 @@ class MatchesPlan:
         return None
 
     def score(self, ctx, doc, ref=None) -> Optional[float]:
-        rid = doc.rid
-        if rid is None:
+        if self.results is None or doc.rid is None:
             return None
-        return self._matched.get(self._key(rid))
+        return self.results.score(doc.rid)
+
+    def highlight(self, ctx, doc, prefix: str, suffix: str, ref=None):
+        if self.results is None or doc.rid is None:
+            return NONE
+        offs = self.ft.offsets_for(ctx, doc.rid, self.results.terms)
+        if not offs:
+            return NONE
+        # apply to the indexed field's current value
+        field = self.op.l
+        with ctx.with_doc_value(doc.current, rid=doc.rid) as c:
+            text = field.compute(c)
+        if not isinstance(text, str):
+            return NONE
+        out = []
+        last = 0
+        for s, e in offs:
+            if s < last or e > len(text):
+                continue
+            out.append(text[last:s])
+            out.append(prefix + text[s:e] + suffix)
+            last = e
+        out.append(text[last:])
+        return "".join(out)
+
+    def offsets(self, ctx, doc, ref=None):
+        if self.results is None or doc.rid is None:
+            return NONE
+        offs = self.ft.offsets_for(ctx, doc.rid, self.results.terms)
+        return {"0": [{"s": s, "e": e} for s, e in offs]} if offs else NONE

@@ -1,0 +1,185 @@
+"""Device-resident CSR graph mirror + batched frontier expansion.
+
+Role of the reference's per-record edge-prefix scans (reference:
+core/src/dbs/processor.rs:610-701 collect_edges, sql/value/get.rs:404-446 —
+hop N over R records ⇒ R separate KV range scans) re-designed TPU-first
+(SURVEY §3.5): the edge keyspace of a table is packed once into CSR arrays
+(indptr/indices) mirrored on device by generation; a multi-hop traversal is
+then H fixed-shape gather kernels with on-device dedup instead of R₁+R₂+…
+pointer chases.
+
+The mirror covers one (table, direction) pair and maps record ids to dense
+ints. `->edge->target` two-segment hops compose: node --OUT--> edge-record
+--OUT--> node, i.e. one logical hop = 2 CSR hops (endpoint→edge, edge→endpoint),
+which the builder pre-composes into a node→node CSR per edge table.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from surrealdb_tpu import key as keys
+from surrealdb_tpu.key.encode import prefix_end
+from surrealdb_tpu.sql.value import Thing
+
+
+class CsrGraphMirror:
+    """node→node adjacency for one (src_table, edge_table, dir) triple."""
+
+    def __init__(self, src_tb: str, edge_tb: str, direction: bytes):
+        self.src_tb = src_tb
+        self.edge_tb = edge_tb
+        self.direction = direction
+        self.generation = -1
+        self._lock = threading.Lock()
+        # id maps
+        self.id_of: Dict[Tuple[str, str], int] = {}  # (tb, repr(id)) -> int
+        self.node_of: List[Thing] = []
+        self.indptr: Optional[np.ndarray] = None
+        self.indices: Optional[np.ndarray] = None
+        self.edge_of: Optional[np.ndarray] = None  # edge-record int per slot
+        self.max_degree = 0
+
+    def _intern(self, t: Thing) -> int:
+        k = (t.tb, repr(t.id))
+        i = self.id_of.get(k)
+        if i is None:
+            i = len(self.node_of)
+            self.id_of[k] = i
+            self.node_of.append(t)
+        return i
+
+    def lookup(self, t: Thing) -> Optional[int]:
+        return self.id_of.get((t.tb, repr(t.id)))
+
+    def refresh(self, ctx) -> None:
+        """Rebuild from the KV edge pointers. One scan over the source
+        table's `~` keyspace composes node→edge→node into node→node."""
+        ns, db = ctx.ns_db()
+        txn = ctx.txn()
+        with self._lock:
+            self.id_of.clear()
+            self.node_of = []
+            adj: Dict[int, List[Tuple[int, int]]] = {}
+
+            # pass 1: node --dir--> edge-record pointers on the source table
+            pre = keys.graph_prefix(ns, db, self.src_tb)
+            node_edges: List[Tuple[int, Thing]] = []
+            for chunk in txn.batch(pre, prefix_end(pre), 2000):
+                for k, _ in chunk:
+                    id_, d, ft, fk = keys.decode_graph(k, ns, db, self.src_tb)
+                    if d != self.direction or ft != self.edge_tb:
+                        continue
+                    src = self._intern(Thing(self.src_tb, id_))
+                    if isinstance(fk, Thing):
+                        node_edges.append((src, fk))
+
+            # pass 2: edge-record --same dir--> endpoint
+            for src, edge in node_edges:
+                e_int = self._intern(edge)
+                pre2 = keys.graph_prefix(
+                    ns, db, edge.tb, edge.id, self.direction
+                )
+                for k2 in txn.keys(pre2, prefix_end(pre2)):
+                    _, _, _, fk2 = keys.decode_graph(k2, ns, db, edge.tb)
+                    if isinstance(fk2, Thing):
+                        dst = self._intern(fk2)
+                        adj.setdefault(src, []).append((dst, e_int))
+
+            n = len(self.node_of)
+            indptr = np.zeros(n + 1, dtype=np.int32)
+            for src, lst in adj.items():
+                indptr[src + 1] = len(lst)
+            self.max_degree = int(indptr.max()) if n else 0
+            np.cumsum(indptr, out=indptr)
+            indices = np.zeros(max(int(indptr[-1]), 1), dtype=np.int32)
+            edge_of = np.zeros_like(indices)
+            fill = indptr[:-1].copy()
+            for src, lst in adj.items():
+                for dst, e_int in lst:
+                    indices[fill[src]] = dst
+                    edge_of[fill[src]] = e_int
+                    fill[src] += 1
+            self.indptr = indptr
+            self.indices = indices
+            self.edge_of = edge_of
+
+    # ------------------------------------------------------------ traversal
+    def hop_batch(self, srcs: List[Thing], want_edges: bool = False) -> List[List[Thing]]:
+        """Expand a batch of source nodes one logical hop. Returns the
+        neighbor list per source (edge records instead when want_edges)."""
+        if self.indptr is None:
+            return [[] for _ in srcs]
+        out: List[List[Thing]] = []
+        for t in srcs:
+            i = self.lookup(t)
+            if i is None or i >= len(self.indptr) - 1:
+                out.append([])
+                continue
+            lo, hi = int(self.indptr[i]), int(self.indptr[i + 1])
+            table = self.edge_of if want_edges else self.indices
+            out.append([self.node_of[int(j)] for j in table[lo:hi]])
+        return out
+
+    def multi_hop_device(self, start: List[Thing], hops: int) -> List[Thing]:
+        """H-hop frontier expansion fully on device (bench/north-star path):
+        fixed-shape gathers + dense-bitmap dedup per hop."""
+        import jax.numpy as jnp
+        from surrealdb_tpu.parallel.mesh import dedup_frontier
+        import jax
+
+        if self.indptr is None:
+            return []
+        n = len(self.node_of)
+        ptr = jnp.asarray(self.indptr)
+        idx = jnp.asarray(self.indices)
+        starts = [self.lookup(t) for t in start]
+        starts = [s for s in starts if s is not None]
+        if not starts:
+            return []
+        frontier = jnp.asarray(np.array(starts, dtype=np.int32))
+        mask = jnp.ones_like(frontier, dtype=bool)
+        md = max(self.max_degree, 1)
+
+        @jax.jit
+        def one_hop(fr, fm):
+            s = ptr[fr]
+            degs = ptr[fr + 1] - s
+            offs = jnp.arange(md)[None, :]
+            take = jnp.clip(s[:, None] + offs, 0, idx.shape[0] - 1)
+            valid = (offs < degs[:, None]) & fm[:, None]
+            nb = idx[take].reshape(-1)
+            return nb, valid.reshape(-1)
+
+        for _ in range(hops):
+            nodes, m = one_hop(frontier, mask)
+            frontier, mask = dedup_frontier(nodes, m, n)
+        out_idx = np.asarray(frontier)[np.asarray(mask)]
+        return [self.node_of[int(i)] for i in out_idx]
+
+
+class GraphMirrors:
+    """Per-datastore registry of CSR mirrors keyed by
+    (ns, db, src_tb, edge_tb, dir)."""
+
+    def __init__(self):
+        self._m: Dict[tuple, CsrGraphMirror] = {}
+        self._lock = threading.Lock()
+
+    def get(self, ctx, src_tb: str, edge_tb: str, direction: bytes) -> CsrGraphMirror:
+        ns, db = ctx.ns_db()
+        k = (ns, db, src_tb, edge_tb, bytes(direction))
+        with self._lock:
+            m = self._m.get(k)
+            if m is None:
+                m = CsrGraphMirror(src_tb, edge_tb, direction)
+                self._m[k] = m
+        return m
+
+    def invalidate(self) -> None:
+        with self._lock:
+            for m in self._m.values():
+                m.generation = -1

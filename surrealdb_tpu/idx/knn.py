@@ -150,7 +150,10 @@ class KnnPlan(_KnnExecutorMixin):
         metric = self.ix["index"].get("dist", "euclidean")
         k = min(self.k, len(mirror.rids))
         q = np.asarray([self.target], dtype=mirror.matrix.dtype)
-        dists, idxs = D.knn_search(q, mirror.matrix, mirror.mask, metric, k)
+        if len(mirror.rids) < cnf.TPU_KNN_ONDEVICE_THRESHOLD:
+            dists, idxs = D.knn_search_host(q, mirror.matrix[: len(mirror.rids)], metric, k)
+        else:
+            dists, idxs = D.knn_search(q, mirror.matrix, mirror.mask, metric, k)
         dists = np.asarray(dists)[0]
         idxs = np.asarray(idxs)[0]
         out = []
@@ -209,10 +212,13 @@ class BruteForceKnnPlan(_KnnExecutorMixin):
             docs[(rid.tb, repr(rid.id))] = doc
         if not rows:
             return
-        mat, mask = D.pad_rows(np.asarray(rows, dtype=np.float32), cnf.TPU_BATCH_MIN_TILE)
         k = min(self.k, len(rids))
         q = np.asarray([self.target], dtype=np.float32)
-        dists, idxs = D.knn_search(q, mat, mask, self.metric, k)
+        if len(rids) < cnf.TPU_KNN_ONDEVICE_THRESHOLD:
+            dists, idxs = D.knn_search_host(q, np.asarray(rows, dtype=np.float32), self.metric, k)
+        else:
+            mat, mask = D.pad_rows(np.asarray(rows, dtype=np.float32), cnf.TPU_BATCH_MIN_TILE)
+            dists, idxs = D.knn_search(q, mat, mask, self.metric, k)
         dists = np.asarray(dists)[0]
         idxs = np.asarray(idxs)[0]
         for d, i in zip(dists, idxs):

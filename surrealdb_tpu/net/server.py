@@ -1,0 +1,376 @@
+"""HTTP + WebSocket server.
+
+Role of the reference's axum net layer + WS RPC actor (reference:
+src/net/mod.rs:162-183 routes, src/rpc/connection.rs:80-417): routes /sql,
+/rpc (HTTP msgpack POST and WS upgrade), /key/{tb}[/{id}] REST CRUD,
+/signin, /signup, /health, /version, /export, /import. Sessions: WS
+connections hold a stateful RpcContext; HTTP requests authenticate per
+request from headers.
+
+Wire formats: JSON (default, values via to_json_value) and msgpack (the
+storage codec doubling as full-fidelity wire format) — content negotiation
+via Content-Type/Accept (reference has 5 formats, core/src/rpc/format/).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import urlparse
+
+from surrealdb_tpu import __version__
+from surrealdb_tpu.dbs.session import Auth, Session
+from surrealdb_tpu.err import InvalidAuthError, SurrealError
+from surrealdb_tpu.rpc.method import RpcContext
+from surrealdb_tpu.sql.value import to_json_value
+from surrealdb_tpu.utils.ser import pack, unpack
+
+from . import ws as wsproto
+
+
+class SurrealHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = f"surrealdb-tpu/{__version__}"
+    ds = None  # set by serve()
+    auth_enabled = True
+
+    # ------------------------------------------------------------ plumbing
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def parse_request(self):
+        # one handler instance serves many keep-alive requests
+        self.__dict__.pop("_cached_body", None)
+        return super().parse_request()
+
+    def _body(self) -> bytes:
+        if not hasattr(self, "_cached_body"):
+            n = int(self.headers.get("Content-Length") or 0)
+            self._cached_body = self.rfile.read(n) if n else b""
+        return self._cached_body
+
+    def _send(self, code: int, payload: Any, content_type: str = "application/json") -> None:
+        # drain any unread request body first, or the next keep-alive request
+        # parses mid-stream
+        self._body()
+        if content_type == "application/json":
+            body = json.dumps(to_json_value(payload)).encode()
+        elif content_type == "application/msgpack":
+            body = pack(payload)
+        else:
+            body = payload if isinstance(payload, bytes) else str(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _session(self) -> Session:
+        """Per-request session from headers (HTTP is stateless)."""
+        ns = self.headers.get("surreal-ns") or self.headers.get("NS")
+        db = self.headers.get("surreal-db") or self.headers.get("DB")
+        sess = Session.anonymous(ns, db)
+        auth_header = self.headers.get("Authorization") or ""
+        if auth_header.startswith("Basic "):
+            import base64
+
+            try:
+                user, _, pwd = base64.b64decode(auth_header[6:]).decode().partition(":")
+            except Exception as e:
+                raise InvalidAuthError() from e
+            from surrealdb_tpu.iam.signin import basic_signin
+
+            basic_signin(self.ds, sess, user, pwd, ns, db)
+        elif auth_header.startswith("Bearer "):
+            from surrealdb_tpu.iam.token import authenticate
+
+            authenticate(self.ds, sess, auth_header[7:])
+        elif not self.auth_enabled:
+            sess = Session.owner(ns, db)
+        sess.ns = sess.ns or ns
+        sess.db = sess.db or db
+        return sess
+
+    def _authorized_session(self) -> Session:
+        """Session for a data-access route: anonymous is rejected when auth
+        is enabled (reference: guest access capability, default deny)."""
+        sess = self._session()
+        if self.auth_enabled and sess.auth.is_anon():
+            raise InvalidAuthError()
+        return sess
+
+    # ------------------------------------------------------------ routes
+    def do_GET(self):
+        path = urlparse(self.path).path
+        if path == "/health":
+            return self._send(200, {"status": "ok"})
+        if path == "/version":
+            return self._send(200, f"surrealdb-tpu-{__version__}", "text/plain")
+        if path == "/rpc" and (self.headers.get("Upgrade") or "").lower() == "websocket":
+            return self._ws_upgrade()
+        if path == "/export":
+            try:
+                sess = self._authorized_session()
+                from surrealdb_tpu.kvs.export import export_database
+
+                return self._send(200, export_database(self.ds, sess), "text/plain")
+            except SurrealError as e:
+                return self._send(401, {"error": str(e)})
+        if path.startswith("/key/"):
+            return self._key_route("GET")
+        return self._send(404, {"error": "not found"})
+
+    def do_POST(self):
+        path = urlparse(self.path).path
+        if path == "/sql":
+            return self._sql()
+        if path == "/rpc":
+            return self._rpc_http()
+        if path == "/signin":
+            return self._auth_route("signin")
+        if path == "/signup":
+            return self._auth_route("signup")
+        if path == "/import":
+            try:
+                sess = self._authorized_session()
+                out = self.ds.execute(self._body().decode(), sess)
+                return self._send(200, out)
+            except InvalidAuthError as e:
+                return self._send(401, {"error": str(e)})
+            except SurrealError as e:
+                return self._send(400, {"error": str(e)})
+        if path.startswith("/key/"):
+            return self._key_route("POST")
+        return self._send(404, {"error": "not found"})
+
+    def do_PUT(self):
+        if urlparse(self.path).path.startswith("/key/"):
+            return self._key_route("PUT")
+        return self._send(404, {"error": "not found"})
+
+    def do_PATCH(self):
+        if urlparse(self.path).path.startswith("/key/"):
+            return self._key_route("PATCH")
+        return self._send(404, {"error": "not found"})
+
+    def do_DELETE(self):
+        if urlparse(self.path).path.startswith("/key/"):
+            return self._key_route("DELETE")
+        return self._send(404, {"error": "not found"})
+
+    # ------------------------------------------------------------ handlers
+    def _sql(self):
+        try:
+            sess = self._authorized_session()
+        except SurrealError as e:
+            return self._send(401, {"error": str(e)})
+        text = self._body().decode()
+        try:
+            out = self.ds.execute(text, sess)
+        except SurrealError as e:
+            return self._send(400, {"error": str(e)})
+        return self._send(200, out)
+
+    def _auth_route(self, kind: str):
+        try:
+            creds = json.loads(self._body() or b"{}")
+        except json.JSONDecodeError:
+            return self._send(400, {"error": "invalid JSON"})
+        sess = Session.anonymous()
+        try:
+            if kind == "signin":
+                from surrealdb_tpu.iam.signin import signin
+
+                token = signin(self.ds, sess, creds)
+            else:
+                from surrealdb_tpu.iam.signup import signup
+
+                token = signup(self.ds, sess, creds)
+            return self._send(200, {"code": 200, "details": "Authentication succeeded", "token": token})
+        except SurrealError as e:
+            return self._send(401, {"code": 401, "details": str(e)})
+
+    def _key_route(self, verb: str):
+        """REST /key/{tb}[/{id}] (reference: src/net/key.rs)."""
+        from urllib.parse import unquote
+
+        from surrealdb_tpu.sql.value import Thing, escape_ident
+
+        parts = urlparse(self.path).path.split("/")[2:]
+        tb = unquote(parts[0]) if parts else None
+        rid = unquote(parts[1]) if len(parts) > 1 else None
+        if not tb:
+            return self._send(400, {"error": "missing table"})
+        try:
+            sess = self._authorized_session()
+        except SurrealError as e:
+            return self._send(401, {"error": str(e)})
+        # escape path segments — they are identifiers, not SurrealQL
+        if rid is not None and rid.lstrip("-").isdigit():
+            rid = int(rid)
+        target = repr(Thing(tb, rid)) if rid is not None else escape_ident(tb)
+        body = self._body()
+        try:
+            data = json.loads(body) if body else None
+        except json.JSONDecodeError:
+            return self._send(400, {"error": "invalid JSON body"})
+        vars = {"_data": data}
+        q = {
+            "GET": f"SELECT * FROM {target}",
+            "POST": f"CREATE {target} CONTENT $_data",
+            "PUT": f"UPSERT {target} CONTENT $_data",
+            "PATCH": f"UPSERT {target} MERGE $_data",
+            "DELETE": f"DELETE {target} RETURN BEFORE",
+        }[verb]
+        try:
+            out = self.ds.execute(q, sess, vars if data is not None else None)
+        except SurrealError as e:
+            return self._send(400, {"error": str(e)})
+        return self._send(200, out)
+
+    def _rpc_http(self):
+        ct = (self.headers.get("Content-Type") or "application/json").split(";")[0]
+        body = self._body()
+        try:
+            req = unpack(body) if ct == "application/msgpack" else json.loads(body)
+        except Exception:
+            return self._send(400, {"error": "invalid request body"})
+        try:
+            sess = self._session()
+        except SurrealError as e:
+            return self._send(401, {"error": str(e)})
+        ctx = RpcContext(self.ds, sess)
+        rid = req.get("id")
+        try:
+            result = ctx.execute(req.get("method", ""), req.get("params") or [])
+            resp = {"id": rid, "result": result}
+        except SurrealError as e:
+            resp = {"id": rid, "error": {"code": -32000, "message": str(e)}}
+        return self._send(200, resp, ct)
+
+    # ------------------------------------------------------------ websocket
+    def _ws_upgrade(self):
+        key = self.headers.get("Sec-WebSocket-Key")
+        if not key:
+            return self._send(400, {"error": "bad websocket request"})
+        self.send_response(101, "Switching Protocols")
+        self.send_header("Upgrade", "websocket")
+        self.send_header("Connection", "Upgrade")
+        self.send_header("Sec-WebSocket-Accept", wsproto.accept_key(key))
+        self.end_headers()
+        self.wfile.flush()
+
+        sock = self.connection
+        sess = Session.anonymous()
+        sess.rt = True
+        if not self.auth_enabled:
+            sess = Session.owner(None, None)
+            sess.ns = sess.db = None
+        ctx = RpcContext(self.ds, sess)
+        send_lock = threading.Lock()
+        alive = {"v": True}
+
+        # live-notification pump: drain ONLY this connection's live queries
+        def pump():
+            import time as _t
+
+            hub = self.ds.notifications
+            while alive["v"]:
+                sent = False
+                if hub is not None:
+                    for live_id in list(ctx.live_ids):
+                        try:
+                            n = hub.subscribe(live_id).get_nowait()
+                        except (queue.Empty, KeyError):
+                            continue
+                        msg = pack({"result": n.to_value()})
+                        with send_lock:
+                            try:
+                                sock.sendall(wsproto.encode_frame(wsproto.OP_BINARY, msg))
+                            except OSError:
+                                return
+                        sent = True
+                if not sent:
+                    _t.sleep(0.02)
+
+        self.ds.enable_notifications()
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+
+        try:
+            while True:
+                # read via the buffered rfile (it may hold early frame bytes)
+                op, payload = wsproto.read_frame(self.rfile)
+                if op == wsproto.OP_CLOSE:
+                    with send_lock:
+                        sock.sendall(wsproto.encode_frame(wsproto.OP_CLOSE, b""))
+                    break
+                if op == wsproto.OP_PING:
+                    with send_lock:
+                        sock.sendall(wsproto.encode_frame(wsproto.OP_PONG, payload))
+                    continue
+                if op not in (wsproto.OP_TEXT, wsproto.OP_BINARY):
+                    continue
+                try:
+                    req = unpack(payload) if op == wsproto.OP_BINARY else json.loads(payload)
+                except Exception:
+                    continue
+                rid = req.get("id")
+                try:
+                    result = ctx.execute(req.get("method", ""), req.get("params") or [])
+                    resp: Dict[str, Any] = {"id": rid, "result": result}
+                except SurrealError as e:
+                    resp = {"id": rid, "error": {"code": -32000, "message": str(e)}}
+                if op == wsproto.OP_BINARY:
+                    frame = wsproto.encode_frame(wsproto.OP_BINARY, pack(resp))
+                else:
+                    frame = wsproto.encode_frame(
+                        wsproto.OP_TEXT, json.dumps(to_json_value(resp)).encode()
+                    )
+                with send_lock:
+                    sock.sendall(frame)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            alive["v"] = False
+        self.close_connection = True
+
+
+class Server:
+    """Embedded server handle (reference: `surreal start`)."""
+
+    def __init__(self, ds, host: str = "127.0.0.1", port: int = 8000, auth_enabled: bool = True):
+        handler = type(
+            "BoundHandler", (SurrealHandler,), {"ds": ds, "auth_enabled": auth_enabled}
+        )
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start_background(self) -> "Server":
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def serve(path: str = "memory", host: str = "127.0.0.1", port: int = 8000, auth_enabled: bool = True) -> Server:
+    from surrealdb_tpu.kvs.ds import Datastore
+
+    ds = Datastore(path)
+    ds.enable_notifications()
+    return Server(ds, host, port, auth_enabled)

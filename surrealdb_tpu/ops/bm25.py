@@ -1,0 +1,60 @@
+"""Batched BM25 scoring kernel.
+
+Role of the reference's per-document scoring loop (reference:
+core/src/idx/ft/scorer.rs:13-92 — Okapi BM25 with lower-bounded tf
+normalization, k1=1.2 b=0.75) re-designed TPU-first: the whole candidate set
+scores in one fused elementwise kernel over [N, T] term-frequency and [T]
+document-frequency arrays (SURVEY §2.5 "BM25 scoring batch → TPU").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bm25_scores(
+    tf: jax.Array,  # [N, T] term frequency of each query term in each doc
+    df: jax.Array,  # [T] number of docs containing each term
+    doc_len: jax.Array,  # [N]
+    doc_count: jax.Array,  # scalar: total docs in the index
+    total_len: jax.Array,  # scalar: sum of all doc lengths
+    k1: float = 1.2,
+    b: float = 0.75,
+) -> jax.Array:
+    """-> [N] BM25 score of each candidate doc against the query terms."""
+    n = jnp.maximum(doc_count.astype(jnp.float32), 1.0)
+    avg_len = jnp.maximum(total_len.astype(jnp.float32) / n, 1e-6)
+    # idf with the +1 lower bound (reference scorer.rs compute_bm25_score)
+    idf = jnp.log1p((n - df.astype(jnp.float32) + 0.5) / (df.astype(jnp.float32) + 0.5))
+    tf_f = tf.astype(jnp.float32)
+    norm = 1.0 - b + b * (doc_len.astype(jnp.float32)[:, None] / avg_len)
+    score = idf[None, :] * (tf_f * (k1 + 1.0)) / (tf_f + k1 * norm)
+    return jnp.sum(score, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def bm25_topk(tf, df, doc_len, doc_count, total_len, k: int, k1=1.2, b=0.75):
+    """Fused score + top-k over the candidate set."""
+    s = bm25_scores(tf, df, doc_len, doc_count, total_len, k1, b)
+    vals, idx = jax.lax.top_k(s, k)
+    return vals, idx
+
+
+def bm25_scores_host(tf, df, doc_len, doc_count, total_len, k1=1.2, b=0.75):
+    """numpy twin of bm25_scores for candidate sets too small to amortize a
+    device dispatch (threshold in cnf.TPU_FT_ONDEVICE_THRESHOLD)."""
+    import numpy as np
+
+    n = max(float(doc_count), 1.0)
+    avg_len = max(float(total_len) / n, 1e-6)
+    df = np.asarray(df, dtype=np.float64)
+    tf = np.asarray(tf, dtype=np.float64)
+    doc_len = np.asarray(doc_len, dtype=np.float64)
+    idf = np.log1p((n - df + 0.5) / (df + 0.5))
+    norm = 1.0 - b + b * (doc_len[:, None] / avg_len)
+    score = idf[None, :] * (tf * (k1 + 1.0)) / (tf + k1 * norm)
+    return score.sum(axis=1).astype(np.float32)

@@ -110,6 +110,35 @@ def pad_rows(arr: np.ndarray, multiple: int) -> Tuple[np.ndarray, np.ndarray]:
     return np.concatenate([arr, pad], axis=0), mask
 
 
+def knn_search_host(
+    q: np.ndarray, x: np.ndarray, metric: str, k: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """numpy twin of knn_search for corpora below the device-dispatch
+    threshold (cnf.TPU_KNN_ONDEVICE_THRESHOLD) — a tunnel round-trip costs
+    more than scanning a few thousand rows on host."""
+    q = np.asarray(q, dtype=np.float64)
+    x = np.asarray(x, dtype=np.float64)
+    if metric == "euclidean":
+        d = np.sqrt(
+            np.maximum(
+                (q**2).sum(1)[:, None] + (x**2).sum(1)[None, :] - 2.0 * (q @ x.T),
+                0.0,
+            )
+        )
+    elif metric == "cosine":
+        qn = q / np.maximum(np.linalg.norm(q, axis=1, keepdims=True), 1e-30)
+        xn = x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True), 1e-30)
+        d = 1.0 - qn @ xn.T
+    else:
+        d = np.stack([[distance_single(a, b, metric) for b in x] for a in q])
+    kk = min(k, x.shape[0])
+    part = np.argpartition(d, kk - 1, axis=1)[:, :kk]
+    row = np.arange(q.shape[0])[:, None]
+    order = np.argsort(d[row, part], axis=1)
+    idx = part[row, order]
+    return d[row, idx].astype(np.float32), idx.astype(np.int64)
+
+
 # -------------------------------------------------------------- single-pair
 def distance_single(a, b, metric: str) -> float:
     """Scalar convenience for the vector:: functions (host path for tiny

@@ -1,0 +1,204 @@
+"""Multi-chip sharded execution over a jax.sharding.Mesh.
+
+Role of the reference's distributed scale-out (reference: kvs/tikv/, kvs/fdb/
+— scale via a distributed KV cluster; SURVEY §2.5) re-designed TPU-first:
+compute-side scale-out shards the device-resident index mirrors (vector
+matrices, CSR edge tables) across chips over ICI and uses XLA collectives
+instead of KV-client RPC:
+
+- vector kNN: corpus rows sharded over the 'data' mesh axis; each chip
+  computes distances + a local top-k on its shard (MXU matmul), then one
+  all-gather of k·n_devices candidates and a tiny global top-k. Collective
+  payload is O(k·devices), not O(N).
+- graph frontier expansion: CSR edge arrays sharded by source-node range;
+  frontier gathers are local, results concatenate via all_gather.
+
+Everything here is pure jax — it runs identically on a virtual
+`--xla_force_host_platform_device_count=8` CPU mesh (tests) and a real TPU
+slice (deployment).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from surrealdb_tpu.ops.distances import pairwise_distance
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def shard_corpus(mesh: Mesh, x: np.ndarray, axis: str = "data") -> jax.Array:
+    """Place a [N, D] corpus row-sharded across the mesh. N must divide by
+    the device count — callers pad with masked rows first."""
+    sharding = NamedSharding(mesh, P(axis, None))
+    return jax.device_put(x, sharding)
+
+
+def sharded_knn(
+    mesh: Mesh,
+    corpus: jax.Array,
+    mask: jax.Array,
+    queries: jax.Array,
+    k: int,
+    metric: str = "euclidean",
+    axis: str = "data",
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN over a row-sharded corpus.
+
+    corpus: [N, D] sharded (axis, None); mask: [N] sharded; queries: [Q, D]
+    replicated. Returns (dists [Q, k], global_idx [Q, k]).
+
+    Per-shard local top-k (all MXU work stays on-chip), then an all_gather of
+    the k-candidate sets — the ICI payload is tiny.
+    """
+    n_dev = mesh.shape[axis]
+    n_total = corpus.shape[0]
+    shard_rows = n_total // n_dev
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis), P(None, None)),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )
+    def _knn(x_local, m_local, q):
+        d = pairwise_distance(q, x_local, metric)  # [Q, N/n]
+        d = jnp.where(m_local[None, :], d, jnp.inf)
+        kk = min(k, x_local.shape[0])
+        neg, idx_local = jax.lax.top_k(-d, kk)  # [Q, kk]
+        # globalize indices: this shard's row-offset
+        shard_id = jax.lax.axis_index(axis)
+        idx_global = idx_local + shard_id * shard_rows
+        # gather every shard's candidates -> [n_dev*kk] per query
+        d_all = jax.lax.all_gather(-neg, axis, axis=1, tiled=True)  # [Q, n*kk]
+        i_all = jax.lax.all_gather(idx_global, axis, axis=1, tiled=True)
+        neg2, pos = jax.lax.top_k(-d_all, k)  # [Q, k]
+        return -neg2, jnp.take_along_axis(i_all, pos, axis=1)
+
+    return _knn(corpus, mask, queries)
+
+
+def sharded_knn_jit(mesh: Mesh, k: int, metric: str, axis: str = "data"):
+    """A jitted closure for repeated sharded kNN calls."""
+
+    @jax.jit
+    def run(corpus, mask, queries):
+        return sharded_knn(mesh, corpus, mask, queries, k, metric, axis)
+
+    return run
+
+
+def sharded_knn_2d(
+    mesh: Mesh,
+    corpus: jax.Array,
+    mask: jax.Array,
+    queries: jax.Array,
+    k: int,
+    data_axis: str = "data",
+    feat_axis: str = "model",
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact euclidean kNN over a 2-D sharded corpus [N/d_data, D/d_model].
+
+    The feature axis is tensor-parallel: each chip holds a D-slice, computes
+    partial q·x and partial squared norms, and a psum over the 'model' axis
+    reconstructs full distances (the TP analog of sharded matmul). The row
+    axis then does the data-parallel local-top-k + all_gather as in
+    sharded_knn. Queries are sharded on features, replicated on rows.
+    """
+    n_dev = mesh.shape[data_axis]
+    n_total = corpus.shape[0]
+    shard_rows = n_total // n_dev
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(data_axis, feat_axis), P(data_axis), P(None, feat_axis)),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )
+    def _knn(x_local, m_local, q_local):
+        # partial distance terms over the local feature slice
+        qq = jnp.sum(q_local.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+        xx = jnp.sum(x_local.astype(jnp.float32) ** 2, axis=-1)
+        qx = jnp.dot(q_local, x_local.T, preferred_element_type=jnp.float32)
+        d2 = qq + xx[None, :] - 2.0 * qx
+        d2 = jax.lax.psum(d2, feat_axis)  # TP collective over ICI
+        d = jnp.sqrt(jnp.maximum(d2, 0.0))
+        d = jnp.where(m_local[None, :], d, jnp.inf)
+        kk = min(k, x_local.shape[0])
+        neg, idx_local = jax.lax.top_k(-d, kk)
+        shard_id = jax.lax.axis_index(data_axis)
+        idx_global = idx_local + shard_id * shard_rows
+        d_all = jax.lax.all_gather(-neg, data_axis, axis=1, tiled=True)
+        i_all = jax.lax.all_gather(idx_global, data_axis, axis=1, tiled=True)
+        neg2, pos = jax.lax.top_k(-d_all, k)
+        return -neg2, jnp.take_along_axis(i_all, pos, axis=1)
+
+    return _knn(corpus, mask, queries)
+
+
+# ------------------------------------------------------------------ graph
+def sharded_frontier_hop(
+    mesh: Mesh,
+    indptr: jax.Array,
+    indices: jax.Array,
+    frontier: jax.Array,
+    frontier_mask: jax.Array,
+    max_degree: int,
+    axis: str = "data",
+) -> Tuple[jax.Array, jax.Array]:
+    """One BFS hop over a replicated CSR with a sharded frontier.
+
+    indptr: [N+1], indices: [E] (replicated; edge tables are far smaller than
+    vector matrices). frontier: [F] node ids padded to a multiple of the
+    device count, frontier_mask: [F]. Each device expands its frontier slice
+    with a fixed-width (max_degree) gather — compiler-friendly static shapes —
+    then results all_gather back. Returns (neighbors [F*max_degree], mask).
+    Dedup happens host-side between hops (sort-unique on small id sets) or
+    on-device for the bench path.
+    """
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(None), P(None), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis)),
+        check_vma=False,
+    )
+    def _hop(ptr, idx, fr, fm):
+        starts = ptr[fr]  # [f]
+        degs = ptr[fr + 1] - starts
+        offs = jnp.arange(max_degree)[None, :]  # [1, max_degree]
+        take = starts[:, None] + offs  # [f, max_degree]
+        valid = (offs < degs[:, None]) & fm[:, None]
+        take = jnp.clip(take, 0, idx.shape[0] - 1)
+        nb = idx[take]  # [f, max_degree]
+        return nb.reshape(-1), valid.reshape(-1)
+
+    return _hop(indptr, indices, frontier, frontier_mask)
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes",))
+def dedup_frontier(nodes: jax.Array, mask: jax.Array, n_nodes: int):
+    """On-device frontier dedup via a dense visited bitmap scatter.
+
+    Returns (unique_sorted_nodes [padded with n_nodes], new_mask). Fixed
+    output shape = input shape, so jit-stable across hops.
+    """
+    marks = jnp.zeros(n_nodes + 1, dtype=jnp.bool_)
+    safe = jnp.where(mask, nodes, n_nodes)
+    marks = marks.at[safe].set(True)
+    marks = marks.at[n_nodes].set(False)
+    present = jnp.nonzero(marks, size=nodes.shape[0], fill_value=n_nodes)[0]
+    return present, present < n_nodes

@@ -1,0 +1,55 @@
+"""Embedded (local) SDK engine: owns a Datastore in-process.
+
+Role of the reference's engine/local (reference: sdk/src/api/engine/local/
+native.rs — translates Method::* into Datastore calls, routes live
+notifications to per-query channels).
+"""
+
+from __future__ import annotations
+
+import queue
+from typing import Any, List, Optional
+
+from surrealdb_tpu.dbs.session import Session
+from surrealdb_tpu.kvs.ds import Datastore
+from surrealdb_tpu.rpc.method import RpcContext
+
+
+class LocalEngine:
+    def __init__(self, endpoint: str):
+        scheme, _, rest = endpoint.partition("://")
+        if scheme in ("mem", "memory"):
+            path = "memory"
+        else:
+            path = f"{scheme}://{rest}"
+        self.ds = Datastore(path)
+        self.ds.enable_notifications()
+        self.session = Session.owner(None, None)
+        self.rpc_ctx = RpcContext(self.ds, self.session)
+
+    def rpc(self, method: str, params: List[Any]) -> Any:
+        return self.rpc_ctx.execute(method, params)
+
+    def next_notification(self, live_id: str, timeout: Optional[float]):
+        hub = self.ds.notifications
+        if hub is None:
+            return None
+        q = hub.subscribe(live_id)
+        try:
+            n = q.get(timeout=timeout) if timeout else q.get_nowait()
+            return n.to_value()
+        except queue.Empty:
+            return None
+
+    def export(self) -> str:
+        from surrealdb_tpu.kvs.export import export_database
+
+        return export_database(self.ds, self.session)
+
+    def import_(self, text: str) -> None:
+        from surrealdb_tpu.kvs.export import import_database
+
+        import_database(self.ds, self.session, text)
+
+    def close(self) -> None:
+        self.ds.close()

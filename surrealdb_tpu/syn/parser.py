@@ -514,8 +514,11 @@ class Parser:
 
     def _stmt_insert(self) -> S.Statement:
         self.next()
+        # accept RELATION/IGNORE in either order
         relation = self.eat_kw("RELATION")
         ignore = self.eat_kw("IGNORE")
+        if not relation:
+            relation = self.eat_kw("RELATION")
         into = None
         if self.eat_kw("INTO"):
             # a bare table name even when '(' follows (column-list form)
@@ -901,11 +904,15 @@ class Parser:
                     ix["analyzer"] = self.ident("analyzer name")
                 while True:
                     if self.eat_kw("BM25"):
+                        # accepts both `BM25 1.2 0.75` and `BM25(1.2,0.75)`
+                        parens = self.eat_op("(")
                         if self.peek().kind == "NUMBER":
                             ix["k1"] = float(self.next().value)
-                            if self.eat_op(","):
-                                pass
-                            ix["b"] = float(self.next().value)
+                            self.eat_op(",")
+                            if self.peek().kind == "NUMBER":
+                                ix["b"] = float(self.next().value)
+                        if parens:
+                            self.expect_op(")")
                     elif self.eat_kw("HIGHLIGHTS"):
                         ix["highlights"] = True
                     elif self.eat_kw("DOC_IDS_ORDER") or self.eat_kw("DOC_LENGTHS_ORDER") or self.eat_kw("POSTINGS_ORDER") or self.eat_kw("TERMS_ORDER"):

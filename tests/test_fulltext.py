@@ -1,0 +1,99 @@
+"""Full-text index tests (mirrors reference sdk/tests/matches.rs style)."""
+
+from surrealdb_tpu.sql.value import Thing
+
+
+def ok(resp):
+    assert resp["status"] == "OK", resp
+    return resp["result"]
+
+
+def setup_docs(ds):
+    ds.execute(
+        "DEFINE ANALYZER simple TOKENIZERS blank,class FILTERS lowercase;"
+        "DEFINE INDEX title_ix ON book FIELDS title SEARCH ANALYZER simple BM25 HIGHLIGHTS;"
+    )
+    ds.execute(
+        "CREATE book:1 SET title = 'Rust Web Programming';"
+        "CREATE book:2 SET title = 'Programming in Python';"
+        "CREATE book:3 SET title = 'The Rust Book';"
+    )
+
+
+def test_matches_basic(ds):
+    setup_docs(ds)
+    r = ds.execute("SELECT VALUE id FROM book WHERE title @@ 'rust' ORDER BY id;")
+    assert ok(r[0]) == [Thing("book", 1), Thing("book", 3)]
+
+
+def test_matches_and_semantics(ds):
+    setup_docs(ds)
+    r = ds.execute("SELECT VALUE id FROM book WHERE title @@ 'rust programming';")
+    assert ok(r[0]) == [Thing("book", 1)]
+
+
+def test_matches_no_hit(ds):
+    setup_docs(ds)
+    r = ds.execute("SELECT * FROM book WHERE title @@ 'golang';")
+    assert ok(r[0]) == []
+
+
+def test_bm25_score(ds):
+    setup_docs(ds)
+    r = ds.execute(
+        "SELECT id, search::score(1) AS sc FROM book WHERE title @1@ 'rust' ORDER BY sc DESC;"
+    )
+    rows = ok(r[0])
+    assert len(rows) == 2
+    assert all(row["sc"] > 0 for row in rows)
+    # 'rust' in a 3-term title should outscore a 3-term title equally...
+    # at minimum scores are finite and ordered
+    assert rows[0]["sc"] >= rows[1]["sc"]
+
+
+def test_highlight(ds):
+    setup_docs(ds)
+    r = ds.execute(
+        "SELECT search::highlight('<b>', '</b>', 1) AS h FROM book WHERE title @1@ 'rust' ORDER BY id;"
+    )
+    rows = ok(r[0])
+    assert rows[0]["h"] == "<b>Rust</b> Web Programming"
+    assert rows[1]["h"] == "The <b>Rust</b> Book"
+
+
+def test_index_updates_on_change(ds):
+    setup_docs(ds)
+    ds.execute("UPDATE book:2 SET title = 'Advanced Rust';")
+    r = ds.execute("SELECT VALUE id FROM book WHERE title @@ 'rust' ORDER BY id;")
+    assert ok(r[0]) == [Thing("book", 1), Thing("book", 2), Thing("book", 3)]
+    ds.execute("DELETE book:1;")
+    r = ds.execute("SELECT VALUE id FROM book WHERE title @@ 'rust' ORDER BY id;")
+    assert ok(r[0]) == [Thing("book", 2), Thing("book", 3)]
+
+
+def test_matches_explain(ds):
+    setup_docs(ds)
+    r = ds.execute("SELECT * FROM book WHERE title @@ 'rust' EXPLAIN;")
+    plan = ok(r[0])
+    assert plan[0]["operation"] == "Iterate Index"
+    assert plan[0]["detail"]["plan"]["index"] == "title_ix"
+
+
+def test_edgengram_analyzer(ds):
+    ds.execute(
+        "DEFINE ANALYZER auto TOKENIZERS blank FILTERS lowercase, edgengram(2, 10);"
+        "DEFINE INDEX name_ix ON user FIELDS name SEARCH ANALYZER auto;"
+        "CREATE user:1 SET name = 'jonathan';"
+    )
+    r = ds.execute("SELECT VALUE id FROM user WHERE name @@ 'jo';")
+    assert ok(r[0]) == [Thing("user", 1)]
+
+
+def test_snowball_stemming(ds):
+    ds.execute(
+        "DEFINE ANALYZER eng TOKENIZERS blank,class FILTERS lowercase, snowball(english);"
+        "DEFINE INDEX c_ix ON doc FIELDS body SEARCH ANALYZER eng;"
+        "CREATE doc:1 SET body = 'running quickly through the forests';"
+    )
+    r = ds.execute("SELECT VALUE id FROM doc WHERE body @@ 'run forest';")
+    assert ok(r[0]) == [Thing("doc", 1)]

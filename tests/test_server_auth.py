@@ -1,0 +1,94 @@
+"""Server auth-gating + REST escaping tests (review regressions)."""
+
+import base64
+import http.client
+import json
+
+import pytest
+
+
+@pytest.fixture()
+def authed_server(ds):
+    from surrealdb_tpu.net.server import Server
+    from surrealdb_tpu.dbs.session import Session
+
+    ds.execute("CREATE a:1;")
+    ds.execute("DEFINE USER nsu ON NAMESPACE PASSWORD 'pw';", Session.owner("test", None))
+    srv = Server(ds, port=0, auth_enabled=True).start_background()
+    yield srv
+    srv.shutdown()
+
+
+def _conn(srv):
+    return http.client.HTTPConnection(srv.host, srv.port)
+
+
+def test_anonymous_rejected(authed_server):
+    c = _conn(authed_server)
+    hdrs = {"surreal-ns": "test", "surreal-db": "test"}
+    c.request("POST", "/sql", "SELECT * FROM a;", hdrs)
+    r = c.getresponse(); r.read()
+    assert r.status == 401
+    c.request("GET", "/export", headers=hdrs)
+    r = c.getresponse(); r.read()
+    assert r.status == 401
+    c.request("GET", "/key/a", headers=hdrs)
+    r = c.getresponse(); r.read()
+    assert r.status == 401
+    c.close()
+
+
+def test_ns_user_basic_auth(authed_server):
+    hdrs = {
+        "Authorization": "Basic " + base64.b64encode(b"nsu:pw").decode(),
+        "surreal-ns": "test",
+        "surreal-db": "test",
+    }
+    c = _conn(authed_server)
+    c.request("POST", "/sql", "RETURN 1;", hdrs)
+    r = c.getresponse()
+    out = json.loads(r.read())
+    assert r.status == 200 and out[0]["result"] == 1
+    c.close()
+
+
+def test_key_route_escapes_ids(authed_server):
+    hdrs = {
+        "Authorization": "Basic " + base64.b64encode(b"nsu:pw").decode(),
+        "surreal-ns": "test",
+        "surreal-db": "test",
+        "Content-Type": "application/json",
+    }
+    c = _conn(authed_server)
+    weird = "8424486b-85b3-4448-ac8d-5d51083391c7"
+    c.request("POST", f"/key/widget/{weird}", json.dumps({"v": 1}), hdrs)
+    out = json.loads(c.getresponse().read())
+    assert out[0]["status"] == "OK", out
+    c.request("GET", f"/key/widget/{weird}", headers=hdrs)
+    out = json.loads(c.getresponse().read())
+    assert out[0]["result"][0]["v"] == 1
+    # an id shaped like an injection stays an id
+    evil = "1;REMOVE TABLE widget"
+    c.request("POST", "/key/widget/" + evil.replace(";", "%3B"), json.dumps({"v": 2}), hdrs)
+    out = json.loads(c.getresponse().read())
+    assert out[0]["status"] == "OK", out
+    c.request("GET", f"/key/widget/{weird}", headers=hdrs)
+    out = json.loads(c.getresponse().read())
+    assert out[0]["result"], "table must still exist"
+    c.close()
+
+
+def test_insert_ignore_relation(ds):
+    ds.execute("CREATE a:1; CREATE b:1; RELATE a:1->likes->b:1;")
+    edge = ds.execute("SELECT VALUE id FROM likes;")[0]["result"][0]
+    r = ds.execute(
+        f"INSERT IGNORE RELATION [{{ id: {edge}, in: a:1, out: b:1, extra: 1 }}];"
+    )
+    assert r[0]["result"] == []
+    row = ds.execute("SELECT * FROM likes;")[0]["result"][0]
+    assert "extra" not in row
+
+
+def test_bm25_single_arg(ds):
+    r = ds.execute("DEFINE INDEX i1 ON t FIELDS body SEARCH ANALYZER like BM25(1.2);")
+    assert r[0]["status"] == "OK", r
