@@ -2002,7 +2002,7 @@ class Parser:
         # record id: ident:...
         if self.is_op(":"):
             nt = self.peek(1)
-            if nt.kind in ("NUMBER", "IDENT", "STRING", "UUID") or (
+            if nt.kind in ("NUMBER", "IDENT", "STRING", "UUID", "DURATION") or (
                 nt.kind == "OP" and nt.value in ("[", "{", "..", "⟨", "-", "|")
             ):
                 self.next()  # consume :
@@ -2024,7 +2024,35 @@ class Parser:
         # range forms: tb:beg..end, tb:beg>..end, tb:..end
         def id_atom() -> Any:
             t = self.peek()
-            if t.kind == "NUMBER":
+            if t.kind in ("NUMBER", "DURATION"):
+                # digit-leading alphanumeric ids (`likes:8abc2`, `t:1h30x`)
+                # lex as NUMBER/DURATION [+ IDENT]; merge adjacent source text
+                # back into one string id
+                nxt = self.peek(1)
+                merged = None
+                if nxt.kind in ("IDENT", "NUMBER", "DURATION"):
+                    seg = self.text[t.pos : nxt.pos]
+                    if not any(c.isspace() for c in seg):
+                        self.next()
+                        end_tok = self.next()
+                        end = end_tok.pos
+                        # extend through the end token's literal text
+                        while end < len(self.text) and (
+                            self.text[end].isalnum() or self.text[end] == "_"
+                        ):
+                            end += 1
+                        merged = self.text[t.pos : end]
+                if merged is not None:
+                    return merged
+                if t.kind == "DURATION":
+                    # a bare duration-shaped id (`t:1h`) is a string id
+                    self.next()
+                    end = t.pos
+                    while end < len(self.text) and (
+                        self.text[end].isalnum() or self.text[end] == "_"
+                    ):
+                        end += 1
+                    return self.text[t.pos : end]
                 self.next()
                 if isinstance(t.value, float):
                     raise self.error("record id must be an integer", t)

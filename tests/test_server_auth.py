@@ -68,8 +68,10 @@ def test_key_route_escapes_ids(authed_server):
     out = json.loads(c.getresponse().read())
     assert out[0]["result"][0]["v"] == 1
     # an id shaped like an injection stays an id
-    evil = "1;REMOVE TABLE widget"
-    c.request("POST", "/key/widget/" + evil.replace(";", "%3B"), json.dumps({"v": 2}), hdrs)
+    from urllib.parse import quote
+
+    evil = quote("1;REMOVE TABLE widget", safe="")
+    c.request("POST", "/key/widget/" + evil, json.dumps({"v": 2}), hdrs)
     out = json.loads(c.getresponse().read())
     assert out[0]["status"] == "OK", out
     c.request("GET", f"/key/widget/{weird}", headers=hdrs)
