@@ -95,10 +95,11 @@ def _start(args) -> int:
         auth_enabled=not args.unauthenticated,
     )
     if args.user and args.password:
+        from surrealdb_tpu.sql.value import format_value
+
         srv.httpd.RequestHandlerClass.ds.execute(
-            f"DEFINE USER {args.user} ON ROOT PASSWORD $p ROLES OWNER;",
+            f"DEFINE USER {args.user} ON ROOT PASSWORD {format_value(args.password)} ROLES OWNER;",
             Session.owner(None, None),
-            {"p": args.password},
         )
     print(f"Started surrealdb-tpu on {srv.url} (storage: {args.path})", file=sys.stderr)
     try:

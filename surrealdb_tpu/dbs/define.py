@@ -32,7 +32,11 @@ def _guard(existing, args, kind: str, name: str) -> bool:
 
 
 def define_compute(ctx, stm) -> Any:
+    from surrealdb_tpu.iam.check import check_ddl
+
     kind = stm.kind
+    target_base = stm.args.get("base") if kind in ("user", "access") else None
+    check_ddl(ctx, kind, target_base=target_base)
     args = stm.args
     handler = _DEFINES.get(kind)
     if handler is None:
@@ -343,7 +347,11 @@ _DEFINES = {
 
 # ------------------------------------------------------------------ REMOVE
 def remove_compute(ctx, stm) -> Any:
+    from surrealdb_tpu.iam.check import check_ddl
+
     kind, name = stm.kind, stm.name
+    target_base = (stm.level or "root") if kind in ("user", "access") else None
+    check_ddl(ctx, kind, target_base=target_base)
     txn = ctx.txn()
 
     def missing(what: str):
@@ -458,6 +466,9 @@ def remove_compute(ctx, stm) -> Any:
 
 # ------------------------------------------------------------------ ALTER / REBUILD
 def alter_compute(ctx, stm) -> Any:
+    from surrealdb_tpu.iam.check import check_ddl
+
+    check_ddl(ctx, stm.kind)
     if stm.kind != "table":
         raise SurrealError(f"ALTER {stm.kind.upper()} is not supported")
     ns, db = ctx.ns_db()
@@ -475,6 +486,9 @@ def alter_compute(ctx, stm) -> Any:
 
 
 def rebuild_compute(ctx, stm) -> Any:
+    from surrealdb_tpu.iam.check import check_ddl
+
+    check_ddl(ctx, "index")
     ns, db = ctx.ns_db()
     txn = ctx.txn()
     ix = txn.get_tb_index(ns, db, stm.table, stm.name)

@@ -14,6 +14,9 @@ from surrealdb_tpu.err import IxNotFoundError, SurrealError
 
 
 def info_compute(ctx, stm) -> Any:
+    from surrealdb_tpu.iam.check import check_info
+
+    check_info(ctx, stm.level)
     level = stm.level
     txn = ctx.txn()
     structure = stm.structure

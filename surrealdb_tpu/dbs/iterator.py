@@ -345,6 +345,17 @@ class Iterator:
         ctx, stm, verb = self.ctx, self.stm, self.verb
         try:
             if verb == "select":
+                # per-record PERMISSIONS for record-access / guest sessions
+                from surrealdb_tpu.iam.check import (
+                    check_table_permission,
+                    filter_fields_for_select,
+                    perms_apply,
+                )
+
+                if rid is not None and perms_apply(ctx):
+                    if not check_table_permission(ctx, rid, docv, "select"):
+                        return
+                    docv = filter_fields_for_select(ctx, rid, docv)
                 with ctx.with_doc_value(docv, rid=rid, ir=ir) as c:
                     if stm.cond is not None and not truthy(stm.cond.compute(c)):
                         return

@@ -492,6 +492,28 @@ def pluck_output(ctx, stm, rid: Thing, before, after) -> Any:
 
 
 # ------------------------------------------------------------------ verbs
+def _check_write_perm(ctx, rid: Thing, doc_v, verb: str) -> None:
+    """Statement-level role gate + per-record PERMISSIONS for non-system
+    sessions (reference doc/check.rs + iam is_allowed)."""
+    from surrealdb_tpu.iam.check import check_data_write, check_table_permission, perms_apply
+
+    check_data_write(ctx)
+    if perms_apply(ctx):
+        if not check_table_permission(ctx, rid, doc_v, verb):
+            raise IgnoreError()
+
+
+def _check_record_perm(ctx, rid: Thing, doc_v, verb: str) -> None:
+    """Per-record PERMISSIONS only (no role gate) — used for the post-data
+    check; the reference evaluates table permissions AFTER record data is
+    applied (create.rs) and twice for updates (update.rs)."""
+    from surrealdb_tpu.iam.check import check_table_permission, perms_apply
+
+    if perms_apply(ctx):
+        if not check_table_permission(ctx, rid, doc_v, verb):
+            raise IgnoreError()
+
+
 def _check_cond(ctx, stm, rid, doc_v) -> bool:
     cond = getattr(stm, "cond", None)
     if cond is None:
@@ -502,6 +524,9 @@ def _check_cond(ctx, stm, rid, doc_v) -> bool:
 
 def process_create(ctx, rid: Thing, stm, check_exists: bool = True) -> Any:
     """CREATE one record (reference: core/src/doc/create.rs)."""
+    from surrealdb_tpu.iam.check import check_data_write
+
+    check_data_write(ctx)
     ns, db = ctx.ns_db()
     txn = ctx.txn()
     if check_exists and txn.record_exists(ns, db, rid.tb, rid.id):
@@ -511,6 +536,7 @@ def process_create(ctx, rid: Thing, stm, check_exists: bool = True) -> Any:
     current = apply_data(ctx, current, getattr(stm, "data", None), rid)
     current["id"] = rid
     current = process_field_defs(ctx, rid, current, {}, is_create=True)
+    _check_record_perm(ctx, rid, current, "create")
     from surrealdb_tpu.idx.index import index_document
 
     store_record(ctx, rid, current)
@@ -523,11 +549,13 @@ def process_update(ctx, rid: Thing, initial: dict, stm) -> Any:
     """UPDATE one existing record (reference: core/src/doc/update.rs)."""
     if not _check_cond(ctx, stm, rid, initial):
         raise IgnoreError()
+    _check_write_perm(ctx, rid, initial, "update")
     before = copy_value(initial)
     current = copy_value(initial)
     current = apply_data(ctx, current, getattr(stm, "data", None), rid)
     current["id"] = rid
     current = process_field_defs(ctx, rid, current, before, is_create=False)
+    _check_record_perm(ctx, rid, current, "update")
     from surrealdb_tpu.idx.index import index_document
 
     store_record(ctx, rid, current)
@@ -540,6 +568,7 @@ def process_delete(ctx, rid: Thing, initial: dict, stm) -> Any:
     """DELETE one record (reference: core/src/doc/delete.rs)."""
     if not _check_cond(ctx, stm, rid, initial):
         raise IgnoreError()
+    _check_write_perm(ctx, rid, initial, "delete")
     before = copy_value(initial)
     from surrealdb_tpu.idx.index import index_document
 
@@ -552,6 +581,9 @@ def process_delete(ctx, rid: Thing, initial: dict, stm) -> Any:
 def process_insert(ctx, rid: Thing, row: dict, stm) -> Any:
     """INSERT one row (reference: core/src/doc/insert.rs): create, or on
     duplicate key either IGNORE, apply the UPDATE clause, or error."""
+    from surrealdb_tpu.iam.check import check_data_write
+
+    check_data_write(ctx)
     ns, db = ctx.ns_db()
     txn = ctx.txn()
     existing = txn.get_record(ns, db, rid.tb, rid.id)
@@ -569,6 +601,7 @@ def process_insert(ctx, rid: Thing, row: dict, stm) -> Any:
     current = dict(row)
     current["id"] = rid
     current = process_field_defs(ctx, rid, current, {}, is_create=True)
+    _check_record_perm(ctx, rid, current, "create")
     from surrealdb_tpu.idx.index import index_document
 
     store_record(ctx, rid, current)
@@ -581,6 +614,9 @@ def process_relate(
     ctx, edge_rid: Thing, from_t: Thing, to_t: Thing, stm, row: Optional[dict] = None
 ) -> Any:
     """RELATE one edge (reference: core/src/doc/relate.rs + edges.rs)."""
+    from surrealdb_tpu.iam.check import check_data_write
+
+    check_data_write(ctx)
     ns, db = ctx.ns_db()
     txn = ctx.txn()
     tb_def = txn.ensure_tb(ns, db, edge_rid.tb)
@@ -610,6 +646,7 @@ def process_relate(
     current["in"] = from_t
     current["out"] = to_t
     current = process_field_defs(ctx, edge_rid, current, before or {}, is_create=existing is None)
+    _check_record_perm(ctx, edge_rid, current, "create" if existing is None else "update")
     from surrealdb_tpu.idx.index import index_document
 
     store_record(ctx, edge_rid, current)

@@ -1,0 +1,178 @@
+"""Permission enforcement tests (reference: sdk/tests/permissions + doc/check)."""
+
+import pytest
+
+from surrealdb_tpu.dbs.session import Session
+from surrealdb_tpu.sql.value import Thing
+
+
+def owner(ds, q, vars=None):
+    return ds.execute(q, Session.owner(), vars)
+
+
+def test_viewer_cannot_write(ds):
+    owner(ds, "CREATE t:1 SET v = 1;")
+    viewer = Session.viewer()
+    r = ds.execute("UPDATE t:1 SET v = 2;", viewer)
+    assert r[0]["status"] == "ERR"
+    r = ds.execute("CREATE t:2;", viewer)
+    assert r[0]["status"] == "ERR"
+    # reads still fine
+    r = ds.execute("SELECT VALUE v FROM t:1;", viewer)
+    assert r[0]["result"] == [1]
+
+
+def test_viewer_cannot_define(ds):
+    viewer = Session.viewer()
+    r = ds.execute("DEFINE TABLE x;", viewer)
+    assert r[0]["status"] == "ERR"
+    assert "permissions" in r[0]["result"].lower()
+
+
+def test_editor_cannot_define_users(ds):
+    editor = Session.editor()
+    r = ds.execute("DEFINE TABLE x;", editor)
+    assert r[0]["status"] == "OK"
+    r = ds.execute("DEFINE USER u ON ROOT PASSWORD 'p';", editor)
+    assert r[0]["status"] == "ERR"
+
+
+def test_anonymous_denied_without_permissions(ds):
+    owner(ds, "DEFINE TABLE secret; CREATE secret:1 SET v = 1;")
+    anon = Session.anonymous("test", "test")
+    r = ds.execute("SELECT * FROM secret;", anon)
+    assert r[0]["result"] == []
+    r = ds.execute("CREATE secret:2;", anon)
+    assert r[0]["result"] == []  # silently ignored per-record
+
+
+def test_table_permissions_full(ds):
+    owner(ds, "DEFINE TABLE pub PERMISSIONS FULL; CREATE pub:1 SET v = 1;")
+    anon = Session.anonymous("test", "test")
+    r = ds.execute("SELECT VALUE v FROM pub;", anon)
+    assert r[0]["result"] == [1]
+    r = ds.execute("CREATE pub:2 SET v = 2;", anon)
+    assert len(r[0]["result"]) == 1
+
+
+def test_table_permissions_where_clause(ds):
+    owner(
+        ds,
+        "DEFINE TABLE post PERMISSIONS FOR select WHERE published = true FOR create, update, delete NONE;"
+        "CREATE post:1 SET published = true, title = 'a';"
+        "CREATE post:2 SET published = false, title = 'b';",
+    )
+    anon = Session.anonymous("test", "test")
+    r = ds.execute("SELECT VALUE title FROM post;", anon)
+    assert r[0]["result"] == ["a"]
+    r = ds.execute("DELETE post:1;", anon)
+    # denied silently; record still there for the owner
+    r = owner(ds, "SELECT count() FROM post GROUP ALL;")
+    assert r[0]["result"][0]["count"] == 2
+
+
+def test_record_access_auth_param(ds):
+    owner(
+        ds,
+        "DEFINE TABLE account PERMISSIONS FOR select, update WHERE owner = $auth FOR create, delete NONE;"
+        "CREATE account:a SET owner = user:alice, bal = 10;"
+        "CREATE account:b SET owner = user:bob, bal = 20;",
+    )
+    alice = Session.for_record("test", "test", "users", Thing("user", "alice"))
+    r = ds.execute("SELECT VALUE bal FROM account;", alice)
+    assert r[0]["result"] == [10]
+    r = ds.execute("UPDATE account:a SET bal = 11;", alice)
+    assert len(r[0]["result"]) == 1
+    r = ds.execute("UPDATE account:b SET bal = 0;", alice)
+    assert r[0]["result"] == []
+    assert owner(ds, "SELECT VALUE bal FROM account:b;")[0]["result"] == [20]
+
+
+def test_field_permissions_filtered_on_select(ds):
+    owner(
+        ds,
+        "DEFINE TABLE profile PERMISSIONS FULL;"
+        "DEFINE FIELD email ON profile PERMISSIONS FOR select NONE;"
+        "CREATE profile:1 SET name = 'x', email = 'x@y.z';",
+    )
+    anon = Session.anonymous("test", "test")
+    r = ds.execute("SELECT * FROM profile;", anon)
+    row = r[0]["result"][0]
+    assert row["name"] == "x"
+    assert "email" not in row
+    # owner still sees it
+    row = owner(ds, "SELECT * FROM profile;")[0]["result"][0]
+    assert row["email"] == "x@y.z"
+
+
+def test_info_requires_system_user(ds):
+    anon = Session.anonymous("test", "test")
+    r = ds.execute("INFO FOR DB;", anon)
+    assert r[0]["status"] == "ERR"
+
+
+def test_ns_owner_cannot_define_root_user(ds):
+    from surrealdb_tpu.dbs.session import Auth
+
+    owner(ds, "DEFINE USER nso ON NAMESPACE PASSWORD 'p' ROLES OWNER;")
+    ns_owner = Session("test", "test", Auth("ns", ns="test", user="nso", roles=["Owner"]))
+    r = ds.execute("DEFINE USER evil ON ROOT PASSWORD 'p' ROLES OWNER;", ns_owner)
+    assert r[0]["status"] == "ERR"
+    r = ds.execute("INFO FOR ROOT;", ns_owner)
+    assert r[0]["status"] == "ERR"
+    # but ns-level INFO is fine
+    r = ds.execute("INFO FOR NS;", ns_owner)
+    assert r[0]["status"] == "OK"
+
+
+def test_create_permission_sees_new_doc(ds):
+    owner(ds, "DEFINE TABLE post PERMISSIONS FOR create WHERE author = $auth FOR select FULL;")
+    alice = Session.for_record("test", "test", "users", Thing("user", "alice"))
+    r = ds.execute("CREATE post:1 SET author = user:alice, t = 'x';", alice)
+    assert len(r[0]["result"]) == 1, r
+    # creating on someone else's behalf is denied
+    r = ds.execute("CREATE post:2 SET author = user:bob;", alice)
+    assert r[0]["result"] == []
+
+
+def test_update_cannot_transfer_ownership(ds):
+    owner(
+        ds,
+        "DEFINE TABLE acc PERMISSIONS FOR update WHERE owner = $auth FOR select FULL;"
+        "CREATE acc:1 SET owner = user:alice, v = 1;",
+    )
+    alice = Session.for_record("test", "test", "users", Thing("user", "alice"))
+    r = ds.execute("UPDATE acc:1 SET v = 2;", alice)
+    assert len(r[0]["result"]) == 1
+    # the post-apply check denies mutating into a denied state
+    r = ds.execute("UPDATE acc:1 SET owner = user:bob;", alice)
+    assert r[0]["result"] == []
+    assert owner(ds, "SELECT VALUE owner FROM acc:1;")[0]["result"] == [Thing("user", "alice")]
+
+
+def test_nested_field_permission_keeps_siblings(ds):
+    owner(
+        ds,
+        "DEFINE TABLE t PERMISSIONS FULL;"
+        "DEFINE FIELD meta.secret ON t PERMISSIONS FOR select NONE;"
+        "CREATE t:1 SET meta = { secret: 's', open: 'o' };",
+    )
+    anon = Session.anonymous("test", "test")
+    row = ds.execute("SELECT * FROM t;", anon)[0]["result"][0]
+    assert row["meta"].get("open") == "o"
+    assert "secret" not in row["meta"]
+
+
+def test_insert_on_duplicate_uses_update_permission(ds):
+    owner(
+        ds,
+        "DEFINE TABLE kv PERMISSIONS FOR update FULL FOR create NONE FOR select FULL;"
+        "CREATE kv:1 SET v = 1;",
+    )
+    anon = Session.anonymous("test", "test")
+    r = ds.execute("INSERT INTO kv { id: kv:1, v: 2 } ON DUPLICATE KEY UPDATE v = 2;", anon)
+    assert len(r[0]["result"]) == 1, r
+    assert owner(ds, "SELECT VALUE v FROM kv:1;")[0]["result"] == [2]
+    # plain insert of a new record still denied
+    r = ds.execute("INSERT INTO kv { id: kv:2, v: 9 };", anon)
+    assert r[0]["result"] == []

@@ -13,7 +13,10 @@ def authed_server(ds):
     from surrealdb_tpu.dbs.session import Session
 
     ds.execute("CREATE a:1;")
-    ds.execute("DEFINE USER nsu ON NAMESPACE PASSWORD 'pw';", Session.owner("test", None))
+    ds.execute(
+        "DEFINE USER nsu ON NAMESPACE PASSWORD 'pw' ROLES EDITOR;",
+        Session.owner("test", None),
+    )
     srv = Server(ds, port=0, auth_enabled=True).start_background()
     yield srv
     srv.shutdown()
