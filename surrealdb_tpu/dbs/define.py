@@ -8,6 +8,7 @@ bootstraps).
 
 from __future__ import annotations
 
+import secrets
 from typing import Any, Optional
 
 from surrealdb_tpu import key as keys
@@ -290,7 +291,9 @@ def _def_access(ctx, a) -> Any:
         "signin": a.get("signin"),
         "authenticate": a.get("authenticate"),
         "jwt_alg": a.get("jwt_alg", "HS512"),
-        "jwt_key": a.get("jwt_key"),
+        # no WITH KEY → random secret, so issued tokens verify on the way back
+        # in (reference: define/access.rs random_key())
+        "jwt_key": a.get("jwt_key") or secrets.token_urlsafe(32),
         "jwt_url": a.get("jwt_url"),
         "jwt_issuer_key": a.get("jwt_issuer_key"),
         "token_duration": a.get("token_duration"),

@@ -26,7 +26,7 @@ from surrealdb_tpu.dbs.session import Auth, Session
 from surrealdb_tpu.err import InvalidAuthError, SurrealError
 from surrealdb_tpu.rpc.method import RpcContext
 from surrealdb_tpu.sql.value import to_json_value
-from surrealdb_tpu.utils.ser import pack, unpack
+from surrealdb_tpu.utils.ser import wire_pack as pack, wire_unpack
 
 from . import ws as wsproto
 
@@ -114,6 +114,16 @@ class SurrealHandler(BaseHTTPRequestHandler):
         if path == "/export":
             try:
                 sess = self._authorized_session()
+                # export dumps raw KV state, bypassing table/field PERMISSIONS,
+                # so it requires a *system* user covering this db — record-access
+                # users (public /signup) must not reach it (reference:
+                # src/net/export.rs db.check(View, Any.on_db(..)))
+                if self.auth_enabled:
+                    a = sess.auth
+                    if a.level not in ("db", "ns", "root") or not a.has_db_access(
+                        sess.ns, sess.db
+                    ):
+                        raise InvalidAuthError()
                 from surrealdb_tpu.kvs.export import export_database
 
                 return self._send(200, export_database(self.ds, sess), "text/plain")
@@ -231,21 +241,33 @@ class SurrealHandler(BaseHTTPRequestHandler):
             return self._send(400, {"error": str(e)})
         return self._send(200, out)
 
+    # RPC methods an unauthenticated client may call (the authentication
+    # bootstrap itself plus connection management); everything else touches
+    # data and follows the /sql route's default-deny guest policy
+    _RPC_ANON_METHODS = frozenset(
+        {"ping", "version", "use", "signin", "signup", "authenticate", "invalidate"}
+    )
+
     def _rpc_http(self):
         ct = (self.headers.get("Content-Type") or "application/json").split(";")[0]
         body = self._body()
         try:
-            req = unpack(body) if ct == "application/msgpack" else json.loads(body)
+            req = wire_unpack(body) if ct == "application/msgpack" else json.loads(body)
         except Exception:
             return self._send(400, {"error": "invalid request body"})
         try:
             sess = self._session()
         except SurrealError as e:
             return self._send(401, {"error": str(e)})
-        ctx = RpcContext(self.ds, sess)
         rid = req.get("id")
+        method = req.get("method", "")
+        if self.auth_enabled and sess.auth.is_anon() and method not in self._RPC_ANON_METHODS:
+            return self._send(
+                401, {"id": rid, "error": {"code": -32000, "message": "Not authenticated"}}, ct
+            )
+        ctx = RpcContext(self.ds, sess)
         try:
-            result = ctx.execute(req.get("method", ""), req.get("params") or [])
+            result = ctx.execute(method, req.get("params") or [])
             resp = {"id": rid, "result": result}
         except SurrealError as e:
             resp = {"id": rid, "error": {"code": -32000, "message": str(e)}}
@@ -272,6 +294,9 @@ class SurrealHandler(BaseHTTPRequestHandler):
         ctx = RpcContext(self.ds, sess)
         send_lock = threading.Lock()
         alive = {"v": True}
+        # wire format follows the client's most recent request frame so JSON
+        # (text) clients receive notifications they can actually decode
+        fmt = {"binary": False}
 
         # live-notification pump: drain ONLY this connection's live queries
         def pump():
@@ -286,10 +311,16 @@ class SurrealHandler(BaseHTTPRequestHandler):
                             n = hub.subscribe(live_id).get_nowait()
                         except (queue.Empty, KeyError):
                             continue
-                        msg = pack({"result": n.to_value()})
+                        note = {"result": n.to_value()}
+                        if fmt["binary"]:
+                            frame = wsproto.encode_frame(wsproto.OP_BINARY, pack(note))
+                        else:
+                            frame = wsproto.encode_frame(
+                                wsproto.OP_TEXT, json.dumps(to_json_value(note)).encode()
+                            )
                         with send_lock:
                             try:
-                                sock.sendall(wsproto.encode_frame(wsproto.OP_BINARY, msg))
+                                sock.sendall(frame)
                             except OSError:
                                 return
                         sent = True
@@ -314,13 +345,24 @@ class SurrealHandler(BaseHTTPRequestHandler):
                     continue
                 if op not in (wsproto.OP_TEXT, wsproto.OP_BINARY):
                     continue
+                fmt["binary"] = op == wsproto.OP_BINARY
                 try:
-                    req = unpack(payload) if op == wsproto.OP_BINARY else json.loads(payload)
+                    req = wire_unpack(payload) if op == wsproto.OP_BINARY else json.loads(payload)
                 except Exception:
                     continue
                 rid = req.get("id")
+                method = req.get("method", "")
                 try:
-                    result = ctx.execute(req.get("method", ""), req.get("params") or [])
+                    # same default-deny guest policy as HTTP /rpc; checked per
+                    # message because signin/authenticate upgrade the session
+                    # mid-connection
+                    if (
+                        self.auth_enabled
+                        and ctx.session.auth.is_anon()
+                        and method not in self._RPC_ANON_METHODS
+                    ):
+                        raise InvalidAuthError()
+                    result = ctx.execute(method, req.get("params") or [])
                     resp: Dict[str, Any] = {"id": rid, "result": result}
                 except SurrealError as e:
                     resp = {"id": rid, "error": {"code": -32000, "message": str(e)}}

@@ -18,7 +18,7 @@ from urllib.parse import urlparse
 
 from surrealdb_tpu.err import SurrealError
 from surrealdb_tpu.net import ws as wsproto
-from surrealdb_tpu.utils.ser import pack, unpack
+from surrealdb_tpu.utils.ser import wire_pack as pack, wire_unpack as unpack
 
 
 class HttpEngine:

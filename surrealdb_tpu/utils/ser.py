@@ -74,11 +74,16 @@ def _default(v: Any):
     raise TypeError(f"cannot serialize {type(v).__name__}")
 
 
-def _ext_hook(code: int, data: bytes):
+def _ext_hook(code: int, data: bytes, recurse=None):
+    # `recurse` decodes nested container payloads (Thing ids, Geometry coords,
+    # Range bounds) and must stay the SAME codec as the outer decode — if the
+    # wire codec recursed through the trusted one, a pickle ext nested inside
+    # EXT_THING would bypass the EXT_PYOBJ rejection.
+    recurse = recurse or unpack
     if code == EXT_NONE:
         return NONE
     if code == EXT_THING:
-        d = unpack(data)
+        d = recurse(data)
         return Thing(d["tb"], d["id"])
     if code == EXT_DURATION:
         return Duration(msgpack.unpackb(data))
@@ -87,10 +92,10 @@ def _ext_hook(code: int, data: bytes):
     if code == EXT_UUID:
         return Uuid(_uuid.UUID(bytes=data))
     if code == EXT_GEOMETRY:
-        d = unpack(data)
+        d = recurse(data)
         return Geometry(d["k"], d["c"])
     if code == EXT_RANGE:
-        d = unpack(data)
+        d = recurse(data)
         return Range(d["b"], d["e"], d["bi"], d["ei"])
     if code == EXT_TABLE:
         return Table(data.decode())
@@ -101,9 +106,39 @@ def _ext_hook(code: int, data: bytes):
     return msgpack.ExtType(code, data)
 
 
+def _wire_ext_hook(code: int, data: bytes):
+    # Network-facing decode: EXT_PYOBJ carries pickled engine internals and is
+    # storage-codec-only. Accepting it from the wire would hand remote clients
+    # arbitrary code execution via pickle.loads, so it is rejected outright —
+    # at every nesting depth, not just the top level.
+    if code == EXT_PYOBJ:
+        raise ValueError("EXT_PYOBJ is not accepted on the wire")
+    return _ext_hook(code, data, recurse=wire_unpack)
+
+
+def _wire_default(v: Any):
+    # Network-facing encode: never pickle engine internals onto the wire.
+    # Anything the storage codec would pickle is degraded to its SurrealQL
+    # string form so msgpack clients always receive decodable frames.
+    out = _default(v)
+    if isinstance(out, msgpack.ExtType) and out.code == EXT_PYOBJ:
+        return repr(v)
+    return out
+
+
 def pack(v: Any) -> bytes:
     return msgpack.packb(v, default=_default, use_bin_type=True, strict_types=True)
 
 
 def unpack(b: bytes) -> Any:
     return msgpack.unpackb(b, ext_hook=_ext_hook, raw=False, strict_map_key=False)
+
+
+def wire_pack(v: Any) -> bytes:
+    """Encode for the network; engine internals become strings, never pickles."""
+    return msgpack.packb(v, default=_wire_default, use_bin_type=True, strict_types=True)
+
+
+def wire_unpack(b: bytes) -> Any:
+    """Decode untrusted network bytes; refuses the pickle extension type."""
+    return msgpack.unpackb(b, ext_hook=_wire_ext_hook, raw=False, strict_map_key=False)
