@@ -50,6 +50,7 @@ TPU_BATCH_MIN_TILE = _env_int("SURREAL_TPU_BATCH_MIN_TILE", 128)
 TPU_VECTOR_DTYPE = os.environ.get("SURREAL_TPU_VECTOR_DTYPE", "bfloat16")
 TPU_KNN_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_KNN_ONDEVICE_THRESHOLD", 4096)
 TPU_FT_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_FT_ONDEVICE_THRESHOLD", 4096)
+TPU_GRAPH_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_GRAPH_ONDEVICE_THRESHOLD", 2048)
 TPU_DISABLE = _env_bool("SURREAL_TPU_DISABLE", False)
 
 # Changefeeds

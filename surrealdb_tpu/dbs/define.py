@@ -370,6 +370,7 @@ def remove_compute(ctx, stm) -> Any:
         txn.del_ns(name)
         pre = keys._ns(name)
         txn.delr(pre, prefix_end(pre))
+        ctx.ds().graph_mirrors.drop_ns(name)
         return NONE
     if kind == "database":
         ns = ctx.session.ns
@@ -380,6 +381,7 @@ def remove_compute(ctx, stm) -> Any:
         txn.del_db(ns, name)
         pre = keys._db(ns, name)
         txn.delr(pre, prefix_end(pre))
+        ctx.ds().graph_mirrors.drop_db(ns, name)
         return NONE
     if kind == "table":
         ns, db = ctx.ns_db()
@@ -391,6 +393,7 @@ def remove_compute(ctx, stm) -> Any:
         pre = keys.table_all_prefix(ns, db, name)
         txn.delr(pre, prefix_end(pre))
         ctx.ds().index_stores.remove_table(ns, db, name)
+        ctx.ds().graph_mirrors.drop_table(ns, db, name)
         return NONE
     if kind == "field":
         ns, db = ctx.ns_db()

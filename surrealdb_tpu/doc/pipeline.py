@@ -369,15 +369,20 @@ def purge_record(ctx, rid: Thing, current: dict) -> None:
         in_v, out_v = current["in"], current["out"]
         txn.delete(keys.graph(ns, db, in_v.tb, in_v.id, keys.DIR_OUT, rid.tb, rid))
         txn.delete(keys.graph(ns, db, out_v.tb, out_v.id, keys.DIR_IN, rid.tb, rid))
+        txn.graph_delta(ns, db, in_v.tb, keys.DIR_OUT, rid.tb, in_v, rid, False)
+        txn.graph_delta(ns, db, out_v.tb, keys.DIR_IN, rid.tb, out_v, rid, False)
+        txn.graph_delta(ns, db, rid.tb, keys.DIR_IN, in_v.tb, rid, in_v, False)
+        txn.graph_delta(ns, db, rid.tb, keys.DIR_OUT, out_v.tb, rid, out_v, False)
         txn.delr(pre, prefix_end(pre))
         return
 
     # node record: every pointer references an edge record — delete those
     # edge records too (graph integrity, reference doc/purge.rs node branch)
     for k in txn.keys(pre, prefix_end(pre)):
-        _, _, ft, fk = keys.decode_graph(k, ns, db, rid.tb)
+        _, d, ft, fk = keys.decode_graph(k, ns, db, rid.tb)
         txn.delete(k)
         if isinstance(fk, Thing):
+            txn.graph_delta(ns, db, rid.tb, d, ft, rid, fk, False)
             edge_doc = txn.get_record(ns, db, fk.tb, fk.id)
             if edge_doc is not None:
                 from surrealdb_tpu.idx.index import index_document
@@ -396,6 +401,11 @@ def store_edges(ctx, edge_rid: Thing, from_t: Thing, to_t: Thing) -> None:
     txn.set(keys.graph(ns, db, edge_rid.tb, edge_rid.id, keys.DIR_IN, from_t.tb, from_t), b"")
     txn.set(keys.graph(ns, db, edge_rid.tb, edge_rid.id, keys.DIR_OUT, to_t.tb, to_t), b"")
     txn.set(keys.graph(ns, db, to_t.tb, to_t.id, keys.DIR_IN, edge_rid.tb, edge_rid), b"")
+    # mirror upkeep: one delta per pointer, applied after commit
+    txn.graph_delta(ns, db, from_t.tb, keys.DIR_OUT, edge_rid.tb, from_t, edge_rid, True)
+    txn.graph_delta(ns, db, edge_rid.tb, keys.DIR_IN, from_t.tb, edge_rid, from_t, True)
+    txn.graph_delta(ns, db, edge_rid.tb, keys.DIR_OUT, to_t.tb, edge_rid, to_t, True)
+    txn.graph_delta(ns, db, to_t.tb, keys.DIR_IN, edge_rid.tb, to_t, edge_rid, True)
 
 
 # ------------------------------------------------------------------ reactions

@@ -1,23 +1,29 @@
-"""Device-resident CSR graph mirror + batched frontier expansion.
+"""Device-resident CSR graph mirrors + batched frontier expansion.
 
 Role of the reference's per-record edge-prefix scans (reference:
 core/src/dbs/processor.rs:610-701 collect_edges, sql/value/get.rs:404-446 —
 hop N over R records ⇒ R separate KV range scans) re-designed TPU-first
-(SURVEY §3.5): the edge keyspace of a table is packed once into CSR arrays
-(indptr/indices) mirrored on device by generation; a multi-hop traversal is
-then H fixed-shape gather kernels with on-device dedup instead of R₁+R₂+…
-pointer chases.
+(SURVEY §3.5): each (src_table, direction, foreign_table) pointer keyspace is
+packed into CSR arrays (indptr/indices) over a node id space shared across
+all mirrors of a database, so a multi-hop idiom like `->knows->person` is a
+sequence of fixed-shape gather kernels with on-device dedup instead of
+R₁+R₂+… pointer chases.
 
-The mirror covers one (table, direction) pair and maps record ids to dense
-ints. `->edge->target` two-segment hops compose: node --OUT--> edge-record
---OUT--> node, i.e. one logical hop = 2 CSR hops (endpoint→edge, edge→endpoint),
-which the builder pre-composes into a node→node CSR per edge table.
+Maintenance is incremental: the base adjacency is built with ONE scan over
+the source table's `~` keyspace (all directions/foreign-tables at once), and
+every committed RELATE/DELETE applies per-edge deltas through the
+transaction's graph-delta buffer (kvs/tx.py) — no corpus rescans on write
+(reference analog: trees/store/cache.rs generation swap, improved). Device
+arrays are recompacted lazily from the host adjacency when dirty; queries
+inside a transaction that has its own uncommitted edge writes fall back to
+the exact KV walk (sql/path.py graph_hop).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Tuple
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -26,160 +32,376 @@ from surrealdb_tpu.key.encode import prefix_end
 from surrealdb_tpu.sql.value import Thing
 
 
-class CsrGraphMirror:
-    """node→node adjacency for one (src_table, edge_table, dir) triple."""
+class NodeInterner:
+    """Thing ↔ dense-int mapping shared by every mirror of one (ns, db)."""
 
-    def __init__(self, src_tb: str, edge_tb: str, direction: bytes):
-        self.src_tb = src_tb
-        self.edge_tb = edge_tb
-        self.direction = direction
-        self.generation = -1
-        self._lock = threading.Lock()
-        # id maps
-        self.id_of: Dict[Tuple[str, str], int] = {}  # (tb, repr(id)) -> int
+    def __init__(self):
+        self.id_of: Dict[Tuple[str, str], int] = {}
         self.node_of: List[Thing] = []
-        self.indptr: Optional[np.ndarray] = None
-        self.indices: Optional[np.ndarray] = None
-        self.edge_of: Optional[np.ndarray] = None  # edge-record int per slot
-        self.max_degree = 0
+        self._lock = threading.Lock()
 
-    def _intern(self, t: Thing) -> int:
+    def __len__(self) -> int:
+        return len(self.node_of)
+
+    def intern(self, t: Thing) -> int:
         k = (t.tb, repr(t.id))
         i = self.id_of.get(k)
         if i is None:
-            i = len(self.node_of)
-            self.id_of[k] = i
-            self.node_of.append(t)
+            with self._lock:
+                i = self.id_of.get(k)
+                if i is None:
+                    i = len(self.node_of)
+                    self.node_of.append(t)
+                    self.id_of[k] = i
         return i
 
     def lookup(self, t: Thing) -> Optional[int]:
         return self.id_of.get((t.tb, repr(t.id)))
 
-    def refresh(self, ctx) -> None:
-        """Rebuild from the KV edge pointers. One scan over the source
-        table's `~` keyspace composes node→edge→node into node→node."""
-        ns, db = ctx.ns_db()
-        txn = ctx.txn()
+
+class PointerCsr:
+    """Adjacency for one (src_tb, direction, foreign_tb) pointer keyspace.
+
+    Host side: `adj` dict of global-int lists — authoritative, updated by
+    deltas. Device side: indptr/indices arrays compacted lazily.
+    """
+
+    def __init__(self, interner: NodeInterner):
+        self.interner = interner
+        self.adj: Dict[int, List[int]] = {}
+        self.dirty = True
+        self.indptr: Optional[np.ndarray] = None
+        self.indices: Optional[np.ndarray] = None
+        self._dev = None  # (jnp indptr, jnp indices) cache
+        self.n_built = 0
+        self.max_degree = 0
+        self._lock = threading.Lock()
+
+    def load(self, adj: Dict[int, List[int]]) -> None:
         with self._lock:
-            self.id_of.clear()
-            self.node_of = []
-            adj: Dict[int, List[Tuple[int, int]]] = {}
+            self.adj = adj
+            self.dirty = True
 
-            # pass 1: node --dir--> edge-record pointers on the source table
-            pre = keys.graph_prefix(ns, db, self.src_tb)
-            node_edges: List[Tuple[int, Thing]] = []
-            for chunk in txn.batch(pre, prefix_end(pre), 2000):
-                for k, _ in chunk:
-                    id_, d, ft, fk = keys.decode_graph(k, ns, db, self.src_tb)
-                    if d != self.direction or ft != self.edge_tb:
-                        continue
-                    src = self._intern(Thing(self.src_tb, id_))
-                    if isinstance(fk, Thing):
-                        node_edges.append((src, fk))
+    def apply(self, src: int, dst: int, add: bool) -> None:
+        """Idempotent delta: pointer keys are unique in KV, so the mirror
+        holds at most one (src, dst) entry per keyspace."""
+        with self._lock:
+            lst = self.adj.setdefault(src, [])
+            if add:
+                if dst not in lst:
+                    lst.append(dst)
+            else:
+                try:
+                    lst.remove(dst)
+                except ValueError:
+                    pass
+                if not lst:
+                    del self.adj[src]
+            self.dirty = True
 
-            # pass 2: edge-record --same dir--> endpoint
-            for src, edge in node_edges:
-                e_int = self._intern(edge)
-                pre2 = keys.graph_prefix(
-                    ns, db, edge.tb, edge.id, self.direction
-                )
-                for k2 in txn.keys(pre2, prefix_end(pre2)):
-                    _, _, _, fk2 = keys.decode_graph(k2, ns, db, edge.tb)
-                    if isinstance(fk2, Thing):
-                        dst = self._intern(fk2)
-                        adj.setdefault(src, []).append((dst, e_int))
-
-            n = len(self.node_of)
-            indptr = np.zeros(n + 1, dtype=np.int32)
-            for src, lst in adj.items():
-                indptr[src + 1] = len(lst)
+    def ensure_arrays(self) -> None:
+        """Compact host adjacency into CSR arrays (numpy only — no KV)."""
+        n = len(self.interner)
+        with self._lock:
+            if not self.dirty and self.n_built == n and self.indptr is not None:
+                return
+            # indptr spans a pow2-padded node capacity and indices a pow2
+            # buffer so XLA kernel shapes stay stable while edges trickle in
+            # (a recompile per RELATE would dwarf the gather itself)
+            cap = _next_pow2(max(n, 1))
+            indptr = np.zeros(cap + 1, dtype=np.int32)
+            for src, lst in self.adj.items():
+                if src < n:
+                    indptr[src + 1] = len(lst)
             self.max_degree = int(indptr.max()) if n else 0
             np.cumsum(indptr, out=indptr)
-            indices = np.zeros(max(int(indptr[-1]), 1), dtype=np.int32)
-            edge_of = np.zeros_like(indices)
+            indices = np.zeros(_next_pow2(max(int(indptr[-1]), 1)), dtype=np.int32)
             fill = indptr[:-1].copy()
-            for src, lst in adj.items():
-                for dst, e_int in lst:
-                    indices[fill[src]] = dst
-                    edge_of[fill[src]] = e_int
-                    fill[src] += 1
+            for src, lst in self.adj.items():
+                if src >= n:
+                    continue
+                k = fill[src]
+                indices[k : k + len(lst)] = lst
             self.indptr = indptr
             self.indices = indices
-            self.edge_of = edge_of
+            self._dev = None
+            self.n_built = n
+            self.dirty = False
 
-    # ------------------------------------------------------------ traversal
-    def hop_batch(self, srcs: List[Thing], want_edges: bool = False) -> List[List[Thing]]:
-        """Expand a batch of source nodes one logical hop. Returns the
-        neighbor list per source (edge records instead when want_edges)."""
-        if self.indptr is None:
-            return [[] for _ in srcs]
-        out: List[List[Thing]] = []
-        for t in srcs:
-            i = self.lookup(t)
-            if i is None or i >= len(self.indptr) - 1:
-                out.append([])
-                continue
-            lo, hi = int(self.indptr[i]), int(self.indptr[i + 1])
-            table = self.edge_of if want_edges else self.indices
-            out.append([self.node_of[int(j)] for j in table[lo:hi]])
-        return out
-
-    def multi_hop_device(self, start: List[Thing], hops: int) -> List[Thing]:
-        """H-hop frontier expansion fully on device (bench/north-star path):
-        fixed-shape gathers + dense-bitmap dedup per hop."""
+    def device_arrays(self):
         import jax.numpy as jnp
-        from surrealdb_tpu.parallel.mesh import dedup_frontier
-        import jax
 
-        if self.indptr is None:
-            return []
-        n = len(self.node_of)
-        ptr = jnp.asarray(self.indptr)
-        idx = jnp.asarray(self.indices)
-        starts = [self.lookup(t) for t in start]
-        starts = [s for s in starts if s is not None]
-        if not starts:
-            return []
-        frontier = jnp.asarray(np.array(starts, dtype=np.int32))
-        mask = jnp.ones_like(frontier, dtype=bool)
-        md = max(self.max_degree, 1)
+        self.ensure_arrays()
+        if self._dev is None:
+            self._dev = (jnp.asarray(self.indptr), jnp.asarray(self.indices))
+        return self._dev
 
-        @jax.jit
-        def one_hop(fr, fm):
-            s = ptr[fr]
-            degs = ptr[fr + 1] - s
-            offs = jnp.arange(md)[None, :]
-            take = jnp.clip(s[:, None] + offs, 0, idx.shape[0] - 1)
-            valid = (offs < degs[:, None]) & fm[:, None]
-            nb = idx[take].reshape(-1)
-            return nb, valid.reshape(-1)
 
-        for _ in range(hops):
-            nodes, m = one_hop(frontier, mask)
-            frontier, mask = dedup_frontier(nodes, m, n)
-        out_idx = np.asarray(frontier)[np.asarray(mask)]
-        return [self.node_of[int(i)] for i in out_idx]
+# ------------------------------------------------------------------ kernels
+def _next_pow2(x: int) -> int:
+    return 1 << max(int(x) - 1, 0).bit_length()
+
+
+_JITTED: dict = {}
+
+
+def _kernels():
+    """Lazily build the jitted hop kernels (keeps jax off the import path)."""
+    if _JITTED:
+        return _JITTED["hop"], _JITTED["dedup"]
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnames=("md",))
+    def gather_hop(ptr, idx, frontier, mask, md):
+        # one CSR gather: frontier [F] ints → neighbor slots [F*md] + validity
+        n = ptr.shape[0] - 1
+        fr = jnp.clip(frontier, 0, jnp.maximum(n - 1, 0))
+        s = ptr[fr]
+        deg = ptr[fr + 1] - s
+        offs = jnp.arange(md)[None, :]
+        take = jnp.clip(s[:, None] + offs, 0, idx.shape[0] - 1)
+        valid = (offs < deg[:, None]) & mask[:, None] & (frontier < n)[:, None]
+        return idx[take].reshape(-1), valid.reshape(-1)
+
+    @partial(jax.jit, static_argnames=("n_nodes", "out_size"))
+    def dedup_cap(nodes, mask, n_nodes, out_size):
+        # dense-bitmap dedup with a capped, jit-static output size
+        marks = jnp.zeros(n_nodes + 1, dtype=jnp.bool_)
+        safe = jnp.where(mask, jnp.clip(nodes, 0, n_nodes), n_nodes)
+        marks = marks.at[safe].set(True)
+        marks = marks.at[n_nodes].set(False)
+        present = jnp.nonzero(marks, size=out_size, fill_value=n_nodes)[0]
+        return present, present < n_nodes
+
+    _JITTED["hop"] = gather_hop
+    _JITTED["dedup"] = dedup_cap
+    return gather_hop, dedup_cap
 
 
 class GraphMirrors:
-    """Per-datastore registry of CSR mirrors keyed by
-    (ns, db, src_tb, edge_tb, dir)."""
+    """Per-datastore registry: (ns, db, src_tb, dir, ft) → PointerCsr, with a
+    shared NodeInterner per (ns, db) so hops compose across tables."""
 
     def __init__(self):
-        self._m: Dict[tuple, CsrGraphMirror] = {}
-        self._lock = threading.Lock()
+        self._interners: Dict[Tuple[str, str], NodeInterner] = {}
+        self._m: Dict[tuple, PointerCsr] = {}
+        self._built: Set[Tuple[str, str, str]] = set()
+        # tables mid-build: deltas committed during the build scan are
+        # buffered here and replayed after load (closes the scan→built gap)
+        self._building: Dict[Tuple[str, str, str], List[tuple]] = {}
+        self._build_locks: Dict[Tuple[str, str, str], threading.Lock] = {}
+        self._lock = threading.RLock()
 
-    def get(self, ctx, src_tb: str, edge_tb: str, direction: bytes) -> CsrGraphMirror:
-        ns, db = ctx.ns_db()
-        k = (ns, db, src_tb, edge_tb, bytes(direction))
+    # ------------------------------------------------------------ plumbing
+    def interner(self, ns: str, db: str) -> NodeInterner:
+        with self._lock:
+            it = self._interners.get((ns, db))
+            if it is None:
+                it = NodeInterner()
+                self._interners[(ns, db)] = it
+            return it
+
+    def _get_or_create(self, ns, db, src_tb, d: bytes, ft: str) -> PointerCsr:
+        k = (ns, db, src_tb, bytes(d), ft)
         with self._lock:
             m = self._m.get(k)
             if m is None:
-                m = CsrGraphMirror(src_tb, edge_tb, direction)
+                m = PointerCsr(self.interner(ns, db))
                 self._m[k] = m
-        return m
+            return m
 
-    def invalidate(self) -> None:
+    def get(self, ns, db, src_tb, d: bytes, ft: str) -> Optional[PointerCsr]:
+        return self._m.get((ns, db, src_tb, bytes(d), ft))
+
+    def table_built(self, ns: str, db: str, src_tb: str) -> bool:
+        return (ns, db, src_tb) in self._built
+
+    def drop_table(self, ns: str, db: str, tb: str) -> None:
+        """Forget a table's mirrors (REMOVE TABLE / bulk invalidation)."""
         with self._lock:
-            for m in self._m.values():
-                m.generation = -1
+            self._built.discard((ns, db, tb))
+            self._building.pop((ns, db, tb), None)
+            for k in [k for k in self._m if k[:3] == (ns, db, tb)]:
+                del self._m[k]
+
+    def drop_db(self, ns: str, db: str) -> None:
+        """Forget everything of one database (REMOVE DATABASE)."""
+        with self._lock:
+            self._built = {k for k in self._built if k[:2] != (ns, db)}
+            self._building = {k: v for k, v in self._building.items() if k[:2] != (ns, db)}
+            for k in [k for k in self._m if k[:2] == (ns, db)]:
+                del self._m[k]
+            self._interners.pop((ns, db), None)
+
+    def drop_ns(self, ns: str) -> None:
+        """Forget everything of one namespace (REMOVE NAMESPACE)."""
+        with self._lock:
+            self._built = {k for k in self._built if k[0] != ns}
+            self._building = {k: v for k, v in self._building.items() if k[0] != ns}
+            for k in [k for k in self._m if k[0] == ns]:
+                del self._m[k]
+            for k in [k for k in self._interners if k[0] == ns]:
+                del self._interners[k]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._m.clear()
+            self._built.clear()
+            self._building.clear()
+            self._interners.clear()
+
+    # ------------------------------------------------------------ build
+    def ensure_table(self, ctx, src_tb: str) -> None:
+        """Build every (dir, ft) mirror of `src_tb` with ONE scan over its
+        `~` pointer keyspace. Deltas committed concurrently with the scan
+        are buffered and replayed afterwards (apply is idempotent), so no
+        committed edge can fall between the scan and the built flag."""
+        ns, db = ctx.ns_db()
+        key3 = (ns, db, src_tb)
+        with self._lock:
+            if key3 in self._built:
+                return
+            bl = self._build_locks.setdefault(key3, threading.Lock())
+        with bl:
+            with self._lock:
+                if key3 in self._built:
+                    return
+                self._building[key3] = []
+            it = self.interner(ns, db)
+            adjs: Dict[Tuple[bytes, str], Dict[int, List[int]]] = {}
+            pre = keys.graph_prefix(ns, db, src_tb)
+            txn = ctx.txn()
+            for chunk in txn.batch(pre, prefix_end(pre), 4096):
+                for k, _ in chunk:
+                    id_, d, ft, fk = keys.decode_graph(k, ns, db, src_tb)
+                    if not isinstance(fk, Thing):
+                        continue
+                    s = it.intern(Thing(src_tb, id_))
+                    t = it.intern(fk)
+                    adjs.setdefault((bytes(d), ft), {}).setdefault(s, []).append(t)
+            with self._lock:
+                for (d, ft), adj in adjs.items():
+                    self._get_or_create(ns, db, src_tb, d, ft).load(adj)
+                pending = self._building.pop(key3, [])
+                for delta in pending:
+                    self._apply_one(delta)
+                self._built.add(key3)
+
+    # ------------------------------------------------------------ deltas
+    def _apply_one(self, delta: tuple) -> None:
+        ns, db, src_tb, d, ft, src, dst, add = delta
+        it = self.interner(ns, db)
+        m = self._get_or_create(ns, db, src_tb, d, ft)
+        m.apply(it.intern(src), it.intern(dst), add)
+
+    def apply_deltas(self, deltas: Sequence[tuple]) -> None:
+        """Apply committed edge-pointer deltas to built (or mid-build)
+        tables. Each delta: (ns, db, src_tb, dir, ft, src, dst, add).
+        Unbuilt tables ignore deltas — their eventual build scan sees the
+        committed KV state anyway.
+        """
+        for delta in deltas:
+            key3 = tuple(delta[:3])
+            with self._lock:
+                if key3 in self._building:
+                    self._building[key3].append(delta)
+                    continue
+                if key3 not in self._built:
+                    continue
+                self._apply_one(delta)
+
+    # ------------------------------------------------------------ traversal
+    def _hop_mirrors(self, ns, db, spec) -> List[PointerCsr]:
+        srcs, dirs, fts = spec
+        out = []
+        for tb in srcs:
+            for d in dirs:
+                for ft in fts:
+                    m = self.get(ns, db, tb, d, ft)
+                    if m is not None and m.adj:
+                        out.append(m)
+        return out
+
+    def _host_hop(self, ns, db, frontier: np.ndarray, spec) -> np.ndarray:
+        out: Set[int] = set()
+        for m in self._hop_mirrors(ns, db, spec):
+            with m._lock:  # deltas may mutate adj lists concurrently
+                for i in frontier.tolist():
+                    out.update(m.adj.get(int(i), ()))
+        return np.fromiter(sorted(out), dtype=np.int32, count=len(out))
+
+    def _device_chain(self, ns, db, frontier: np.ndarray, specs) -> np.ndarray:
+        """Run the remaining hops entirely on device: one upload, H gathers
+        with on-device dedup between hops, one download at the end. Every
+        static dimension (frontier size, max degree, node capacity, dedup
+        output) is pow2-rounded so steady writes don't recompile."""
+        import jax.numpy as jnp
+
+        gather_hop, dedup_cap = _kernels()
+        it = self.interner(ns, db)
+        n_cap = _next_pow2(len(it))
+        fsz = _next_pow2(frontier.size)
+        fr = np.full(fsz, n_cap, dtype=np.int32)
+        fr[: frontier.size] = frontier
+        frj = jnp.asarray(fr)
+        maskj = jnp.asarray(fr < n_cap)
+        for spec in specs:
+            pieces, masks = [], []
+            for m in self._hop_mirrors(ns, db, spec):
+                ptr, idx = m.device_arrays()
+                md = _next_pow2(max(m.max_degree, 1))
+                nodes, valid = gather_hop(ptr, idx, frj, maskj, md=md)
+                pieces.append(nodes)
+                masks.append(valid)
+            if not pieces:
+                return np.empty(0, dtype=np.int32)
+            allnodes = jnp.concatenate(pieces) if len(pieces) > 1 else pieces[0]
+            allmask = jnp.concatenate(masks) if len(masks) > 1 else masks[0]
+            out_size = _next_pow2(min(int(allnodes.shape[0]), n_cap))
+            frj, maskj = dedup_cap(allnodes, allmask, n_nodes=n_cap, out_size=out_size)
+        u = np.asarray(frj)
+        return u[np.asarray(maskj)].astype(np.int32)
+
+    def chain(
+        self,
+        ctx,
+        start: List[Thing],
+        parts: List,  # List[PGraph]
+    ) -> List[Thing]:
+        """Run a maximal chain of cond-free graph parts `->a->b->c` as
+        batched frontier hops: host adjacency while the frontier is small,
+        then the rest of the chain on device once it crosses
+        TPU_GRAPH_ONDEVICE_THRESHOLD.
+
+        Result order is deterministic (ascending intern order ≈ build-scan
+        key order, with delta-added nodes after) but not identical to the
+        KV walk's key order; graph hop ordering is unspecified upstream.
+        """
+        from surrealdb_tpu import cnf
+
+        ns, db = ctx.ns_db()
+        it = self.interner(ns, db)
+        dir_map = {"out": [keys.DIR_OUT], "in": [keys.DIR_IN], "both": [keys.DIR_IN, keys.DIR_OUT]}
+        # pre-resolve hop specs; a hop filtered on foreign-table ft lands
+        # entirely in table ft, so the next hop's sources are exactly p.what
+        tables = {t.tb for t in start}
+        specs = []
+        for p in parts:
+            for tb in tables:
+                self.ensure_table(ctx, tb)
+            specs.append((sorted(tables), dir_map[p.dir], p.what))
+            tables = set(p.what)
+        uniq = {i for i in (it.lookup(t) for t in start) if i is not None}
+        frontier = np.fromiter(sorted(uniq), dtype=np.int32, count=len(uniq))
+        i = 0
+        while i < len(specs):
+            if (
+                not cnf.TPU_DISABLE
+                and frontier.size >= cnf.TPU_GRAPH_ONDEVICE_THRESHOLD
+            ):
+                frontier = self._device_chain(ns, db, frontier, specs[i:])
+                break
+            frontier = self._host_hop(ns, db, frontier, specs[i])
+            i += 1
+        return [it.node_of[int(j)] for j in frontier]

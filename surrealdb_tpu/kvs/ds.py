@@ -27,8 +27,10 @@ class Datastore:
         self.node_id = _uuid.uuid4()
         # device-resident index mirrors (vector / graph / ft columnar snapshots)
         from surrealdb_tpu.idx.store import IndexStores
+        from surrealdb_tpu.idx.graph_csr import GraphMirrors
 
         self.index_stores = IndexStores()
+        self.graph_mirrors = GraphMirrors()
         # live queries: uuid(hex) -> LiveSubscription (registered in M10)
         self.notifications = None  # set by enable_notifications()
         self.auth_enabled = False
@@ -46,7 +48,9 @@ class Datastore:
 
     # ------------------------------------------------------------ txns
     def transaction(self, write: bool = False) -> Transaction:
-        return Transaction(self.backend.transaction(write), self.oracle, self.clock)
+        return Transaction(
+            self.backend.transaction(write), self.oracle, self.clock, self.graph_mirrors
+        )
 
     # ------------------------------------------------------------ notifications
     def enable_notifications(self) -> None:

@@ -22,19 +22,31 @@ from .vs import Oracle
 
 
 class Transaction:
-    def __init__(self, backend: BackendTransaction, oracle: Oracle, clock):
+    def __init__(self, backend: BackendTransaction, oracle: Oracle, clock, graph_mirrors=None):
         self.tr = backend
         self.oracle = oracle
         self.clock = clock
         self.cache: Dict[bytes, Any] = {}
         # changefeed buffer: (ns, db, tb) -> list of mutation dicts
         self.cf_buffer: Dict[Tuple[str, str, str], List[dict]] = {}
+        # edge-pointer deltas buffered until commit, then applied to the
+        # shared CSR graph mirrors (incremental maintenance — idx/graph_csr.py);
+        # a cancelled transaction never touches the mirrors
+        self.graph_deltas: List[tuple] = []
+        self._graph_mirrors = graph_mirrors
         self.write = backend.write
 
     # ------------------------------------------------------------ lifecycle
     def commit(self) -> None:
         self.complete_changes()
         self.tr.commit()
+        if self.graph_deltas and self._graph_mirrors is not None:
+            self._graph_mirrors.apply_deltas(self.graph_deltas)
+            self.graph_deltas = []
+
+    def graph_delta(self, ns, db, src_tb, d: bytes, ft: str, src, dst, add: bool) -> None:
+        """Record one edge-pointer mutation for post-commit mirror upkeep."""
+        self.graph_deltas.append((ns, db, src_tb, bytes(d), ft, src, dst, add))
 
     def cancel(self) -> None:
         self.tr.cancel()

@@ -410,7 +410,34 @@ def _prefix_end(p: bytes) -> bytes:
     return prefix_end(p)
 
 
+def _mirror_eligible(ctx, p: PGraph) -> bool:
+    """A hop can ride the CSR mirrors when its edge tables are named, it has
+    no per-record WHERE, and this transaction has no uncommitted edge writes
+    (those are only visible to the exact KV walk)."""
+    if p.cond is not None or not p.what:
+        return False
+    try:
+        return ctx.ds() is not None and not ctx.txn().graph_deltas
+    except Exception:
+        return False
+
+
 def _graph_part(ctx, things: List[Thing], p: PGraph, rest: List[Part]):
+    # batched frontier path: a maximal run of eligible graph parts becomes a
+    # chain of CSR gather hops (device above TPU_GRAPH_ONDEVICE_THRESHOLD)
+    # instead of per-record `~` prefix scans (reference processor.rs:610-701)
+    if things and _mirror_eligible(ctx, p):
+        chain = [p]
+        i = 0
+        while (
+            i < len(rest)
+            and isinstance(rest[i], PGraph)
+            and _mirror_eligible(ctx, rest[i])
+        ):
+            chain.append(rest[i])
+            i += 1
+        found = ctx.ds().graph_mirrors.chain(ctx, things, chain)
+        return get_path(ctx, found, rest[i:])
     found = graph_hop(ctx, things, p.dir, p.what)
     if p.cond is not None:
         kept = []

@@ -38,13 +38,18 @@ EXT_TABLE = 8
 EXT_PYOBJ = 32  # AST nodes inside catalog definitions (Kind, Expr, ...)
 
 
-def _default(v: Any):
+def _default(v: Any, packer=None):
+    # `packer` encodes nested container payloads (Thing ids, Geometry coords,
+    # Range bounds) and must stay the SAME codec as the outer encode — if the
+    # wire codec nested through the trusted one, an engine-internal object
+    # hidden inside a Thing id would still be pickled onto the wire.
+    packer = packer or pack
     if is_none(v):
         return msgpack.ExtType(EXT_NONE, b"")
     if is_null(v):
         return None  # NULL round-trips as msgpack nil
     if isinstance(v, Thing):
-        return msgpack.ExtType(EXT_THING, pack({"tb": v.tb, "id": v.id}))
+        return msgpack.ExtType(EXT_THING, packer({"tb": v.tb, "id": v.id}))
     if isinstance(v, Duration):
         return msgpack.ExtType(EXT_DURATION, msgpack.packb(v.nanos))
     if isinstance(v, Datetime):
@@ -54,11 +59,11 @@ def _default(v: Any):
     if isinstance(v, _uuid.UUID):
         return msgpack.ExtType(EXT_UUID, v.bytes)
     if isinstance(v, Geometry):
-        return msgpack.ExtType(EXT_GEOMETRY, pack({"k": v.kind, "c": v.coords}))
+        return msgpack.ExtType(EXT_GEOMETRY, packer({"k": v.kind, "c": v.coords}))
     if isinstance(v, Range):
         return msgpack.ExtType(
             EXT_RANGE,
-            pack({"b": v.beg, "e": v.end, "bi": v.beg_incl, "ei": v.end_incl}),
+            packer({"b": v.beg, "e": v.end, "bi": v.beg_incl, "ei": v.end_incl}),
         )
     if isinstance(v, Table):
         return msgpack.ExtType(EXT_TABLE, str(v).encode())
@@ -117,10 +122,11 @@ def _wire_ext_hook(code: int, data: bytes):
 
 
 def _wire_default(v: Any):
-    # Network-facing encode: never pickle engine internals onto the wire.
-    # Anything the storage codec would pickle is degraded to its SurrealQL
-    # string form so msgpack clients always receive decodable frames.
-    out = _default(v)
+    # Network-facing encode: never pickle engine internals onto the wire —
+    # at any nesting depth. Anything the storage codec would pickle is
+    # degraded to its SurrealQL string form so msgpack clients always
+    # receive decodable frames.
+    out = _default(v, packer=wire_pack)
     if isinstance(out, msgpack.ExtType) and out.code == EXT_PYOBJ:
         return repr(v)
     return out
