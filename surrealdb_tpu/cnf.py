@@ -51,6 +51,8 @@ TPU_VECTOR_DTYPE = os.environ.get("SURREAL_TPU_VECTOR_DTYPE", "bfloat16")
 TPU_KNN_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_KNN_ONDEVICE_THRESHOLD", 4096)
 TPU_FT_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_FT_ONDEVICE_THRESHOLD", 4096)
 TPU_GRAPH_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_GRAPH_ONDEVICE_THRESHOLD", 2048)
+# corpus size at which `<|k|>` switches from exact search to the IVF ANN
+TPU_ANN_MIN_ROWS = _env_int("SURREAL_TPU_ANN_MIN_ROWS", 8192)
 TPU_DISABLE = _env_bool("SURREAL_TPU_DISABLE", False)
 
 # Changefeeds

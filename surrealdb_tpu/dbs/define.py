@@ -370,7 +370,8 @@ def remove_compute(ctx, stm) -> Any:
         txn.del_ns(name)
         pre = keys._ns(name)
         txn.delr(pre, prefix_end(pre))
-        ctx.ds().graph_mirrors.drop_ns(name)
+        ds = ctx.ds()
+        txn.on_commit(lambda: ds.graph_mirrors.drop_ns(name))
         return NONE
     if kind == "database":
         ns = ctx.session.ns
@@ -381,7 +382,8 @@ def remove_compute(ctx, stm) -> Any:
         txn.del_db(ns, name)
         pre = keys._db(ns, name)
         txn.delr(pre, prefix_end(pre))
-        ctx.ds().graph_mirrors.drop_db(ns, name)
+        ds = ctx.ds()
+        txn.on_commit(lambda: ds.graph_mirrors.drop_db(ns, name))
         return NONE
     if kind == "table":
         ns, db = ctx.ns_db()
@@ -392,8 +394,9 @@ def remove_compute(ctx, stm) -> Any:
         txn.del_tb(ns, db, name)
         pre = keys.table_all_prefix(ns, db, name)
         txn.delr(pre, prefix_end(pre))
-        ctx.ds().index_stores.remove_table(ns, db, name)
-        ctx.ds().graph_mirrors.drop_table(ns, db, name)
+        ds = ctx.ds()
+        txn.on_commit(lambda: ds.index_stores.remove_table(ns, db, name))
+        txn.on_commit(lambda: ds.graph_mirrors.drop_table(ns, db, name))
         return NONE
     if kind == "field":
         ns, db = ctx.ns_db()
@@ -410,7 +413,8 @@ def remove_compute(ctx, stm) -> Any:
         txn.del_tb_index(ns, db, stm.table, name)
         pre = keys.index_prefix(ns, db, stm.table, name)
         txn.delr(pre, prefix_end(pre))
-        ctx.ds().index_stores.remove(ns, db, stm.table, name)
+        ds = ctx.ds()
+        txn.on_commit(lambda: ds.index_stores.remove(ns, db, stm.table, name))
         return NONE
     if kind == "event":
         ns, db = ctx.ns_db()

@@ -30,6 +30,7 @@ import numpy as np
 from surrealdb_tpu import key as keys
 from surrealdb_tpu.key.encode import prefix_end
 from surrealdb_tpu.sql.value import Thing
+from surrealdb_tpu.utils.num import next_pow2 as _next_pow2
 
 
 class NodeInterner:
@@ -138,10 +139,6 @@ class PointerCsr:
 
 
 # ------------------------------------------------------------------ kernels
-def _next_pow2(x: int) -> int:
-    return 1 << max(int(x) - 1, 0).bit_length()
-
-
 _JITTED: dict = {}
 
 
@@ -254,9 +251,12 @@ class GraphMirrors:
     # ------------------------------------------------------------ build
     def ensure_table(self, ctx, src_tb: str) -> None:
         """Build every (dir, ft) mirror of `src_tb` with ONE scan over its
-        `~` pointer keyspace. Deltas committed concurrently with the scan
-        are buffered and replayed afterwards (apply is idempotent), so no
-        committed edge can fall between the scan and the built flag."""
+        `~` pointer keyspace. The scan runs on a FRESH snapshot opened after
+        delta-buffering starts, so (a) deltas committed concurrently with
+        the scan are buffered and replayed afterwards (apply is idempotent)
+        and no committed edge can fall between the scan and the built flag,
+        and (b) the querying transaction's own uncommitted writes never
+        leak into the shared mirror (they force the exact KV walk anyway)."""
         ns, db = ctx.ns_db()
         key3 = (ns, db, src_tb)
         with self._lock:
@@ -271,15 +271,18 @@ class GraphMirrors:
             it = self.interner(ns, db)
             adjs: Dict[Tuple[bytes, str], Dict[int, List[int]]] = {}
             pre = keys.graph_prefix(ns, db, src_tb)
-            txn = ctx.txn()
-            for chunk in txn.batch(pre, prefix_end(pre), 4096):
-                for k, _ in chunk:
-                    id_, d, ft, fk = keys.decode_graph(k, ns, db, src_tb)
-                    if not isinstance(fk, Thing):
-                        continue
-                    s = it.intern(Thing(src_tb, id_))
-                    t = it.intern(fk)
-                    adjs.setdefault((bytes(d), ft), {}).setdefault(s, []).append(t)
+            txn = ctx.ds().transaction(False)
+            try:
+                for chunk in txn.batch(pre, prefix_end(pre), 4096):
+                    for k, _ in chunk:
+                        id_, d, ft, fk = keys.decode_graph(k, ns, db, src_tb)
+                        if not isinstance(fk, Thing):
+                            continue
+                        s = it.intern(Thing(src_tb, id_))
+                        t = it.intern(fk)
+                        adjs.setdefault((bytes(d), ft), {}).setdefault(s, []).append(t)
+            finally:
+                txn.cancel()
             with self._lock:
                 for (d, ft), adj in adjs.items():
                     self._get_or_create(ns, db, src_tb, d, ft).load(adj)

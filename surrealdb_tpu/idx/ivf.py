@@ -1,0 +1,255 @@
+"""IVF (inverted-file) ANN index — the TPU-native answer to HNSW/MTree.
+
+Role of the reference's graph ANN structures (reference:
+core/src/idx/trees/hnsw/mod.rs:337-416 layered beam search, trees/mtree.rs:135
+ball-tree kNN) re-designed TPU-first: pointer-chasing beam searches are a poor
+fit for the MXU, so `DEFINE INDEX … HNSW|MTREE` executes as a ScaNN-style
+IVF: a k-means coarse quantizer (trained on device, MXU matmuls) partitions
+the corpus into C lists; a query probes the nprobe nearest lists and exactly
+reranks only their members — one fused gather + distance-matmul + top-k
+kernel. Sublinear work (nprobe/C of the corpus), tunable recall via the
+operator's ef (reference `<|k,ef|>` Ann operator, sql/operator.rs:65).
+
+Quality floors are asserted by recall-vs-brute-force tests
+(tests/test_ivf.py), mirroring the reference's hnsw recall suite
+(trees/hnsw/mod.rs:828-951).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from surrealdb_tpu.ops import distances as D
+from surrealdb_tpu.utils.num import next_pow2 as _next_pow2
+
+# metrics whose geometry the coarse quantizer can probe directly; the rest
+# probe in euclidean space and rely on exact rerank for the final order
+_PROBE_METRICS = {"euclidean", "cosine", "manhattan", "chebyshev"}
+
+
+def default_nlists(n: int) -> int:
+    """C ≈ sqrt(N), pow2-clamped to [8, 4096]."""
+    return min(max(_next_pow2(int(math.sqrt(max(n, 1)))), 8), 4096)
+
+
+def default_nprobe(nlists: int, ef: Optional[int]) -> int:
+    """Map the HNSW-style ef beam width onto probed-list count. With
+    balanced lists each probe examines ~2·N/C candidates, so ef/10 probes
+    lands near the reference's beam-width semantics (search ef=80 → 8
+    probes ≈ 99% recall on clustered data, see tests/test_ivf.py)."""
+    if ef is not None and ef > 0:
+        return min(max(4, round(ef / 10)), nlists)
+    return min(max(4, nlists // 16), nlists)
+
+
+@functools.partial(jax.jit, static_argnames=("k_assign",))
+def _assign_chunk(chunk, cents, k_assign=1):
+    """Nearest-centroid assignment for one corpus tile (euclidean)."""
+    import jax.numpy as jnp
+
+    d = D.pairwise_distance(chunk, cents, "euclidean")
+    if k_assign == 1:
+        return jnp.argmin(d, axis=1)
+    return jax.lax.top_k(-d, k_assign)[1]
+
+
+def _kmeans(x: np.ndarray, nlists: int, iters: int = 8, seed: int = 7) -> np.ndarray:
+    """Device k-means on a training subsample; returns [C, D] centroids."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    n = x.shape[0]
+    train_n = min(n, max(nlists * 64, 16384))
+    sample = x[rng.choice(n, size=train_n, replace=False)] if train_n < n else x
+    cents = jnp.asarray(sample[rng.choice(train_n, size=nlists, replace=False)])
+    xs = jnp.asarray(sample)
+
+    @jax.jit
+    def step(c):
+        d = D.pairwise_distance(xs, c, "euclidean")
+        a = jnp.argmin(d, axis=1)
+        sums = jax.ops.segment_sum(xs.astype(jnp.float32), a, num_segments=nlists)
+        cnts = jax.ops.segment_sum(jnp.ones(xs.shape[0], jnp.float32), a, num_segments=nlists)
+        # empty clusters keep their previous centroid
+        return jnp.where(cnts[:, None] > 0, sums / jnp.maximum(cnts[:, None], 1.0), c)
+
+    for _ in range(iters):
+        cents = step(cents)
+    return np.asarray(cents, dtype=np.float32)
+
+
+def _full_assign(
+    x: np.ndarray, cents: np.ndarray, chunk: int = 65536, k_assign: int = 1
+) -> np.ndarray:
+    """Assign every corpus row to its k nearest centroids, tiled so the
+    [N, C] distance matrix never materializes whole."""
+    import jax.numpy as jnp
+
+    cj = jnp.asarray(cents)
+    shape = (x.shape[0],) if k_assign == 1 else (x.shape[0], k_assign)
+    out = np.empty(shape, dtype=np.int32)
+    for lo in range(0, x.shape[0], chunk):
+        hi = min(lo + chunk, x.shape[0])
+        tile = x[lo:hi]
+        pad = chunk - (hi - lo)
+        if pad:
+            tile = np.concatenate([tile, np.zeros((pad, x.shape[1]), x.dtype)])
+        a = np.asarray(_assign_chunk(jnp.asarray(tile), cj, k_assign=k_assign))
+        out[lo:hi] = a[: hi - lo]
+    return out
+
+
+class IvfState:
+    """Trained quantizer + inverted lists over mirror row slots.
+
+    Host-authoritative: `lists` maps centroid → row slots; device arrays are
+    compacted lazily (numpy only — never a KV rescan). Incremental adds
+    assign to the nearest existing centroid; retrain happens when the corpus
+    outgrows the trained size by 50%.
+    """
+
+    def __init__(self, centroids: np.ndarray, lists: List[List[int]], trained_n: int):
+        self.centroids = centroids  # [C, D] float32
+        self.lists = lists  # C lists of row slots
+        self.slot_list: Dict[int, int] = {s: i for i, l in enumerate(lists) for s in l}
+        self.trained_n = trained_n
+        self.dirty = True
+        self._dev = None  # (cents, list_rows, list_mask)
+
+    @property
+    def nlists(self) -> int:
+        return self.centroids.shape[0]
+
+    # ------------------------------------------------------------ build
+    @staticmethod
+    def train(data: np.ndarray, alive: np.ndarray, nlists: Optional[int] = None) -> "IvfState":
+        rows = np.nonzero(alive)[0]
+        x = np.ascontiguousarray(data[rows], dtype=np.float32)
+        c = nlists or default_nlists(rows.size)
+        cents = _kmeans(x, c)
+        # balanced assignment: top-2 candidate cells with spill to the
+        # runner-up once the nearest is over 2x the mean size — bounds the
+        # padded gather at ~2·N/C per probe instead of the worst cell
+        assign2 = _full_assign(x, cents, k_assign=2)
+        cap = max(2 * (rows.size + c - 1) // c, 8)
+        lists: List[List[int]] = [[] for _ in range(c)]
+        for slot, (a1, a2) in zip(rows.tolist(), assign2.tolist()):
+            a = a1 if len(lists[a1]) < cap or len(lists[a2]) >= len(lists[a1]) else a2
+            lists[int(a)].append(slot)
+        return IvfState(cents, lists, rows.size)
+
+    # ------------------------------------------------------------ writes
+    def add(self, slot: int, vec: np.ndarray) -> None:
+        d2 = ((self.centroids - vec[None, :]) ** 2).sum(1)
+        a1, a2 = np.argpartition(d2, 1)[:2]
+        cap = max(2 * (self.size() // max(self.nlists, 1) + 1), 8)
+        a = int(a1) if len(self.lists[a1]) < cap or len(self.lists[a2]) >= len(self.lists[a1]) else int(a2)
+        self.lists[a].append(slot)
+        self.slot_list[slot] = a
+        self.dirty = True
+
+    def remove(self, slot: int, vec: np.ndarray) -> None:
+        a = self.slot_list.pop(slot, None)
+        if a is not None:
+            try:
+                self.lists[a].remove(slot)
+            except ValueError:
+                pass
+        self.dirty = True
+
+    def size(self) -> int:
+        return sum(len(l) for l in self.lists)
+
+    def needs_retrain(self) -> bool:
+        return self.size() > 1.5 * max(self.trained_n, 1)
+
+    # ------------------------------------------------------------ search
+    def _device(self):
+        import jax.numpy as jnp
+
+        if not self.dirty and self._dev is not None:
+            return self._dev
+        c = self.nlists
+        maxlen = _next_pow2(max(max((len(l) for l in self.lists), default=1), 1))
+        list_rows = np.zeros((c, maxlen), dtype=np.int32)
+        list_mask = np.zeros((c, maxlen), dtype=bool)
+        for i, l in enumerate(self.lists):
+            list_rows[i, : len(l)] = l
+            list_mask[i, : len(l)] = True
+        self._dev = (
+            jnp.asarray(self.centroids),
+            jnp.asarray(list_rows),
+            jnp.asarray(list_mask),
+        )
+        self.dirty = False
+        return self._dev
+
+    def search(
+        self, q: np.ndarray, matrix, metric: str, k: int, nprobe: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Probe nprobe lists, exact-rerank their members on device.
+
+        q: [D] query; matrix: device [N*, D] mirror matrix.
+        Returns (dists [k], row slots [k]); misses surface as +inf/-1.
+        """
+        d, r = self.search_batch(q[None, :], matrix, metric, k, nprobe)
+        return d[0], r[0]
+
+    def search_batch(
+        self, qs: np.ndarray, matrix, metric: str, k: int, nprobe: int, tile: int = 64
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Batched probe+rerank: qs [Q, D] → (dists [Q, k], slots [Q, k]).
+
+        Queries are tiled so the [tile, nprobe·L, D] candidate gather stays
+        within memory; each tile is ONE device dispatch (the cross-query
+        batching seam — amortizes dispatch latency across queries).
+        """
+        import jax.numpy as jnp
+
+        cents, list_rows, list_mask = self._device()
+        probe_metric = metric if metric in _PROBE_METRICS else "euclidean"
+        nprobe = min(nprobe, self.nlists)
+        # the kernel can return at most nprobe·L candidates per query
+        k = min(k, nprobe * int(list_rows.shape[1]))
+        qs = np.asarray(qs, dtype=np.float32)
+        dd = np.empty((qs.shape[0], k), dtype=np.float32)
+        rr = np.empty((qs.shape[0], k), dtype=np.int64)
+        for lo in range(0, qs.shape[0], tile):
+            hi = min(lo + tile, qs.shape[0])
+            qt = qs[lo:hi]
+            pad = tile - (hi - lo)
+            if pad:
+                qt = np.concatenate([qt, np.zeros((pad, qs.shape[1]), np.float32)])
+            d, r = _ivf_search(
+                jnp.asarray(qt), cents, list_rows, list_mask, matrix,
+                metric=metric, probe_metric=probe_metric, k=k, nprobe=nprobe,
+            )
+            dd[lo:hi] = np.asarray(d)[: hi - lo]
+            rr[lo:hi] = np.asarray(r)[: hi - lo]
+        return dd, rr
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "probe_metric", "k", "nprobe"))
+def _ivf_search(q, cents, list_rows, list_mask, x, metric, probe_metric, k, nprobe):
+    """q [Q, D] → (dists [Q, k], row slots [Q, k]); vmapped per query."""
+    import jax.numpy as jnp
+
+    dc = D.pairwise_distance(q, cents, probe_metric)  # [Q, C]
+    probes = jax.lax.top_k(-dc, nprobe)[1]  # [Q, nprobe]
+
+    def one(qi, pr):
+        rows = list_rows[pr].reshape(-1)  # [nprobe*L]
+        mask = list_mask[pr].reshape(-1)
+        cand = x[jnp.clip(rows, 0, x.shape[0] - 1)]  # gather [nprobe*L, D]
+        d = D.pairwise_distance(qi[None, :], cand, metric)[0]
+        d = jnp.where(mask, d, jnp.inf)
+        kk = min(k, int(rows.shape[0]))
+        neg, idx = jax.lax.top_k(-d, kk)
+        return -neg, jnp.where(neg > -jnp.inf, rows[idx], -1)
+
+    return jax.vmap(one)(q, probes)

@@ -19,7 +19,6 @@ from surrealdb_tpu.sql.value import Thing, is_nullish
 from surrealdb_tpu.utils.ser import pack, unpack
 
 _ROW = b"v"  # per-record vector row
-_GEN = b"g"  # state generation counter
 
 
 def check_vector(ix: dict, val: Any) -> Optional[List[float]]:
@@ -45,18 +44,6 @@ def _row_key(ns, db, tb, name, rid: Thing) -> bytes:
     return keys.index_state(ns, db, tb, name, _ROW + enc_value_key(rid))
 
 
-def bump_generation(txn, ns, db, tb, name) -> None:
-    k = keys.index_state(ns, db, tb, name, _GEN)
-    raw = txn.get(k)
-    gen = (unpack(raw) if raw is not None else 0) + 1
-    txn.set(k, pack(gen))
-
-
-def read_generation(txn, ns, db, tb, name) -> int:
-    raw = txn.get(keys.index_state(ns, db, tb, name, _GEN))
-    return unpack(raw) if raw is not None else 0
-
-
 def update_vector_index(ctx, ix: dict, rid: Thing, old_vals, new_vals) -> None:
     ns, db = ctx.ns_db()
     txn = ctx.txn()
@@ -70,7 +57,9 @@ def update_vector_index(ctx, ix: dict, rid: Thing, old_vals, new_vals) -> None:
         txn.delete(k)
     else:
         txn.set(k, pack(new_vec))
-    bump_generation(txn, ns, db, tb, name)
+    # buffered mirror delta, applied on commit (idx/knn.py VectorMirror);
+    # a cancelled transaction never touches the shared mirror
+    txn.vector_delta(ns, db, tb, name, rid, new_vec)
 
 
 def scan_vectors(txn, ns, db, tb, name):

@@ -48,9 +48,11 @@ class Datastore:
 
     # ------------------------------------------------------------ txns
     def transaction(self, write: bool = False) -> Transaction:
-        return Transaction(
+        txn = Transaction(
             self.backend.transaction(write), self.oracle, self.clock, self.graph_mirrors
         )
+        txn._index_stores = self.index_stores
+        return txn
 
     # ------------------------------------------------------------ notifications
     def enable_notifications(self) -> None:

@@ -29,11 +29,17 @@ class Transaction:
         self.cache: Dict[bytes, Any] = {}
         # changefeed buffer: (ns, db, tb) -> list of mutation dicts
         self.cf_buffer: Dict[Tuple[str, str, str], List[dict]] = {}
-        # edge-pointer deltas buffered until commit, then applied to the
-        # shared CSR graph mirrors (incremental maintenance — idx/graph_csr.py);
-        # a cancelled transaction never touches the mirrors
+        # index-mirror deltas buffered until commit, then applied to the
+        # shared device mirrors (incremental maintenance — idx/graph_csr.py,
+        # idx/knn.py); a cancelled transaction never touches the mirrors
         self.graph_deltas: List[tuple] = []
+        self.vector_deltas: List[tuple] = []
         self._graph_mirrors = graph_mirrors
+        self._index_stores = None  # set by Datastore.transaction
+        # callbacks run strictly after a successful commit (mirror drops on
+        # REMOVE …— running them at statement time would let a concurrent
+        # rebuild resurrect state the uncommitted delete was about to erase)
+        self._on_commit: List = []
         self.write = backend.write
 
     # ------------------------------------------------------------ lifecycle
@@ -43,10 +49,28 @@ class Transaction:
         if self.graph_deltas and self._graph_mirrors is not None:
             self._graph_mirrors.apply_deltas(self.graph_deltas)
             self.graph_deltas = []
+        if self.vector_deltas and self._index_stores is not None:
+            for ns, db, tb, name, rid, vec in self.vector_deltas:
+                mirror = self._index_stores.get(ns, db, tb, name)
+                if mirror is not None and hasattr(mirror, "apply"):
+                    # apply() buffers during a build and no-ops when unbuilt
+                    mirror.apply(rid, vec)
+            self.vector_deltas = []
+        for fn in self._on_commit:
+            fn()
+        self._on_commit = []
+
+    def on_commit(self, fn) -> None:
+        """Defer a side effect until this transaction has committed."""
+        self._on_commit.append(fn)
 
     def graph_delta(self, ns, db, src_tb, d: bytes, ft: str, src, dst, add: bool) -> None:
         """Record one edge-pointer mutation for post-commit mirror upkeep."""
         self.graph_deltas.append((ns, db, src_tb, bytes(d), ft, src, dst, add))
+
+    def vector_delta(self, ns, db, tb, name, rid, vec) -> None:
+        """Record one vector-row mutation for post-commit mirror upkeep."""
+        self.vector_deltas.append((ns, db, tb, name, rid, vec))
 
     def cancel(self) -> None:
         self.tr.cancel()

@@ -1,0 +1,7 @@
+"""Shared numeric helpers for device-shape padding."""
+
+
+def next_pow2(x: int) -> int:
+    """Smallest power of two >= x (>=1). All mirror/kernel static dims round
+    through this so steady writes never change compiled shapes."""
+    return 1 << max(int(x) - 1, 0).bit_length()

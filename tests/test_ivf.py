@@ -1,0 +1,162 @@
+"""IVF ANN index tests: recall floors vs brute force (reference:
+core/src/idx/trees/hnsw/mod.rs:828-951 recall suite), SQL-level execution
+through the planner, incremental mirror maintenance, and in-transaction
+overlay semantics."""
+
+import numpy as np
+import pytest
+
+
+def _mixture(n, d, clusters=32, seed=3):
+    """Gaussian-mixture corpus — the shape real embedding sets have."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(clusters, d)).astype(np.float32) * 4.0
+    assign = rng.integers(0, clusters, size=n)
+    return centers[assign] + rng.normal(size=(n, d)).astype(np.float32)
+
+
+def _brute(q, x, k):
+    d = ((x - q[None, :]) ** 2).sum(1)
+    return set(np.argsort(d)[:k].tolist())
+
+
+def test_ivf_recall_floor():
+    from surrealdb_tpu.idx.ivf import IvfState, default_nprobe
+
+    n, d, k = 20000, 32, 10
+    x = _mixture(n, d)
+    alive = np.ones(n, dtype=bool)
+    ivf = IvfState.train(x, alive)
+    import jax.numpy as jnp
+
+    mat = jnp.asarray(x)
+    nprobe = default_nprobe(ivf.nlists, 150)
+    rng = np.random.default_rng(11)
+    hits = total = 0
+    for qi in rng.integers(0, n, size=50):
+        q = x[qi]
+        dists, slots = ivf.search(q, mat, "euclidean", k, nprobe)
+        got = {int(s) for s, dd in zip(slots, dists) if s >= 0 and np.isfinite(dd)}
+        want = _brute(q, x, k)
+        hits += len(got & want)
+        total += k
+    recall = hits / total
+    assert recall >= 0.9, f"recall@10 = {recall:.3f} < 0.9"
+    # sublinear: candidates examined ≤ nprobe/nlists of the corpus (+ padding)
+    maxlen = max(len(l) for l in ivf.lists)
+    assert nprobe * maxlen < n, "IVF probes the whole corpus"
+
+
+def test_ivf_self_hit():
+    """Every corpus point must find itself at distance 0."""
+    from surrealdb_tpu.idx.ivf import IvfState
+
+    x = _mixture(5000, 16, seed=5)
+    ivf = IvfState.train(x, np.ones(len(x), dtype=bool))
+    import jax.numpy as jnp
+
+    mat = jnp.asarray(x)
+    rng = np.random.default_rng(2)
+    for qi in rng.integers(0, len(x), size=20):
+        dists, slots = ivf.search(x[qi], mat, "euclidean", 1, max(ivf.nlists // 8, 1))
+        # f32 matmul-decomposed euclidean has ~1e-2 noise at these norms
+        assert int(slots[0]) == qi and dists[0] < 0.1
+
+
+@pytest.fixture()
+def vec_ds(ds):
+    ds.execute("DEFINE INDEX v ON item FIELDS emb HNSW DIMENSION 8 DIST EUCLIDEAN;")
+    rng = np.random.default_rng(9)
+    x = _mixture(300, 8, clusters=8, seed=9)
+    stmts = [
+        f"CREATE item:{i} SET emb = [{', '.join(f'{v:.5f}' for v in row)}]"
+        for i, row in enumerate(x)
+    ]
+    ds.execute(";".join(stmts))
+    return ds, x
+
+
+def _knn_ids(ds, q, k=5, ef=None):
+    qs = "[" + ", ".join(f"{v:.5f}" for v in q) + "]"
+    op = f"<|{k},{ef}|>" if ef else f"<|{k}|>"
+    out = ds.execute(f"SELECT VALUE id FROM item WHERE emb {op} {qs};")
+    return [t.id for t in out[0]["result"]]
+
+
+def test_sql_knn_exact_small(vec_ds):
+    """Below TPU_ANN_MIN_ROWS the plan is exact — matches brute force."""
+    ds, x = vec_ds
+    got = _knn_ids(ds, x[7], k=5)
+    assert set(got) == _brute(x[7], x, 5)
+
+
+def test_sql_knn_ivf_path(vec_ds):
+    """Forcing the ANN threshold down routes the same query through IVF;
+    results overlap brute force (recall) and include the query point."""
+    from surrealdb_tpu import cnf
+
+    ds, x = vec_ds
+    old = cnf.TPU_ANN_MIN_ROWS
+    cnf.TPU_ANN_MIN_ROWS = 10
+    try:
+        ds.index_stores.clear()
+        got = _knn_ids(ds, x[7], k=5, ef=400)
+        assert 7 in got, "self-hit missed"
+        assert len(set(got) & _brute(x[7], x, 5)) >= 4
+    finally:
+        cnf.TPU_ANN_MIN_ROWS = old
+
+
+def test_sql_knn_incremental_no_rescan(vec_ds):
+    """After the mirror builds, writes maintain it by delta — a rebuild scan
+    would raise (VERDICT r1 item 4)."""
+    from surrealdb_tpu.idx import vector_index
+
+    ds, x = vec_ds
+    _knn_ids(ds, x[0], k=3)  # builds the mirror
+
+    orig = vector_index.scan_vectors
+
+    def boom(*a, **k):
+        raise AssertionError("vector mirror rebuilt instead of delta-maintained")
+
+    vector_index.scan_vectors = boom
+    try:
+        ds.execute("CREATE item:999 SET emb = [9.0, 9.0, 9.0, 9.0, 9.0, 9.0, 9.0, 9.0];")
+        got = _knn_ids(ds, [9.0] * 8, k=1)
+        assert got == [999]
+        ds.execute("DELETE item:999;")
+        got = _knn_ids(ds, [9.0] * 8, k=1)
+        assert got != [999]
+        # update moves the record in vector space
+        ds.execute("UPDATE item:5 SET emb = [-9.0, -9.0, -9.0, -9.0, -9.0, -9.0, -9.0, -9.0];")
+        got = _knn_ids(ds, [-9.0] * 8, k=1)
+        assert got == [5]
+    finally:
+        vector_index.scan_vectors = orig
+
+
+def test_sql_knn_txn_overlay(vec_ds):
+    """Uncommitted writes are visible to kNN inside their own transaction
+    (exact overlay path); a cancelled transaction leaves no trace in the
+    shared mirror."""
+    ds, x = vec_ds
+    _knn_ids(ds, x[0], k=3)  # build mirror
+    out = ds.execute(
+        "BEGIN;"
+        " CREATE item:777 SET emb = [7.5, 7.5, 7.5, 7.5, 7.5, 7.5, 7.5, 7.5];"
+        " SELECT VALUE id FROM item WHERE emb <|1|> [7.5, 7.5, 7.5, 7.5, 7.5, 7.5, 7.5, 7.5];"
+        " COMMIT;"
+    )
+    ids = [t.id for t in out[-1]["result"]]
+    assert ids == [777], out[-1]
+    ds.execute("DELETE item:777;")
+
+    # cancelled txn: the pending row must never reach the mirror
+    ds.execute(
+        "BEGIN;"
+        " CREATE item:888 SET emb = [8.5, 8.5, 8.5, 8.5, 8.5, 8.5, 8.5, 8.5];"
+        " CANCEL;"
+    )
+    got = _knn_ids(ds, [8.5] * 8, k=1)
+    assert got and got != [888]
