@@ -57,6 +57,21 @@ def main(argv=None) -> int:
     p_exp.add_argument("--user", "-u")
     p_exp.add_argument("--pass", "-p", dest="password")
 
+    p_ml = sub.add_parser("ml", help="import/export ML models")
+    ml_sub = p_ml.add_subparsers(dest="ml_cmd")
+    p_mli = ml_sub.add_parser("import", help="import a JSON model spec")
+    p_mli.add_argument("file")
+    p_mle = ml_sub.add_parser("export", help="export a model spec as JSON")
+    p_mle.add_argument("name")
+    p_mle.add_argument("model_version", nargs="?", default="")
+    p_mle.add_argument("file", nargs="?", default="-")
+    for p in (p_mli, p_mle):
+        p.add_argument("--endpoint", "-e", default="mem://")
+        p.add_argument("--ns", required=True)
+        p.add_argument("--db", required=True)
+        p.add_argument("--user", "-u")
+        p.add_argument("--pass", "-p", dest="password")
+
     p_val = sub.add_parser("validate", help="parse-check SurrealQL files")
     p_val.add_argument("files", nargs="+")
 
@@ -74,6 +89,7 @@ def main(argv=None) -> int:
         "sql": _sql,
         "import": _import,
         "export": _export,
+        "ml": _ml,
         "validate": _validate,
         "isready": _isready,
         "version": _version,
@@ -162,6 +178,31 @@ def _export(args) -> int:
         with open(args.file, "w") as f:
             f.write(dump)
     return 0
+
+
+def _ml(args) -> int:
+    """`surrealdb-tpu ml import|export` (reference: src/cli/ml/)."""
+    import json
+
+    if args.ml_cmd == "import":
+        db = _connect(args)
+        with open(args.file) as f:
+            spec = json.load(f)
+        entry = db.import_model(spec)
+        print(f"model ml::{entry['name']}<{entry['version']}> stored", file=sys.stderr)
+        return 0
+    if args.ml_cmd == "export":
+        db = _connect(args)
+        spec = db.export_model(args.name, args.model_version)
+        text = json.dumps(spec)
+        if args.file == "-":
+            sys.stdout.write(text)
+        else:
+            with open(args.file, "w") as f:
+                f.write(text)
+        return 0
+    print("usage: surrealdb-tpu ml {import,export} ...", file=sys.stderr)
+    return 1
 
 
 def _validate(args) -> int:

@@ -316,14 +316,20 @@ def _def_model(ctx, a) -> Any:
     txn = ctx.txn()
     name, version = a["name"], a.get("version", "")
     txn.ensure_db(ns, db)
-    if _guard(txn.get_ml(ns, db, name, version), a, "model", name):
+    existing = txn.get_ml(ns, db, name, version)
+    if _guard(existing, a, "model", name):
         return NONE
-    txn.put_ml(ns, db, name, version, {
+    d = {
         "name": name,
         "version": version,
         "permissions": a.get("permissions"),
         "comment": a.get("comment"),
-    })
+    }
+    if existing:  # OVERWRITE re-defines metadata but keeps stored weights
+        for k in ("blob", "in_dim", "out_dim"):
+            if k in existing:
+                d[k] = existing[k]
+    txn.put_ml(ns, db, name, version, d)
     return NONE
 
 
@@ -470,6 +476,10 @@ def remove_compute(ctx, stm) -> Any:
         if txn.get_ml(ns, db, name, version) is None:
             return missing("model")
         txn.del_ml(ns, db, name, version)
+        ds = ctx.ds()
+        from surrealdb_tpu.ml.exec import invalidate as _ml_invalidate
+
+        txn.on_commit(lambda: _ml_invalidate(ds, ns, db, name, version))
         return NONE
     raise SurrealError(f"REMOVE {kind.upper()} is not supported")
 

@@ -173,6 +173,16 @@ def model_prefix(ns: str, db: str) -> bytes:
     return _db(ns, db) + b"!ml"
 
 
+def blob(ns: str, db: str, digest: str) -> bytes:
+    """Content-addressed blob storage (role of the reference's object store,
+    core/src/obs/mod.rs:20 — SHA-addressed model weight files)."""
+    return _db(ns, db) + b"!ob" + enc_str(digest)
+
+
+def blob_prefix(ns: str, db: str) -> bytes:
+    return _db(ns, db) + b"!ob"
+
+
 def database_ts(ns: str, db: str, ts: int) -> bytes:
     return _db(ns, db) + b"!ts" + enc_u64(ts)
 

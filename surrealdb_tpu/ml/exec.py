@@ -1,24 +1,152 @@
-"""ML model execution (ml::name<version>(args)).
+"""ML model execution (ml::name<version>(args)) + import/export.
 
-Role of the reference's Model::compute (reference: core/src/sql/model.rs).
-Model storage + the TPU inference path (jax-jitted forward over batched
-table scans) land with the ML milestone; DEFINE MODEL metadata already
-persists via the catalog.
+Role of the reference's Model::compute + ml import surface (reference:
+core/src/sql/model.rs:37, src/net/ml.rs, src/cli/ml/). Weights persist as
+content-addressed blobs (obs.py); execution compiles the spec once per
+datastore (cache below) and runs batched rows as ONE jitted device dispatch
+(ml/model.py CompiledModel.forward) — the TPU-native path for BASELINE
+config 5 (model scored over a full-table scan).
 """
 
 from __future__ import annotations
 
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
 from surrealdb_tpu.err import SurrealError
+from surrealdb_tpu.obs import get_blob, put_blob
+
+from .model import CompiledModel, spec_from_bytes, spec_to_bytes, validate_spec
+
+_cache_lock = threading.Lock()
+
+
+def _model_cache(ds) -> dict:
+    cache = getattr(ds, "_ml_cache", None)
+    if cache is None:
+        with _cache_lock:
+            cache = getattr(ds, "_ml_cache", None)
+            if cache is None:
+                cache = {}
+                ds._ml_cache = cache
+    return cache
+
+
+def invalidate(ds, ns: str, db: str, name: str, version: str) -> None:
+    _model_cache(ds).pop((ns, db, name, version), None)
+
+
+def import_model(ds, session, name: str, version: str, spec: dict) -> dict:
+    """Validate + persist a model (spec dict with weights) and register it
+    in the catalog. Returns the stored catalog entry."""
+    spec = validate_spec(spec)
+    raw = spec_to_bytes(spec)
+    ns, db = session.ns, session.db
+    if not (ns and db):
+        raise SurrealError("Model import requires a namespace and database")
+    txn = ds.transaction(True)
+    try:
+        digest = put_blob(txn, ns, db, raw)
+        entry = txn.get_ml(ns, db, name, version) or {
+            "name": name,
+            "version": version,
+            "permissions": None,
+            "comment": None,
+        }
+        entry["blob"] = digest
+        entry["in_dim"] = int(spec["layers"][0]["w"].shape[0])
+        entry["out_dim"] = int(spec["layers"][-1]["w"].shape[1])
+        txn.put_ml(ns, db, name, version, entry)
+        txn.commit()
+    except BaseException:
+        if not txn.done:
+            txn.cancel()
+        raise
+    invalidate(ds, ns, db, name, version)
+    return entry
+
+
+def export_model(ds, session, name: str, version: str) -> dict:
+    """Return the stored spec (weights as nested lists, json-safe)."""
+    ns, db = session.ns, session.db
+    txn = ds.transaction(False)
+    try:
+        entry = txn.get_ml(ns, db, name, version)
+        if entry is None or not entry.get("blob"):
+            raise SurrealError(f"The model 'ml::{name}<{version}>' does not exist")
+        raw = get_blob(txn, ns, db, entry["blob"])
+    finally:
+        txn.cancel()
+    spec = spec_from_bytes(raw)
+    return {
+        "name": name,
+        "version": version,
+        "format": spec["format"],
+        "layers": [
+            {
+                "w": layer["w"].tolist(),
+                "b": layer["b"].tolist(),
+                "activation": layer["activation"],
+            }
+            for layer in spec["layers"]
+        ],
+    }
+
+
+def _compiled(ctx, ns, db, name, version) -> CompiledModel:
+    ds = ctx.ds()
+    cache = _model_cache(ds)
+    key = (ns, db, name, version)
+    cm = cache.get(key)
+    if cm is not None:
+        return cm
+    txn = ctx.txn()
+    entry = txn.get_ml(ns, db, name, version)
+    if entry is None:
+        raise SurrealError(f"The model 'ml::{name}<{version}>' does not exist")
+    blob = entry.get("blob")
+    if blob is None:
+        raise SurrealError(f"The model 'ml::{name}<{version}>' has no stored weights")
+    raw = get_blob(txn, ns, db, blob)
+    if raw is None:
+        raise SurrealError(f"The model 'ml::{name}<{version}>' weights are missing")
+    cm = CompiledModel(spec_from_bytes(raw))
+    cache[key] = cm
+    return cm
+
+
+def _rows_from_arg(arg, in_dim: int):
+    """Accept one row (list of numbers / object of numbers) or a batch
+    (list of rows). Returns ([N, D] float32, batched?)."""
+    if isinstance(arg, dict):
+        arg = [float(v) for v in arg.values()]
+    if not isinstance(arg, (list, tuple)) or not arg:
+        raise SurrealError("ml:: argument must be a number array or array of arrays")
+    first = arg[0]
+    if isinstance(first, (list, tuple)):
+        mat = np.asarray([[float(v) for v in row] for row in arg], dtype=np.float32)
+        batched = True
+    else:
+        mat = np.asarray([[float(v) for v in arg]], dtype=np.float32)
+        batched = False
+    if mat.shape[1] != in_dim:
+        raise SurrealError(
+            f"ml:: input has {mat.shape[1]} features, model expects {in_dim}"
+        )
+    return mat, batched
 
 
 def run_model(ctx, name: str, version: str, args):
     ns, db = ctx.ns_db()
-    ml = ctx.txn().get_ml(ns, db, name, version)
-    if ml is None:
-        raise SurrealError(f"The model 'ml::{name}<{version}>' does not exist")
-    runner = ml.get("runner")
-    if runner is None:
-        raise SurrealError(
-            f"The model 'ml::{name}<{version}>' has no stored weights"
-        )
-    return runner(ctx, args)
+    cm = _compiled(ctx, ns, db, name, version)
+    if len(args) != 1:
+        raise SurrealError("ml:: calls take exactly one argument")
+    mat, batched = _rows_from_arg(args[0], cm.in_dim)
+    out = cm.forward(mat)
+    if cm.out_dim == 1:
+        vals = [float(v) for v in out[:, 0]]
+    else:
+        vals = [[float(x) for x in row] for row in out]
+    return vals if batched else vals[0]

@@ -1,0 +1,167 @@
+"""Model specs, weight serialization, and compiled forwards.
+
+Role of the reference's surrealml `.surml` runtime + object store
+(reference: core/src/sql/model.rs:37 Model::compute, core/src/obs/mod.rs:20
+SHA1-addressed model files). TPU-first design: weights live as
+content-addressed blobs in the KV (key/__init__.py blob); the forward is a
+jax-jitted function materialized once per (model, version) and vmapped over
+batches, so `ml::m<v>(batch_of_rows)` is ONE device dispatch for a whole
+table scan (BASELINE config 5). Tiny single-row calls use a numpy twin to
+skip the dispatch latency.
+
+Spec format (msgpack-serializable dict):
+  {"format": "linear" | "mlp",
+   "layers": [{"w": [[...]], "b": [...], "activation": "relu"|"tanh"|
+               "sigmoid"|"softmax"|None}, ...]}
+`linear` is a 1-layer mlp with no activation. Output of a single-output
+model is unwrapped to a scalar per row.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from surrealdb_tpu.err import SurrealError
+from surrealdb_tpu.utils.ser import pack, unpack
+
+_ACTS = ("relu", "tanh", "sigmoid", "softmax", None)
+
+
+def validate_spec(spec: dict) -> dict:
+    """Normalize + sanity-check a model spec; returns the canonical dict."""
+    fmt = spec.get("format")
+    if fmt not in ("linear", "mlp"):
+        raise SurrealError(f"Unsupported model format {fmt!r}")
+    layers = spec.get("layers") or []
+    if not layers:
+        raise SurrealError("Model has no layers")
+    canon = []
+    prev_out: Optional[int] = None
+    for i, layer in enumerate(layers):
+        w = np.asarray(layer.get("w"), dtype=np.float32)
+        if w.ndim != 2:
+            raise SurrealError(f"Layer {i} weight must be a 2-d matrix")
+        b = layer.get("b")
+        b = np.zeros(w.shape[1], np.float32) if b is None else np.asarray(b, np.float32)
+        if b.shape != (w.shape[1],):
+            raise SurrealError(f"Layer {i} bias shape {b.shape} != ({w.shape[1]},)")
+        act = layer.get("activation")
+        if act not in _ACTS:
+            raise SurrealError(f"Layer {i} has unknown activation {act!r}")
+        if prev_out is not None and w.shape[0] != prev_out:
+            raise SurrealError(
+                f"Layer {i} input dim {w.shape[0]} != previous output {prev_out}"
+            )
+        prev_out = w.shape[1]
+        canon.append({"w": w, "b": b, "activation": act})
+    return {"format": fmt, "layers": canon}
+
+
+# ------------------------------------------------------------ serialization
+def spec_to_bytes(spec: dict) -> bytes:
+    out = {"format": spec["format"], "layers": []}
+    for layer in spec["layers"]:
+        out["layers"].append(
+            {
+                "w_shape": list(layer["w"].shape),
+                "w": layer["w"].astype(np.float32).tobytes(),
+                "b": layer["b"].astype(np.float32).tobytes(),
+                "activation": layer["activation"],
+            }
+        )
+    return pack(out)
+
+
+def spec_from_bytes(raw: bytes) -> dict:
+    d = unpack(raw)
+    layers = []
+    for layer in d["layers"]:
+        sh = tuple(layer["w_shape"])
+        layers.append(
+            {
+                "w": np.frombuffer(layer["w"], np.float32).reshape(sh).copy(),
+                "b": np.frombuffer(layer["b"], np.float32).copy(),
+                "activation": layer["activation"],
+            }
+        )
+    return {"format": d["format"], "layers": layers}
+
+
+def digest(raw: bytes) -> str:
+    return hashlib.sha1(raw).hexdigest()
+
+
+# ------------------------------------------------------------ forwards
+def _np_act(x: np.ndarray, act: Optional[str]) -> np.ndarray:
+    if act == "relu":
+        return np.maximum(x, 0.0)
+    if act == "tanh":
+        return np.tanh(x)
+    if act == "sigmoid":
+        return 1.0 / (1.0 + np.exp(-x))
+    if act == "softmax":
+        e = np.exp(x - x.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+    return x
+
+
+class CompiledModel:
+    """One (model, version): host twin + lazily-jitted device forward."""
+
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.in_dim = spec["layers"][0]["w"].shape[0]
+        self.out_dim = spec["layers"][-1]["w"].shape[1]
+        self._jitted = None
+
+    def forward_host(self, x: np.ndarray) -> np.ndarray:
+        h = x.astype(np.float32)
+        for layer in self.spec["layers"]:
+            h = _np_act(h @ layer["w"] + layer["b"], layer["activation"])
+        return h
+
+    def _device_fn(self):
+        if self._jitted is None:
+            import jax
+            import jax.numpy as jnp
+
+            ws = [jnp.asarray(l["w"]) for l in self.spec["layers"]]
+            bs = [jnp.asarray(l["b"]) for l in self.spec["layers"]]
+            acts = [l["activation"] for l in self.spec["layers"]]
+
+            @jax.jit
+            def fwd(x):
+                h = x
+                for w, b, act in zip(ws, bs, acts):
+                    h = jnp.dot(h, w, preferred_element_type=jnp.float32) + b
+                    if act == "relu":
+                        h = jnp.maximum(h, 0.0)
+                    elif act == "tanh":
+                        h = jnp.tanh(h)
+                    elif act == "sigmoid":
+                        h = jax.nn.sigmoid(h)
+                    elif act == "softmax":
+                        h = jax.nn.softmax(h, axis=-1)
+                return h
+
+            self._jitted = fwd
+        return self._jitted
+
+    def forward(self, x: np.ndarray, device_threshold: int = 1024) -> np.ndarray:
+        """Batched forward: device above `device_threshold` rows (pow2-padded
+        so repeated table scans reuse the compiled kernel), numpy below."""
+        from surrealdb_tpu.utils.num import next_pow2
+
+        if x.shape[0] < device_threshold:
+            return self.forward_host(x)
+        fwd = self._device_fn()
+        n = x.shape[0]
+        cap = next_pow2(n)
+        if cap != n:
+            x = np.concatenate([x, np.zeros((cap - n, x.shape[1]), np.float32)])
+        import jax.numpy as jnp
+
+        return np.asarray(fwd(jnp.asarray(x.astype(np.float32))))[:n]

@@ -129,6 +129,8 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 return self._send(200, export_database(self.ds, sess), "text/plain")
             except SurrealError as e:
                 return self._send(401, {"error": str(e)})
+        if path.startswith("/ml/export/"):
+            return self._ml_export(path)
         if path.startswith("/key/"):
             return self._key_route("GET")
         return self._send(404, {"error": "not found"})
@@ -143,6 +145,8 @@ class SurrealHandler(BaseHTTPRequestHandler):
             return self._auth_route("signin")
         if path == "/signup":
             return self._auth_route("signup")
+        if path == "/ml/import":
+            return self._ml_import()
         if path == "/import":
             try:
                 sess = self._authorized_session()
@@ -247,6 +251,52 @@ class SurrealHandler(BaseHTTPRequestHandler):
     _RPC_ANON_METHODS = frozenset(
         {"ping", "version", "use", "signin", "signup", "authenticate", "invalidate"}
     )
+
+    def _system_session(self):
+        """Session for model import/export: system user covering the db
+        (reference: src/net/ml.rs check on Edit/View)."""
+        sess = self._authorized_session()
+        if self.auth_enabled:
+            a = sess.auth
+            if a.level not in ("db", "ns", "root") or not a.has_db_access(sess.ns, sess.db):
+                raise InvalidAuthError()
+        return sess
+
+    def _ml_import(self):
+        try:
+            sess = self._system_session()
+        except SurrealError as e:
+            return self._send(401, {"error": str(e)})
+        try:
+            spec = json.loads(self._body())
+        except json.JSONDecodeError:
+            return self._send(400, {"error": "invalid JSON model spec"})
+        from surrealdb_tpu.ml.exec import import_model
+
+        try:
+            entry = import_model(
+                self.ds, sess, spec.get("name", ""), spec.get("version", ""), spec
+            )
+        except SurrealError as e:
+            return self._send(400, {"error": str(e)})
+        return self._send(200, {"name": entry["name"], "version": entry["version"], "blob": entry["blob"]})
+
+    def _ml_export(self, path: str):
+        try:
+            sess = self._system_session()
+        except SurrealError as e:
+            return self._send(401, {"error": str(e)})
+        parts = path.split("/")[3:]  # /ml/export/{name}/{version}
+        if len(parts) != 2:
+            return self._send(400, {"error": "expected /ml/export/{name}/{version}"})
+        from urllib.parse import unquote
+
+        from surrealdb_tpu.ml.exec import export_model
+
+        try:
+            return self._send(200, export_model(self.ds, sess, unquote(parts[0]), unquote(parts[1])))
+        except SurrealError as e:
+            return self._send(404, {"error": str(e)})
 
     def _rpc_http(self):
         ct = (self.headers.get("Content-Type") or "application/json").split(";")[0]

@@ -128,6 +128,13 @@ class Surreal:
     def import_(self, text: str) -> None:
         self._engine.import_(text)
 
+    def import_model(self, spec: dict) -> dict:
+        """Store an ML model (spec dict with weights) for ml:: calls."""
+        return self._engine.import_model(spec)
+
+    def export_model(self, name: str, version: str = "") -> dict:
+        return self._engine.export_model(name, version)
+
     def close(self) -> None:
         self._engine.close()
 

@@ -51,5 +51,17 @@ class LocalEngine:
 
         import_database(self.ds, self.session, text)
 
+    def import_model(self, spec: dict) -> dict:
+        from surrealdb_tpu.ml.exec import import_model
+
+        return import_model(
+            self.ds, self.session, spec.get("name", ""), spec.get("version", ""), spec
+        )
+
+    def export_model(self, name: str, version: str) -> dict:
+        from surrealdb_tpu.ml.exec import export_model
+
+        return export_model(self.ds, self.session, name, version)
+
     def close(self) -> None:
         self.ds.close()

@@ -85,6 +85,39 @@ class HttpEngine:
         finally:
             conn.close()
 
+    def import_model(self, spec: dict) -> dict:
+        import json as _json
+
+        conn = self._conn(timeout=120)
+        try:
+            hdrs = {**self.headers, "Content-Type": "application/json"}
+            conn.request("POST", "/ml/import", _json.dumps(spec).encode(), hdrs)
+            resp = conn.getresponse()
+            out = _json.loads(resp.read())
+            if resp.status != 200:
+                raise SurrealError(out.get("error", "model import failed"))
+            return out
+        finally:
+            conn.close()
+
+    def export_model(self, name: str, version: str) -> dict:
+        import json as _json
+        from urllib.parse import quote
+
+        conn = self._conn(timeout=120)
+        try:
+            conn.request(
+                "GET", f"/ml/export/{quote(name, safe='')}/{quote(version, safe='')}",
+                headers=self.headers,
+            )
+            resp = conn.getresponse()
+            out = _json.loads(resp.read())
+            if resp.status != 200:
+                raise SurrealError(out.get("error", "model export failed"))
+            return out
+        finally:
+            conn.close()
+
     def close(self) -> None:
         pass
 
@@ -164,6 +197,12 @@ class WsEngine:
 
     def import_(self, text: str) -> None:
         raise SurrealError("import over WebSocket is not supported; use HTTP")
+
+    def import_model(self, spec: dict) -> dict:
+        raise SurrealError("model import over WebSocket is not supported; use HTTP")
+
+    def export_model(self, name: str, version: str) -> dict:
+        raise SurrealError("model export over WebSocket is not supported; use HTTP")
 
     def close(self) -> None:
         self._closed = True

@@ -1257,11 +1257,12 @@ class Parser:
             self.expect_kw("ML")
             self.expect_op("::")
             name = self.ident("model name")
+            model_version = ""
             if self.eat_op("<"):
                 v = [str(self.next().value)]
                 while self.eat_op("."):
                     v.append(str(self.next().value))
-                name += "<" + ".".join(v) + ">"
+                model_version = ".".join(v)
                 self.expect_op(">")
         elif kind == "param":
             t2 = self.next()
@@ -1272,6 +1273,8 @@ class Parser:
             name = self.ident("name")
         table = None
         level = None
+        if kind == "model":
+            table = model_version  # version rides the table slot
         if kind in ("field", "index", "event") and self.eat_kw("ON"):
             self.eat_kw("TABLE")
             table = self.ident("table name")
