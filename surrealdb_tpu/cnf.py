@@ -59,6 +59,8 @@ TPU_DISABLE = _env_bool("SURREAL_TPU_DISABLE", False)
 CHANGEFEED_GC_INTERVAL_SECS = _env_int("SURREAL_CHANGEFEED_GC_INTERVAL", 10)
 
 # Websocket / server
+# largest accepted HTTP request body (model imports carry inline weights)
+HTTP_MAX_BODY_SIZE = _env_int("SURREAL_HTTP_MAX_BODY_SIZE", 64 * 1024 * 1024)
 WEBSOCKET_MAX_CONCURRENT_REQUESTS = _env_int(
     "SURREAL_WEBSOCKET_MAX_CONCURRENT_REQUESTS", 24
 )

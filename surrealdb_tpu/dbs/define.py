@@ -377,7 +377,11 @@ def remove_compute(ctx, stm) -> Any:
         pre = keys._ns(name)
         txn.delr(pre, prefix_end(pre))
         ds = ctx.ds()
+        from surrealdb_tpu.ml.exec import invalidate_ns as _ml_invalidate_ns
+
         txn.on_commit(lambda: ds.graph_mirrors.drop_ns(name))
+        txn.on_commit(lambda: ds.index_stores.remove_ns(name))
+        txn.on_commit(lambda: _ml_invalidate_ns(ds, name))
         return NONE
     if kind == "database":
         ns = ctx.session.ns
@@ -389,7 +393,11 @@ def remove_compute(ctx, stm) -> Any:
         pre = keys._db(ns, name)
         txn.delr(pre, prefix_end(pre))
         ds = ctx.ds()
+        from surrealdb_tpu.ml.exec import invalidate_db as _ml_invalidate_db
+
         txn.on_commit(lambda: ds.graph_mirrors.drop_db(ns, name))
+        txn.on_commit(lambda: ds.index_stores.remove_db(ns, name))
+        txn.on_commit(lambda: _ml_invalidate_db(ds, ns, name))
         return NONE
     if kind == "table":
         ns, db = ctx.ns_db()
@@ -473,9 +481,17 @@ def remove_compute(ctx, stm) -> Any:
     if kind == "model":
         ns, db = ctx.ns_db()
         version = getattr(stm, "table", None) or ""
-        if txn.get_ml(ns, db, name, version) is None:
+        entry = txn.get_ml(ns, db, name, version)
+        if entry is None:
             return missing("model")
         txn.del_ml(ns, db, name, version)
+        # GC the content-addressed weights blob unless another model version
+        # still references the same digest (advisor r2: orphaned blobs)
+        digest = entry.get("blob")
+        if digest and not any(m.get("blob") == digest for m in txn.all_ml(ns, db)):
+            from surrealdb_tpu.obs import del_blob
+
+            del_blob(txn, ns, db, digest)
         ds = ctx.ds()
         from surrealdb_tpu.ml.exec import invalidate as _ml_invalidate
 

@@ -145,35 +145,41 @@ _JITTED: dict = {}
 def _kernels():
     """Lazily build the jitted hop kernels (keeps jax off the import path)."""
     if _JITTED:
-        return _JITTED["hop"], _JITTED["dedup"]
+        return _JITTED["hop"], _JITTED["accum"]
     import jax
     import jax.numpy as jnp
 
     @partial(jax.jit, static_argnames=("md",))
-    def gather_hop(ptr, idx, frontier, mask, md):
-        # one CSR gather: frontier [F] ints → neighbor slots [F*md] + validity
+    def gather_hop(ptr, idx, frontier, weights, md):
+        # one weighted CSR gather: frontier [F] ints with multiplicities →
+        # neighbor slots [F*md] + per-slot weight (0 = padding). Carrying a
+        # count per node instead of a bare frontier makes the hop an SpMV
+        # over the adjacency, which preserves the reference's flatten-
+        # without-dedup result multiplicity (sql/value/get.rs:404-446)
+        # while still deduplicating the *frontier* between hops.
         n = ptr.shape[0] - 1
         fr = jnp.clip(frontier, 0, jnp.maximum(n - 1, 0))
         s = ptr[fr]
         deg = ptr[fr + 1] - s
         offs = jnp.arange(md)[None, :]
         take = jnp.clip(s[:, None] + offs, 0, idx.shape[0] - 1)
-        valid = (offs < deg[:, None]) & mask[:, None] & (frontier < n)[:, None]
-        return idx[take].reshape(-1), valid.reshape(-1)
+        valid = (offs < deg[:, None]) & (weights > 0)[:, None] & (frontier < n)[:, None]
+        w = jnp.where(valid, weights[:, None], 0)
+        return idx[take].reshape(-1), w.reshape(-1)
 
     @partial(jax.jit, static_argnames=("n_nodes", "out_size"))
-    def dedup_cap(nodes, mask, n_nodes, out_size):
-        # dense-bitmap dedup with a capped, jit-static output size
-        marks = jnp.zeros(n_nodes + 1, dtype=jnp.bool_)
-        safe = jnp.where(mask, jnp.clip(nodes, 0, n_nodes), n_nodes)
-        marks = marks.at[safe].set(True)
-        marks = marks.at[n_nodes].set(False)
-        present = jnp.nonzero(marks, size=out_size, fill_value=n_nodes)[0]
-        return present, present < n_nodes
+    def accum_cap(nodes, w, n_nodes, out_size):
+        # dense scatter-add dedup: per-node path counts survive the frontier
+        # compaction (capped, jit-static output size)
+        safe = jnp.where(w > 0, jnp.clip(nodes, 0, n_nodes), n_nodes)
+        dense = jnp.zeros(n_nodes + 1, dtype=jnp.int32).at[safe].add(w)
+        dense = dense.at[n_nodes].set(0)
+        present = jnp.nonzero(dense > 0, size=out_size, fill_value=n_nodes)[0]
+        return present, jnp.where(present < n_nodes, dense[present], 0)
 
     _JITTED["hop"] = gather_hop
-    _JITTED["dedup"] = dedup_cap
-    return gather_hop, dedup_cap
+    _JITTED["accum"] = accum_cap
+    return gather_hop, accum_cap
 
 
 class GraphMirrors:
@@ -326,45 +332,53 @@ class GraphMirrors:
                         out.append(m)
         return out
 
-    def _host_hop(self, ns, db, frontier: np.ndarray, spec) -> np.ndarray:
-        out: Set[int] = set()
+    def _host_hop(self, ns, db, frontier: np.ndarray, counts: np.ndarray, spec):
+        out: Dict[int, int] = {}
         for m in self._hop_mirrors(ns, db, spec):
             with m._lock:  # deltas may mutate adj lists concurrently
-                for i in frontier.tolist():
-                    out.update(m.adj.get(int(i), ()))
-        return np.fromiter(sorted(out), dtype=np.int32, count=len(out))
+                for i, c in zip(frontier.tolist(), counts.tolist()):
+                    for dst in m.adj.get(int(i), ()):
+                        out[dst] = out.get(dst, 0) + c
+        nodes = np.fromiter(sorted(out), dtype=np.int32, count=len(out))
+        return nodes, np.array([out[int(n)] for n in nodes], dtype=np.int32)
 
-    def _device_chain(self, ns, db, frontier: np.ndarray, specs) -> np.ndarray:
-        """Run the remaining hops entirely on device: one upload, H gathers
-        with on-device dedup between hops, one download at the end. Every
-        static dimension (frontier size, max degree, node capacity, dedup
-        output) is pow2-rounded so steady writes don't recompile."""
+    def _device_chain(self, ns, db, frontier: np.ndarray, counts: np.ndarray, specs):
+        """Run the remaining hops entirely on device: one upload, H weighted
+        gathers with on-device scatter-add dedup between hops, one download
+        at the end. Every static dimension (frontier size, max degree, node
+        capacity, dedup output) is pow2-rounded so steady writes don't
+        recompile."""
         import jax.numpy as jnp
 
-        gather_hop, dedup_cap = _kernels()
+        gather_hop, accum_cap = _kernels()
         it = self.interner(ns, db)
         n_cap = _next_pow2(len(it))
         fsz = _next_pow2(frontier.size)
         fr = np.full(fsz, n_cap, dtype=np.int32)
         fr[: frontier.size] = frontier
+        cw = np.zeros(fsz, dtype=np.int32)
+        cw[: counts.size] = counts
         frj = jnp.asarray(fr)
-        maskj = jnp.asarray(fr < n_cap)
+        cwj = jnp.asarray(cw)
         for spec in specs:
-            pieces, masks = [], []
+            pieces, ws = [], []
             for m in self._hop_mirrors(ns, db, spec):
                 ptr, idx = m.device_arrays()
                 md = _next_pow2(max(m.max_degree, 1))
-                nodes, valid = gather_hop(ptr, idx, frj, maskj, md=md)
+                nodes, w = gather_hop(ptr, idx, frj, cwj, md=md)
                 pieces.append(nodes)
-                masks.append(valid)
+                ws.append(w)
             if not pieces:
-                return np.empty(0, dtype=np.int32)
+                e = np.empty(0, dtype=np.int32)
+                return e, e
             allnodes = jnp.concatenate(pieces) if len(pieces) > 1 else pieces[0]
-            allmask = jnp.concatenate(masks) if len(masks) > 1 else masks[0]
+            allw = jnp.concatenate(ws) if len(ws) > 1 else ws[0]
             out_size = _next_pow2(min(int(allnodes.shape[0]), n_cap))
-            frj, maskj = dedup_cap(allnodes, allmask, n_nodes=n_cap, out_size=out_size)
+            frj, cwj = accum_cap(allnodes, allw, n_nodes=n_cap, out_size=out_size)
         u = np.asarray(frj)
-        return u[np.asarray(maskj)].astype(np.int32)
+        c = np.asarray(cwj)
+        keep = c > 0
+        return u[keep].astype(np.int32), c[keep].astype(np.int32)
 
     def chain(
         self,
@@ -377,9 +391,13 @@ class GraphMirrors:
         then the rest of the chain on device once it crosses
         TPU_GRAPH_ONDEVICE_THRESHOLD.
 
-        Result order is deterministic (ascending intern order ≈ build-scan
-        key order, with delta-added nodes after) but not identical to the
-        KV walk's key order; graph hop ordering is unspecified upstream.
+        Multiplicity matches the reference's flatten-without-dedup semantics
+        (sql/value/get.rs:404-446): the frontier is deduplicated between hops
+        but each node carries its path count, and the final result expands
+        each node count times. Result order is deterministic (ascending
+        intern order ≈ build-scan key order, with delta-added nodes after)
+        but not identical to the KV walk's key order; graph hop ordering is
+        unspecified upstream.
         """
         from surrealdb_tpu import cnf
 
@@ -395,16 +413,24 @@ class GraphMirrors:
                 self.ensure_table(ctx, tb)
             specs.append((sorted(tables), dir_map[p.dir], p.what))
             tables = set(p.what)
-        uniq = {i for i in (it.lookup(t) for t in start) if i is not None}
-        frontier = np.fromiter(sorted(uniq), dtype=np.int32, count=len(uniq))
+        cmap: Dict[int, int] = {}
+        for t in start:
+            i = it.lookup(t)
+            if i is not None:
+                cmap[i] = cmap.get(i, 0) + 1
+        frontier = np.fromiter(sorted(cmap), dtype=np.int32, count=len(cmap))
+        counts = np.array([cmap[int(i)] for i in frontier], dtype=np.int32)
         i = 0
         while i < len(specs):
             if (
                 not cnf.TPU_DISABLE
                 and frontier.size >= cnf.TPU_GRAPH_ONDEVICE_THRESHOLD
             ):
-                frontier = self._device_chain(ns, db, frontier, specs[i:])
+                frontier, counts = self._device_chain(ns, db, frontier, counts, specs[i:])
                 break
-            frontier = self._host_hop(ns, db, frontier, specs[i])
+            frontier, counts = self._host_hop(ns, db, frontier, counts, specs[i])
             i += 1
-        return [it.node_of[int(j)] for j in frontier]
+        out: List[Thing] = []
+        for j, c in zip(frontier, counts):
+            out.extend([it.node_of[int(j)]] * int(c))
+        return out

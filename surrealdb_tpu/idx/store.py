@@ -43,6 +43,19 @@ class IndexStores:
             for k in [k for k in self._stores if k[:3] == (ns, db, tb)]:
                 del self._stores[k]
 
+    def remove_db(self, ns: str, db: str) -> None:
+        """Forget every mirror of one database (REMOVE DATABASE) — a
+        recreated database must not reuse stale device state."""
+        with self._lock:
+            for k in [k for k in self._stores if k[:2] == (ns, db)]:
+                del self._stores[k]
+
+    def remove_ns(self, ns: str) -> None:
+        """Forget every mirror of one namespace (REMOVE NAMESPACE)."""
+        with self._lock:
+            for k in [k for k in self._stores if k[0] == ns]:
+                del self._stores[k]
+
     def clear(self) -> None:
         with self._lock:
             self._stores.clear()

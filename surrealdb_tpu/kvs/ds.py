@@ -8,6 +8,7 @@ registry, and the live-query notification channel.
 
 from __future__ import annotations
 
+import threading
 import uuid as _uuid
 from typing import Any, Dict, List, Optional
 
@@ -31,6 +32,10 @@ class Datastore:
 
         self.index_stores = IndexStores()
         self.graph_mirrors = GraphMirrors()
+        # serializes backend commit + mirror-delta application so two
+        # concurrently committing transactions can't apply graph/vector
+        # deltas in the opposite order of their backend commits (advisor r2)
+        self.commit_lock = threading.Lock()
         # live queries: uuid(hex) -> LiveSubscription (registered in M10)
         self.notifications = None  # set by enable_notifications()
         self.auth_enabled = False
@@ -52,6 +57,7 @@ class Datastore:
             self.backend.transaction(write), self.oracle, self.clock, self.graph_mirrors
         )
         txn._index_stores = self.index_stores
+        txn._commit_lock = self.commit_lock
         return txn
 
     # ------------------------------------------------------------ notifications

@@ -40,11 +40,25 @@ class Transaction:
         # REMOVE …— running them at statement time would let a concurrent
         # rebuild resurrect state the uncommitted delete was about to erase)
         self._on_commit: List = []
+        self._commit_lock = None  # set by Datastore.transaction
         self.write = backend.write
 
     # ------------------------------------------------------------ lifecycle
     def commit(self) -> None:
         self.complete_changes()
+        # backend commit + mirror-delta application must be one atomic unit
+        # across threads: without the datastore-level lock two committing
+        # transactions could apply their deltas in the opposite order of
+        # their backend commits and leave shared mirrors diverged from KV
+        if self._commit_lock is not None and (
+            self.graph_deltas or self.vector_deltas or self._on_commit
+        ):
+            with self._commit_lock:
+                self._commit_and_apply()
+        else:
+            self._commit_and_apply()
+
+    def _commit_and_apply(self) -> None:
         self.tr.commit()
         if self.graph_deltas and self._graph_mirrors is not None:
             self._graph_mirrors.apply_deltas(self.graph_deltas)

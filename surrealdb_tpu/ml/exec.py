@@ -38,6 +38,21 @@ def invalidate(ds, ns: str, db: str, name: str, version: str) -> None:
     _model_cache(ds).pop((ns, db, name, version), None)
 
 
+def invalidate_db(ds, ns: str, db: str) -> None:
+    """Drop every compiled model of one database (REMOVE DATABASE) so a
+    recreated database can't serve deleted weights from the cache."""
+    cache = _model_cache(ds)
+    for k in [k for k in cache if k[:2] == (ns, db)]:
+        cache.pop(k, None)
+
+
+def invalidate_ns(ds, ns: str) -> None:
+    """Drop every compiled model of one namespace (REMOVE NAMESPACE)."""
+    cache = _model_cache(ds)
+    for k in [k for k in cache if k[0] == ns]:
+        cache.pop(k, None)
+
+
 def import_model(ds, session, name: str, version: str, spec: dict) -> dict:
     """Validate + persist a model (spec dict with weights) and register it
     in the catalog. Returns the stored catalog entry."""

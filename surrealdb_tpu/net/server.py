@@ -31,6 +31,23 @@ from surrealdb_tpu.utils.ser import wire_pack as pack, wire_unpack
 from . import ws as wsproto
 
 
+class BodyTooLarge(Exception):
+    """Request body exceeds cnf.HTTP_MAX_BODY_SIZE; connection is dropped."""
+
+
+def _capped(fn):
+    """Route wrapper: any oversized request body becomes a 413 instead of an
+    unbounded read (the body is never read — see _body)."""
+
+    def inner(self):
+        try:
+            return fn(self)
+        except BodyTooLarge:
+            return self._send(413, {"error": "request body too large"})
+
+    return inner
+
+
 class SurrealHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = f"surrealdb-tpu/{__version__}"
@@ -48,7 +65,19 @@ class SurrealHandler(BaseHTTPRequestHandler):
 
     def _body(self) -> bytes:
         if not hasattr(self, "_cached_body"):
-            n = int(self.headers.get("Content-Length") or 0)
+            from surrealdb_tpu import cnf
+
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                n = -1
+            if n < 0 or n > cnf.HTTP_MAX_BODY_SIZE:
+                # never read an oversized body — respond 413 and drop the
+                # connection (draining would block on bytes that may never
+                # arrive)
+                self._cached_body = b""
+                self.close_connection = True
+                raise BodyTooLarge()
             self._cached_body = self.rfile.read(n) if n else b""
         return self._cached_body
 
@@ -103,6 +132,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
         return sess
 
     # ------------------------------------------------------------ routes
+    @_capped
     def do_GET(self):
         path = urlparse(self.path).path
         if path == "/health":
@@ -135,6 +165,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
             return self._key_route("GET")
         return self._send(404, {"error": "not found"})
 
+    @_capped
     def do_POST(self):
         path = urlparse(self.path).path
         if path == "/sql":
@@ -160,16 +191,19 @@ class SurrealHandler(BaseHTTPRequestHandler):
             return self._key_route("POST")
         return self._send(404, {"error": "not found"})
 
+    @_capped
     def do_PUT(self):
         if urlparse(self.path).path.startswith("/key/"):
             return self._key_route("PUT")
         return self._send(404, {"error": "not found"})
 
+    @_capped
     def do_PATCH(self):
         if urlparse(self.path).path.startswith("/key/"):
             return self._key_route("PATCH")
         return self._send(404, {"error": "not found"})
 
+    @_capped
     def do_DELETE(self):
         if urlparse(self.path).path.startswith("/key/"):
             return self._key_route("DELETE")
@@ -279,6 +313,11 @@ class SurrealHandler(BaseHTTPRequestHandler):
             )
         except SurrealError as e:
             return self._send(400, {"error": str(e)})
+        except (ValueError, TypeError, AttributeError, KeyError) as e:
+            # validate_spec raises these on malformed specs (ragged weight
+            # lists, non-dict layers, …) — a bad spec is a client error,
+            # never a handler crash; anything else is a genuine 500
+            return self._send(400, {"error": f"invalid model spec: {e}"})
         return self._send(200, {"name": entry["name"], "version": entry["version"], "blob": entry["blob"]})
 
     def _ml_export(self, path: str):

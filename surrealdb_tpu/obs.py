@@ -15,11 +15,15 @@ from surrealdb_tpu import key as keys
 
 
 def put_blob(txn, ns: str, db: str, raw: bytes) -> str:
-    """Store bytes content-addressed; returns the sha1 digest."""
+    """Store bytes content-addressed; returns the sha1 digest.
+
+    The write is unconditional even when the blob already exists: the MVCC
+    backends detect conflicts only on *written* keys, so skipping the write
+    would let a concurrent REMOVE MODEL blob-GC delete the digest this
+    import is about to reference — writing it forces the write-write
+    conflict and one side retries."""
     digest = hashlib.sha1(raw).hexdigest()
-    k = keys.blob(ns, db, digest)
-    if txn.get(k) is None:
-        txn.set(k, raw)
+    txn.set(keys.blob(ns, db, digest), raw)
     return digest
 
 

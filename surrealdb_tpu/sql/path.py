@@ -447,15 +447,10 @@ def _graph_part(ctx, things: List[Thing], p: PGraph, rest: List[Part]):
                 if truthy(p.cond.compute(c)):
                     kept.append(t)
         found = kept
-    # dedup preserving order
-    seen = set()
-    uniq = []
-    for t in found:
-        h = (t.tb, repr(t.id))
-        if h not in seen:
-            seen.add(h)
-            uniq.append(t)
-    return get_path(ctx, uniq, rest)
+    # no dedup: the reference flattens hop results without deduplication
+    # (sql/value/get.rs:404-446), so parallel edges / converging paths
+    # yield duplicate records — multiplicity is part of the result
+    return get_path(ctx, found, rest)
 
 
 def _recurse_part(ctx, value, p: PRecurse, rest: List[Part]):

@@ -170,3 +170,59 @@ def test_csr_remove_database_drops_mirrors(ds, jax8):
     ds.execute("REMOVE DATABASE test;")
     ds.execute("CREATE p:0;")
     assert ds.execute(q)[0]["result"][0] == []
+
+def test_graph_multiplicity_parallel_edges(ds, jax8):
+    """Parallel edges yield duplicate results on BOTH the exact KV walk and
+    the mirror path — matching the reference's flatten-without-dedup
+    semantics (sql/value/get.rs:404-446; advisor r2 high finding)."""
+    from surrealdb_tpu import cnf
+
+    ds.execute(
+        "CREATE p:0; CREATE p:1; CREATE p:2;"
+        "RELATE p:0->knows->p:1; RELATE p:0->knows->p:1;"  # parallel edges
+        "RELATE p:0->knows->p:2;"
+    )
+    q = "SELECT VALUE ->knows->p FROM p:0"
+    # mirror path (mirrors are built lazily on first traversal)
+    out = ds.execute(q)[0]["result"][0]
+    assert sorted(t.id for t in out) == [1, 1, 2]
+    # exact KV walk (mirrors bypassed inside a txn with edge writes)
+    out = ds.execute(
+        "BEGIN; RELATE p:0->knows->p:2; SELECT VALUE ->knows->p FROM p:0; COMMIT;"
+    )[-1]["result"][0]
+    assert sorted(t.id for t in out) == [1, 1, 2, 2]
+    # after commit the mirror sees the same multiplicity
+    out = ds.execute(q)[0]["result"][0]
+    assert sorted(t.id for t in out) == [1, 1, 2, 2]
+    # device path agrees
+    old = cnf.TPU_GRAPH_ONDEVICE_THRESHOLD
+    cnf.TPU_GRAPH_ONDEVICE_THRESHOLD = 1
+    try:
+        ds.graph_mirrors.clear()
+        out = ds.execute(q)[0]["result"][0]
+        assert sorted(t.id for t in out) == [1, 1, 2, 2]
+    finally:
+        cnf.TPU_GRAPH_ONDEVICE_THRESHOLD = old
+
+
+def test_graph_multiplicity_converging_paths(ds, jax8):
+    """Two 2-hop paths converging on one node return it twice (reference
+    flatten semantics), on host, device, and exact paths alike."""
+    from surrealdb_tpu import cnf
+
+    ds.execute(
+        "CREATE p:0; CREATE p:1; CREATE p:2; CREATE p:3;"
+        "RELATE p:0->knows->p:1; RELATE p:0->knows->p:2;"
+        "RELATE p:1->knows->p:3; RELATE p:2->knows->p:3;"
+    )
+    q = "SELECT VALUE ->knows->p->knows->p FROM p:0"
+    out = ds.execute(q)[0]["result"][0]
+    assert sorted(t.id for t in out) == [3, 3]
+    old = cnf.TPU_GRAPH_ONDEVICE_THRESHOLD
+    cnf.TPU_GRAPH_ONDEVICE_THRESHOLD = 1
+    try:
+        ds.graph_mirrors.clear()
+        out = ds.execute(q)[0]["result"][0]
+        assert sorted(t.id for t in out) == [3, 3]
+    finally:
+        cnf.TPU_GRAPH_ONDEVICE_THRESHOLD = old

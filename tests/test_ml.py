@@ -147,3 +147,33 @@ def test_ml_sdk_and_cli(tmp_path):
         assert out[0]["result"] == pytest.approx(15.0)
         spec = db.export_model("house", "1.0.0")
         assert spec["layers"][0]["b"] == [10.0]
+
+
+def test_ml_remove_model_gcs_blob(ml_ds):
+    """REMOVE MODEL deletes the content-addressed weights blob when no other
+    model version references it (advisor r2: orphaned blobs)."""
+    from surrealdb_tpu import key as keys
+    from surrealdb_tpu.key.encode import prefix_end
+
+    pre = keys.blob_prefix("test", "test")
+    txn = ml_ds.transaction(False)
+    try:
+        assert txn.scan(pre, prefix_end(pre))  # blob exists before
+    finally:
+        txn.cancel()
+    ml_ds.execute("REMOVE MODEL ml::house<1.0.0>;")
+    txn = ml_ds.transaction(False)
+    try:
+        assert not txn.scan(pre, prefix_end(pre))  # blob gone after
+    finally:
+        txn.cancel()
+
+
+def test_ml_remove_database_clears_compiled_cache(ml_ds):
+    """A recreated database must not serve the removed database's compiled
+    weights from the cache (advisor r2 medium)."""
+    assert ml_ds.execute("RETURN ml::house<1.0.0>([1.0, 2.0]);")[0]["status"] == "OK"
+    ml_ds.execute("REMOVE DATABASE test;")
+    out = ml_ds.execute("RETURN ml::house<1.0.0>([1.0, 2.0]);")
+    assert out[0]["status"] == "ERR"
+    assert "does not exist" in out[0]["result"]
