@@ -222,6 +222,18 @@ class Iterator:
         self.grouping = verb == "select" and bool(
             getattr(stm, "group", None) or getattr(stm, "group_all", False)
         )
+        # SELECTs whose projection invokes ml:: models collect raw docs too,
+        # so every scanned row feeds ONE batched device dispatch instead of
+        # a per-row forward (BASELINE config 5; reference runs Model::compute
+        # per document, core/src/sql/model.rs). Guests / record-access
+        # sessions keep the per-row path so per-doc model PERMISSIONS hold.
+        self.ml_calls: List[Any] = []
+        if verb == "select" and not self.grouping:
+            from surrealdb_tpu.iam.check import perms_apply
+
+            if not perms_apply(ctx):
+                self.ml_calls = find_model_calls(getattr(stm, "fields", None))
+        self.defer_projection = bool(self.ml_calls)
 
     def ingest(self, it) -> None:
         self.entries.append(it)
@@ -312,7 +324,9 @@ class Iterator:
         with ctx.with_doc_value(v) as c:
             if stm.cond is not None and not truthy(stm.cond.compute(c)):
                 return
-            if self.grouping:
+            if self.defer_projection:
+                self._push((None, copy_value(v), None))
+            elif self.grouping:
                 self._push((None, copy_value(v)))
             else:
                 self._push(project_fields(c, stm.fields, v, None, stm.value_mode))
@@ -359,8 +373,8 @@ class Iterator:
                 with ctx.with_doc_value(docv, rid=rid, ir=ir) as c:
                     if stm.cond is not None and not truthy(stm.cond.compute(c)):
                         return
-                    if self.grouping:
-                        self._push((rid, docv))
+                    if self.grouping or self.defer_projection:
+                        self._push((rid, docv, ir) if self.defer_projection else (rid, docv))
                     else:
                         self._push(project_fields(c, stm.fields, docv, rid, stm.value_mode))
             elif verb in ("update", "upsert"):
@@ -406,9 +420,62 @@ class Iterator:
             if self._full():
                 return
 
+    # -------------------------------------------------------------- ml batching
+    def _batched_projection(self, rows: List[Any]) -> List[Any]:
+        """Deferred projection for SELECTs containing ml:: calls: every
+        scanned row's model input is collected host-side, each distinct call
+        runs as ONE batched forward, then the projection is evaluated with
+        the per-row results parked as overrides (sql/ast.py ModelCall).
+
+        Rows whose argument expression fails to evaluate fall back to the
+        inline per-row path (the call may sit under a conditional branch
+        that never reaches it for that row)."""
+        from surrealdb_tpu.ml.exec import run_model_batch
+
+        ctx, stm = self.ctx, self.stm
+        outputs: dict = {}  # id(call) -> {row_index: value}
+        ex = ctx.executor
+        # save/restore: a nested deferred SELECT (subquery with its own ml::
+        # calls) must not clobber the enclosing projection's overrides
+        prev = getattr(ex, "_ml_overrides", None)
+        try:
+            # innermost-first: a call nested in another call's argument
+            # resolves from its overrides while the outer one is collected
+            for call in reversed(self.ml_calls):
+                per_row: dict = {}
+                for i, (rid, docv, ir) in enumerate(rows):
+                    ex._ml_overrides = {
+                        cid: m[i] for cid, m in outputs.items() if i in m
+                    }
+                    try:
+                        with ctx.with_doc_value(docv, rid=rid, ir=ir) as c:
+                            if len(call.args) == 1:
+                                per_row[i] = call.args[0].compute(c)
+                    except SurrealError:
+                        pass
+                    finally:
+                        ex._ml_overrides = prev
+                outputs[id(call)] = run_model_batch(
+                    ctx, call.name, call.version, per_row
+                )
+            out = []
+            for i, (rid, docv, ir) in enumerate(rows):
+                ex._ml_overrides = {
+                    cid: m[i] for cid, m in outputs.items() if i in m
+                }
+                with ctx.with_doc_value(docv, rid=rid, ir=ir) as c:
+                    out.append(
+                        project_fields(c, stm.fields, docv, rid, stm.value_mode)
+                    )
+        finally:
+            ex._ml_overrides = prev
+        return out
+
     # -------------------------------------------------------------- postprocess
     def _postprocess(self, rows: List[Any]) -> List[Any]:
         ctx, stm = self.ctx, self.stm
+        if self.defer_projection:
+            rows = self._batched_projection(rows)
         if self.grouping:
             rows = aggregate_groups(ctx, stm, rows)
         if stm.split:
@@ -428,6 +495,22 @@ class Iterator:
 
             rows = apply_fetch(ctx, rows, stm.fetch)
         return rows
+
+# ------------------------------------------------------------------ ml detection
+def find_model_calls(fields) -> List[Any]:
+    """ModelCall nodes evaluated directly in a projection (not inside
+    subquery scope boundaries — those bind a different document)."""
+    from surrealdb_tpu.sql.ast import ModelCall, walk_exprs
+
+    found: List[Any] = []
+
+    def visit(node):
+        if isinstance(node, ModelCall):
+            found.append(node)
+
+    walk_exprs(fields, visit)
+    return found
+
 
 # ------------------------------------------------------------------ projection
 def project_fields(ctx, fields, doc_v, rid: Optional[Thing], value_mode: bool):

@@ -8,8 +8,33 @@ from __future__ import annotations
 
 from typing import Any, List
 
-from surrealdb_tpu.err import FcNotFoundError, InvalidArgumentsError, ReturnError, TypeError_
+from surrealdb_tpu.err import (
+    FcNotFoundError,
+    InvalidArgumentsError,
+    ReturnError,
+    SurrealError,
+    TypeError_,
+)
 from surrealdb_tpu.sql.value import NONE, Closure
+
+
+def _check_fc_permission(ctx, name: str, fc: dict) -> None:
+    """DEFINE FUNCTION ... PERMISSIONS for record-access / guest sessions
+    (reference: core/src/fnc/mod.rs custom-path permission check). Absent
+    clause = FULL (the reference default)."""
+    from surrealdb_tpu.iam.check import evaluate_permission, perms_apply
+
+    perms = fc.get("permissions")
+    if perms is None or not perms_apply(ctx):
+        return
+    rule = perms.get("select", "NONE") if isinstance(perms, dict) else perms
+    doc = ctx.doc
+    rid = doc.rid if doc is not None else None
+    val = doc.current if doc is not None else None
+    if not evaluate_permission(ctx, rule, rid, val):
+        raise SurrealError(
+            f"The function 'fn::{name}' does not allow execution for this session"
+        )
 
 
 def run_custom(ctx, name: str, args: List[Any]) -> Any:
@@ -17,6 +42,7 @@ def run_custom(ctx, name: str, args: List[Any]) -> Any:
     fc = ctx.txn().get_fc(ns, db, name)
     if fc is None:
         raise FcNotFoundError(name)
+    _check_fc_permission(ctx, name, fc)
     params = fc.get("params", [])
     if len(args) > len(params):
         raise InvalidArgumentsError(

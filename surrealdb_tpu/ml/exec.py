@@ -153,9 +153,33 @@ def _rows_from_arg(arg, in_dim: int):
     return mat, batched
 
 
+def check_model_permission(ctx, ns: str, db: str, name: str, version: str) -> None:
+    """Model execution permission for record-access / guest sessions
+    (reference: core/src/sql/model.rs:83-99 Model::compute check). A model
+    defined without a PERMISSIONS clause is FULL (the reference's
+    Permission::default); PERMISSIONS NONE denies non-system sessions."""
+    from surrealdb_tpu.iam.check import evaluate_permission, perms_apply
+
+    if not perms_apply(ctx):
+        return
+    entry = ctx.txn().get_ml(ns, db, name, version)
+    perms = (entry or {}).get("permissions")
+    if perms is None:
+        return
+    rule = perms.get("select", "NONE") if isinstance(perms, dict) else perms
+    doc = ctx.doc
+    rid = doc.rid if doc is not None else None
+    val = doc.current if doc is not None else None
+    if not evaluate_permission(ctx, rule, rid, val):
+        raise SurrealError(
+            f"The model 'ml::{name}<{version}>' does not allow execution for this session"
+        )
+
+
 def run_model(ctx, name: str, version: str, args):
     ns, db = ctx.ns_db()
     cm = _compiled(ctx, ns, db, name, version)
+    check_model_permission(ctx, ns, db, name, version)
     if len(args) != 1:
         raise SurrealError("ml:: calls take exactly one argument")
     mat, batched = _rows_from_arg(args[0], cm.in_dim)
@@ -165,3 +189,40 @@ def run_model(ctx, name: str, version: str, args):
     else:
         vals = [[float(x) for x in row] for row in out]
     return vals if batched else vals[0]
+
+
+def run_model_batch(ctx, name: str, version: str, per_row_args: dict) -> dict:
+    """Collected per-row arguments → ONE device dispatch (BASELINE config 5:
+    model scored over a full-table scan). `per_row_args` maps row index →
+    what that row's ml:: argument evaluated to (a feature vector, or itself
+    a batch). Rows whose argument doesn't convert are silently dropped from
+    the result — they fall back to the inline per-row path, which raises
+    only if the call is actually reached (it may sit under a conditional).
+    Returns {row index: result} with the same single/batch shape run_model
+    would have produced row-by-row."""
+    ns, db = ctx.ns_db()
+    cm = _compiled(ctx, ns, db, name, version)
+    check_model_permission(ctx, ns, db, name, version)
+    spans = []  # (row index, start, count, batched)
+    mats = []
+    total = 0
+    for i, arg in per_row_args.items():
+        try:
+            mat, batched = _rows_from_arg(arg, cm.in_dim)
+        except SurrealError:
+            continue
+        spans.append((i, total, mat.shape[0], batched))
+        mats.append(mat)
+        total += mat.shape[0]
+    if not mats:
+        return {}
+    out = cm.forward(np.concatenate(mats, axis=0))
+    results: dict = {}
+    for i, start, count, batched in spans:
+        rows = out[start : start + count]
+        if cm.out_dim == 1:
+            vals = [float(v) for v in rows[:, 0]]
+        else:
+            vals = [[float(x) for x in row] for row in rows]
+        results[i] = vals if batched else vals[0]
+    return results

@@ -116,6 +116,9 @@ class CompiledModel:
         self.in_dim = spec["layers"][0]["w"].shape[0]
         self.out_dim = spec["layers"][-1]["w"].shape[1]
         self._jitted = None
+        # forward invocations (each = one dispatch); the batched SELECT path
+        # asserts one dispatch per table scan against this counter
+        self.dispatches = 0
 
     def forward_host(self, x: np.ndarray) -> np.ndarray:
         h = x.astype(np.float32)
@@ -155,6 +158,7 @@ class CompiledModel:
         so repeated table scans reuse the compiled kernel), numpy below."""
         from surrealdb_tpu.utils.num import next_pow2
 
+        self.dispatches += 1
         if x.shape[0] < device_threshold:
             return self.forward_host(x)
         fwd = self._device_fn()

@@ -628,6 +628,12 @@ class ModelCall(Expr):
         self.args = args
 
     def compute(self, ctx):
+        # batched-SELECT override: the iterator pre-computes this call for
+        # every scanned row in ONE device dispatch (dbs/iterator.py
+        # _batched_projection) and parks the per-row result here
+        ov = getattr(ctx.executor, "_ml_overrides", None)
+        if ov is not None and id(self) in ov:
+            return ov[id(self)]
         from surrealdb_tpu.ml.exec import run_model
 
         args = [a.compute(ctx) for a in self.args]
@@ -761,3 +767,45 @@ class Block(Expr):
 def compute_or_flatten(e: Expr, ctx):
     v = e.compute(ctx)
     return v
+
+
+# ------------------------------------------------------------------ walking
+# Scope boundaries: nodes whose interior evaluates against a DIFFERENT
+# document binding than the enclosing projection (so a walk looking for
+# batchable work must not cross into them).
+_SCOPE_BOUNDARIES = ("Subquery", "Block", "ClosureLit", "FutureLit")
+
+
+def walk_exprs(node, visit, _depth: int = 0) -> None:
+    """Generic pre-order walk over an AST fragment (exprs, idiom parts,
+    field lists). `visit` is called for every surrealdb_tpu node; descent
+    stops at subquery-like scope boundaries."""
+    if node is None or _depth > 80:
+        return
+    if isinstance(node, (list, tuple)):
+        for x in node:
+            walk_exprs(x, visit, _depth + 1)
+        return
+    if isinstance(node, dict):
+        for x in node.values():
+            walk_exprs(x, visit, _depth + 1)
+        return
+    cls = type(node)
+    if not cls.__module__.startswith("surrealdb_tpu"):
+        return
+    visit(node)
+    if cls.__name__ in _SCOPE_BOUNDARIES:
+        return
+    seen = set()
+    for klass in cls.__mro__:
+        for slot in getattr(klass, "__slots__", ()) or ():
+            if slot in seen:
+                continue
+            seen.add(slot)
+            try:
+                v = getattr(node, slot)
+            except AttributeError:
+                continue
+            walk_exprs(v, visit, _depth + 1)
+    for v in getattr(node, "__dict__", {}).values():
+        walk_exprs(v, visit, _depth + 1)
