@@ -108,8 +108,8 @@ class FtIndex:
             return
 
         # remove the old posting set
+        old_tf = _tf(old_tokens) if old_tokens is not None else None
         if old_tokens is not None:
-            old_tf = _tf(old_tokens)
             for term in old_tf:
                 meta = self._term(ctx, term)
                 if meta is None:
@@ -124,8 +124,8 @@ class FtIndex:
             st["dc"] -= 1
 
         # write the new posting set
+        tfs = _tf(new_tokens) if new_tokens is not None else None
         if new_tokens is not None:
-            tfs = _tf(new_tokens)
             for term, (count, offs) in tfs.items():
                 meta = self._term(ctx, term)
                 if meta is None:
@@ -150,6 +150,18 @@ class FtIndex:
             txn.delete(self._k(ctx, b"r" + enc_u64(did)))
 
         self._put_stats(ctx, st)
+        # buffered mirror delta, applied on commit (idx/ft_mirror.py)
+        ns, db = ctx.ns_db()
+        txn.ft_delta(
+            ns,
+            db,
+            self.tb,
+            self.name,
+            rid,
+            {t: c for t, (c, _) in old_tf.items()} if old_tf is not None else None,
+            {t: c for t, (c, _) in tfs.items()} if tfs is not None else None,
+            len(new_tokens) if new_tokens is not None else 0,
+        )
 
     def _tokens_of(self, az: Analyzer, vals) -> Optional[list]:
         if vals is None:
@@ -217,7 +229,7 @@ class FtIndex:
         b = float(self.ix["index"].get("b", 0.75))
         from surrealdb_tpu import cnf
 
-        if len(dids) < cnf.TPU_FT_ONDEVICE_THRESHOLD:
+        if cnf.TPU_DISABLE or len(dids) < cnf.TPU_FT_ONDEVICE_THRESHOLD:
             # tiny candidate sets score on host — a device dispatch (and
             # worse, a first-compile over a tunneled chip) costs far more
             from surrealdb_tpu.ops.bm25 import bm25_scores_host

@@ -1,0 +1,292 @@
+"""Device-resident full-text mirror: CSR postings + batched BM25 search.
+
+Role of the reference's per-query posting B-tree walks (reference:
+core/src/idx/ft/postings.rs, termdocs.rs, scorer.rs:13-92) re-designed
+TPU-first, the same way idx/knn.py mirrors vectors and idx/graph_csr.py
+mirrors edges: the inverted index's postings are packed once into CSR arrays
+(term → sorted doc ids + term frequencies) kept in sync with committed
+writes by per-document deltas, so a MATCHES query is numpy slicing +
+searchsorted intersection + ONE batched BM25 kernel (ops/bm25.py) instead of
+a per-posting KV scan-and-unpack loop.
+
+The KV inverted index (idx/ft_index.py) stays authoritative/durable; this is
+the compute replica (reference analog: TreeCache generation swap,
+trees/store/cache.rs — improved to incremental deltas, VERDICT r1 item 4).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from surrealdb_tpu import key as keys
+from surrealdb_tpu.key.encode import dec_u64, enc_u64, prefix_end
+from surrealdb_tpu.sql.value import Thing
+from surrealdb_tpu.utils.ser import unpack
+
+
+def _rid_key(rid) -> tuple:
+    return (rid.tb, repr(rid.id)) if isinstance(rid, Thing) else rid
+
+
+class FtMirror:
+    """One search index's postings, host-authoritative dicts + lazily
+    compacted CSR arrays (pattern of idx/graph_csr.py PointerCsr)."""
+
+    def __init__(self):
+        self.built = False
+        self.term_ids: Dict[str, int] = {}  # term -> local tid
+        self.postings: List[Dict[int, int]] = []  # tid -> {did: tf}
+        self.doc_len: Dict[int, int] = {}
+        self.did_of: Dict[tuple, int] = {}
+        self.rid_of: Dict[int, Thing] = {}
+        self.next_did = 0
+        self.dc = 0  # docs indexed
+        self.tl = 0  # total token length
+        self.dirty = True
+        # compacted arrays
+        self.t_indptr: Optional[np.ndarray] = None
+        self.t_dids: Optional[np.ndarray] = None
+        self.t_tfs: Optional[np.ndarray] = None
+        self.doclen_arr: Optional[np.ndarray] = None
+        self._pending: Optional[List[tuple]] = None
+        self._lock = threading.RLock()
+        self._build_lock = threading.Lock()
+
+    # ------------------------------------------------------------ build
+    def ensure_built(self, ctx, ix: dict) -> None:
+        """One scan over the index's KV state builds the mirror. Runs on a
+        fresh snapshot opened after delta buffering starts (same protocol as
+        idx/knn.py VectorMirror.ensure_built)."""
+        if self.built:
+            return
+        with self._build_lock:
+            if self.built:
+                return
+            with self._lock:
+                self._pending = []
+            ns, db = ctx.ns_db()
+            tb, name = ix["table"], ix["name"]
+            txn = ctx.ds().transaction(False)
+            try:
+                base = keys.index_state(ns, db, tb, name, b"")
+                kv_tid_local: Dict[int, int] = {}
+                term_ids: Dict[str, int] = {}
+                postings: List[Dict[int, int]] = []
+                # terms: t{term} -> {id, df}
+                pre = base + b"t"
+                for chunk in txn.batch(pre, prefix_end(pre), 4096):
+                    for k, v in chunk:
+                        meta = unpack(v)
+                        if meta.get("df", 0) <= 0:
+                            continue
+                        term = self._dec_term(k, len(pre))
+                        local = len(postings)
+                        term_ids[term] = local
+                        kv_tid_local[meta["id"]] = local
+                        postings.append({})
+                # postings: p{tid}{did} -> {tf}
+                pre = base + b"p"
+                for chunk in txn.batch(pre, prefix_end(pre), 8192):
+                    for k, v in chunk:
+                        tid, off = dec_u64(k, len(pre))
+                        did, _ = dec_u64(k, off)
+                        local = kv_tid_local.get(tid)
+                        if local is not None:
+                            postings[local][did] = unpack(v)["tf"]
+                # doc lengths: l{did}
+                doc_len: Dict[int, int] = {}
+                pre = base + b"l"
+                for chunk in txn.batch(pre, prefix_end(pre), 8192):
+                    for k, v in chunk:
+                        did, _ = dec_u64(k, len(pre))
+                        doc_len[did] = unpack(v)
+                # rid maps: r{did}
+                rid_of: Dict[int, Thing] = {}
+                did_of: Dict[tuple, int] = {}
+                pre = base + b"r"
+                for chunk in txn.batch(pre, prefix_end(pre), 8192):
+                    for k, v in chunk:
+                        did, _ = dec_u64(k, len(pre))
+                        rid = unpack(v)
+                        rid_of[did] = rid
+                        did_of[_rid_key(rid)] = did
+            finally:
+                txn.cancel()
+            with self._lock:
+                self.term_ids = term_ids
+                self.postings = postings
+                self.doc_len = doc_len
+                self.rid_of = rid_of
+                self.did_of = did_of
+                self.next_did = max(rid_of) + 1 if rid_of else 0
+                self.dc = len(doc_len)
+                self.tl = sum(doc_len.values())
+                self.dirty = True
+                self.built = True
+                pending, self._pending = self._pending, None
+                for args in pending:
+                    self.apply_ft(*args)
+
+    @staticmethod
+    def _dec_term(k: bytes, off: int) -> str:
+        from surrealdb_tpu.key.encode import dec_str
+
+        return dec_str(k, off)[0]
+
+    # ------------------------------------------------------------ deltas
+    def apply_ft(
+        self,
+        rid,
+        old_tf: Optional[Dict[str, int]],
+        new_tf: Optional[Dict[str, int]],
+        new_len: int,
+    ) -> None:
+        """One committed document change. old/new term-frequency maps follow
+        idx/ft_index.py index_document's diff semantics; None = absent."""
+        with self._lock:
+            if self._pending is not None:
+                self._pending.append((rid, old_tf, new_tf, new_len))
+                return
+            if not self.built:
+                return
+            k = _rid_key(rid)
+            did = self.did_of.get(k)
+            if old_tf is not None and did is not None:
+                for term in old_tf:
+                    tid = self.term_ids.get(term)
+                    if tid is not None:
+                        self.postings[tid].pop(did, None)
+                ln = self.doc_len.pop(did, None)
+                if ln is not None:
+                    self.tl -= ln
+                    self.dc -= 1
+            if new_tf is not None:
+                if did is None:
+                    did = self.next_did
+                    self.next_did += 1
+                    self.did_of[k] = did
+                    self.rid_of[did] = rid
+                for term, tf in new_tf.items():
+                    tid = self.term_ids.get(term)
+                    if tid is None:
+                        tid = len(self.postings)
+                        self.term_ids[term] = tid
+                        self.postings.append({})
+                    self.postings[tid][did] = tf
+                self.doc_len[did] = new_len
+                self.dc += 1
+                self.tl += new_len
+            elif did is not None:
+                self.did_of.pop(k, None)
+                self.rid_of.pop(did, None)
+            self.dirty = True
+
+    # ------------------------------------------------------------ bulk seed
+    def load_bulk(self, term_postings: Dict[str, Dict[int, int]], doc_len, rid_of):
+        """Seed an unbuilt mirror directly (kvs/bulk.py fast ingestion); the
+        KV rows are written by the same bulk transaction."""
+        with self._lock:
+            self.term_ids = {t: i for i, t in enumerate(term_postings)}
+            self.postings = [dict(p) for p in term_postings.values()]
+            self.doc_len = dict(doc_len)
+            self.rid_of = dict(rid_of)
+            self.did_of = {_rid_key(r): d for d, r in rid_of.items()}
+            self.next_did = max(rid_of) + 1 if rid_of else 0
+            self.dc = len(self.doc_len)
+            self.tl = sum(self.doc_len.values())
+            self.dirty = True
+            self.built = True
+
+    # ------------------------------------------------------------ arrays
+    def _ensure_arrays(self) -> None:
+        if not self.dirty and self.t_indptr is not None:
+            return
+        T = len(self.postings)
+        counts = np.fromiter(
+            (len(p) for p in self.postings), dtype=np.int64, count=T
+        )
+        indptr = np.zeros(T + 1, dtype=np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        nnz = int(indptr[-1])
+        dids = np.empty(nnz, dtype=np.int64)
+        tfs = np.empty(nnz, dtype=np.float32)
+        for tid, p in enumerate(self.postings):
+            s, e = indptr[tid], indptr[tid + 1]
+            if s == e:
+                continue
+            d = np.fromiter(p.keys(), dtype=np.int64, count=len(p))
+            f = np.fromiter(p.values(), dtype=np.float32, count=len(p))
+            order = np.argsort(d, kind="stable")
+            dids[s:e] = d[order]
+            tfs[s:e] = f[order]
+        cap = max(self.next_did, 1)
+        dl = np.zeros(cap, dtype=np.float32)
+        if self.doc_len:
+            idx = np.fromiter(self.doc_len.keys(), dtype=np.int64, count=len(self.doc_len))
+            val = np.fromiter(self.doc_len.values(), dtype=np.float32, count=len(self.doc_len))
+            dl[idx] = val
+        self.t_indptr, self.t_dids, self.t_tfs, self.doclen_arr = indptr, dids, tfs, dl
+        self.dirty = False
+
+    # ------------------------------------------------------------ search
+    def search(self, terms: List[str], k1: float, b: float):
+        """AND-match the analyzed query terms; returns (dids, scores) —
+        empty arrays when any term is unknown."""
+        from surrealdb_tpu import cnf
+
+        with self._lock:
+            self._ensure_arrays()
+            uniq = list(dict.fromkeys(terms))
+            if not uniq:
+                return np.empty(0, np.int64), np.empty(0, np.float32)
+            tids = []
+            for t in uniq:
+                tid = self.term_ids.get(t)
+                if tid is None or self.t_indptr[tid + 1] == self.t_indptr[tid]:
+                    return np.empty(0, np.int64), np.empty(0, np.float32)
+                tids.append(tid)
+            # rarest-first intersection over sorted did rows
+            tids.sort(key=lambda tid: self.t_indptr[tid + 1] - self.t_indptr[tid])
+            rows = [
+                (
+                    self.t_dids[self.t_indptr[t] : self.t_indptr[t + 1]],
+                    self.t_tfs[self.t_indptr[t] : self.t_indptr[t + 1]],
+                )
+                for t in tids
+            ]
+            cand = rows[0][0]
+            tf_cols = [rows[0][1]]
+            for dids, tfs in rows[1:]:
+                pos = np.searchsorted(dids, cand)
+                pos_c = np.clip(pos, 0, len(dids) - 1)
+                mask = dids[pos_c] == cand
+                cand = cand[mask]
+                tf_cols = [c[mask] for c in tf_cols]
+                tf_cols.append(tfs[pos_c[mask]])
+                if cand.size == 0:
+                    return cand, np.empty(0, np.float32)
+            tf_mat = np.stack(tf_cols, axis=1)
+            df = np.array(
+                [self.t_indptr[t + 1] - self.t_indptr[t] for t in tids],
+                dtype=np.float32,
+            )
+            lens = self.doclen_arr[cand]
+            dc, tl = self.dc, self.tl
+        if not cnf.TPU_DISABLE and cand.size >= cnf.TPU_FT_ONDEVICE_THRESHOLD:
+            from surrealdb_tpu.ops.bm25 import bm25_scores
+
+            scores = np.asarray(
+                bm25_scores(tf_mat, df, lens, np.float32(dc), np.float32(tl), k1, b)
+            )
+        else:
+            from surrealdb_tpu.ops.bm25 import bm25_scores_host
+
+            scores = bm25_scores_host(tf_mat, df, lens, dc, tl, k1, b)
+        return cand, scores
+
+    def count(self) -> int:
+        with self._lock:
+            return self.dc

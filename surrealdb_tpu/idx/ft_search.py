@@ -35,7 +35,31 @@ class MatchesPlan:
     # ------------------------------------------------------------ iteration
     def iterate(self, ctx):
         ctx.qe = self
-        self.results = self.ft.search(ctx, self.query)
+        ns, db = ctx.ns_db()
+        want = (ns, db, self.tb, self.ix["name"])
+        pending = getattr(ctx.txn(), "ft_deltas", None)
+        if pending and any(d[:4] == want for d in pending):
+            # this txn has uncommitted writes to the index: exact KV search
+            # (sees the txn's own writes; the shared mirror must not)
+            self.results = self.ft.search(ctx, self.query)
+        else:
+            from .ft_index import FtResults
+            from .ft_mirror import FtMirror
+
+            mirror = ctx.ds().index_stores.get_or_create(
+                ns, db, self.tb, self.ix["name"], FtMirror
+            )
+            mirror.ensure_built(ctx, self.ix)
+            terms = self.ft.analyzer(ctx).terms(self.query)
+            k1 = float(self.ix["index"].get("k1", 1.2))
+            b = float(self.ix["index"].get("b", 0.75))
+            dids, scores = mirror.search(terms, k1, b)
+            by_rid = {}
+            for did, s in zip(dids, scores):
+                rid = mirror.rid_of.get(int(did))
+                if rid is not None:
+                    by_rid[(rid.tb, repr(rid.id))] = (rid, float(s))
+            self.results = FtResults(self.ft, by_rid, terms)
         ranked = sorted(self.results, key=lambda rs: -rs[1])
         for rid, score in ranked:
             yield rid, None, {"score": score}

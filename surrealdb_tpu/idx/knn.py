@@ -330,7 +330,7 @@ class KnnPlan(_KnnExecutorMixin):
             ef = self.ef or self.ix["index"].get("efc")
             nprobe = default_nprobe(ivf.nlists, ef)
             dists, slots = ivf.search(q, matrix, metric, k, nprobe)
-        elif n >= cnf.TPU_KNN_ONDEVICE_THRESHOLD:
+        elif not cnf.TPU_DISABLE and n >= cnf.TPU_KNN_ONDEVICE_THRESHOLD:
             self.strategy = "exact-device"
             matrix, mask = mirror.device_view()
             import jax.numpy as jnp
@@ -429,7 +429,7 @@ class BruteForceKnnPlan(_KnnExecutorMixin):
             return
         k = min(self.k, len(rids))
         q = np.asarray([self.target], dtype=np.float32)
-        if len(rids) < cnf.TPU_KNN_ONDEVICE_THRESHOLD:
+        if cnf.TPU_DISABLE or len(rids) < cnf.TPU_KNN_ONDEVICE_THRESHOLD:
             dists, idxs = D.knn_search_host(q, np.asarray(rows, dtype=np.float32), self.metric, k)
         else:
             mat, mask = D.pad_rows(np.asarray(rows, dtype=np.float32), cnf.TPU_BATCH_MIN_TILE)

@@ -34,6 +34,7 @@ class Transaction:
         # idx/knn.py); a cancelled transaction never touches the mirrors
         self.graph_deltas: List[tuple] = []
         self.vector_deltas: List[tuple] = []
+        self.ft_deltas: List[tuple] = []
         self._graph_mirrors = graph_mirrors
         self._index_stores = None  # set by Datastore.transaction
         # callbacks run strictly after a successful commit (mirror drops on
@@ -51,7 +52,10 @@ class Transaction:
         # transactions could apply their deltas in the opposite order of
         # their backend commits and leave shared mirrors diverged from KV
         if self._commit_lock is not None and (
-            self.graph_deltas or self.vector_deltas or self._on_commit
+            self.graph_deltas
+            or self.vector_deltas
+            or self.ft_deltas
+            or self._on_commit
         ):
             with self._commit_lock:
                 self._commit_and_apply()
@@ -70,6 +74,12 @@ class Transaction:
                     # apply() buffers during a build and no-ops when unbuilt
                     mirror.apply(rid, vec)
             self.vector_deltas = []
+        if self.ft_deltas and self._index_stores is not None:
+            for ns, db, tb, name, rid, old_tf, new_tf, new_len in self.ft_deltas:
+                mirror = self._index_stores.get(ns, db, tb, name)
+                if mirror is not None and hasattr(mirror, "apply_ft"):
+                    mirror.apply_ft(rid, old_tf, new_tf, new_len)
+            self.ft_deltas = []
         for fn in self._on_commit:
             fn()
         self._on_commit = []
@@ -85,6 +95,11 @@ class Transaction:
     def vector_delta(self, ns, db, tb, name, rid, vec) -> None:
         """Record one vector-row mutation for post-commit mirror upkeep."""
         self.vector_deltas.append((ns, db, tb, name, rid, vec))
+
+    def ft_delta(self, ns, db, tb, name, rid, old_tf, new_tf, new_len) -> None:
+        """Record one full-text document mutation for post-commit mirror
+        upkeep (idx/ft_mirror.py)."""
+        self.ft_deltas.append((ns, db, tb, name, rid, old_tf, new_tf, new_len))
 
     def cancel(self) -> None:
         self.tr.cancel()

@@ -156,10 +156,11 @@ class CompiledModel:
     def forward(self, x: np.ndarray, device_threshold: int = 1024) -> np.ndarray:
         """Batched forward: device above `device_threshold` rows (pow2-padded
         so repeated table scans reuse the compiled kernel), numpy below."""
+        from surrealdb_tpu import cnf
         from surrealdb_tpu.utils.num import next_pow2
 
         self.dispatches += 1
-        if x.shape[0] < device_threshold:
+        if cnf.TPU_DISABLE or x.shape[0] < device_threshold:
             return self.forward_host(x)
         fwd = self._device_fn()
         n = x.shape[0]
