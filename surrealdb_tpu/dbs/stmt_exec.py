@@ -272,6 +272,7 @@ def live_compute(ctx, stm) -> Any:
         "session": ctx.session.id,
     }
     txn.set(keys.live_query(ns, db, tb, live_id.encode()), pack_lq(lq))
+    txn.invalidate_tb_lives(ns, db, tb)
     ds = ctx.ds()
     ds.enable_notifications()
     ds.notifications.subscribe(live_id)
@@ -309,6 +310,7 @@ def kill_compute(ctx, stm) -> Any:
         k = keys.live_query(ns, db, tb_def["name"], live_id.encode())
         if txn.exists(k):
             txn.delete(k)
+            txn.invalidate_tb_lives(ns, db, tb_def["name"])
             found = True
     ds = ctx.ds()
     if ds.notifications is not None:

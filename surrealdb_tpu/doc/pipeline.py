@@ -422,12 +422,10 @@ def _emit_mutation(ctx, rid: Thing, before, after, action: str) -> None:
 def process_table_lives(ctx, rid: Thing, before, after, action: str) -> None:
     ns, db = ctx.ns_db()
     txn = ctx.txn()
-    pre = keys.live_query_prefix(ns, db, rid.tb)
-    from surrealdb_tpu.key.encode import prefix_end
     from surrealdb_tpu.dbs.stmt_exec import unpack_lq
     from .lives import emit_live_notification
 
-    for _, raw in txn.scan(pre, prefix_end(pre)):
+    for raw in txn.all_tb_lives(ns, db, rid.tb):
         lq = unpack_lq(raw)
         emit_live_notification(ctx, lq, rid, before, after, action)
 

@@ -1,10 +1,10 @@
 """Full-text index write path (SEARCH index definitions).
 
 Role of the reference's FtIndex::index_document (reference:
-core/src/idx/ft/mod.rs). The inverted index (analyzers, term dictionary,
-postings, doc lengths, batched BM25 scoring on device) is built in the
-full-text milestone; until ft_index lands this is a tolerant no-op so SEARCH
-index definitions don't break writes.
+core/src/idx/ft/mod.rs). Delegates to the real inverted index in
+idx/ft_index.py — analyzers, term dictionary, postings, doc lengths — which
+also buffers the per-document mirror delta consumed by the device-resident
+CSR postings mirror (idx/ft_mirror.py) at commit.
 """
 
 from __future__ import annotations
@@ -13,8 +13,6 @@ from surrealdb_tpu.sql.value import Thing
 
 
 def update_ft_index(ctx, ix: dict, rid: Thing, old_vals, new_vals) -> None:
-    try:
-        from surrealdb_tpu.idx.ft_index import FtIndex
-    except ImportError:
-        return
+    from surrealdb_tpu.idx.ft_index import FtIndex
+
     FtIndex.for_index(ctx, ix).index_document(ctx, rid, old_vals, new_vals)

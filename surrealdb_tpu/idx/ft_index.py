@@ -45,6 +45,7 @@ class FtIndex:
         self.ix = ix
         self.name = ix["name"]
         self.highlights = bool(ix["index"].get("highlights"))
+        self._pref: Optional[Tuple[Tuple[str, str], bytes]] = None
 
     @staticmethod
     def for_index(ctx, ix: dict) -> "FtIndex":
@@ -56,7 +57,9 @@ class FtIndex:
     # ------------------------------------------------------------ keys
     def _k(self, ctx, sub: bytes) -> bytes:
         ns, db = ctx.ns_db()
-        return keys.index_state(ns, db, self.tb, self.name, sub)
+        if self._pref is None or self._pref[0] != (ns, db):
+            self._pref = ((ns, db), keys.index_state_prefix(ns, db, self.tb, self.name))
+        return self._pref[1] + sub
 
     def _stats(self, ctx) -> dict:
         raw = ctx.txn().get(self._k(ctx, b"s"))

@@ -169,6 +169,13 @@ class FtMirror:
                     self.next_did += 1
                     self.did_of[k] = did
                     self.rid_of[did] = rid
+                # idempotence (the build-window replay protocol relies on
+                # it, like VectorMirror.apply): a delta whose doc the build
+                # scan already loaded must not double-count dc/tl
+                prev = self.doc_len.get(did)
+                if prev is not None:
+                    self.tl -= prev
+                    self.dc -= 1
                 for term, tf in new_tf.items():
                     tid = self.term_ids.get(term)
                     if tid is None:
@@ -183,22 +190,6 @@ class FtMirror:
                 self.did_of.pop(k, None)
                 self.rid_of.pop(did, None)
             self.dirty = True
-
-    # ------------------------------------------------------------ bulk seed
-    def load_bulk(self, term_postings: Dict[str, Dict[int, int]], doc_len, rid_of):
-        """Seed an unbuilt mirror directly (kvs/bulk.py fast ingestion); the
-        KV rows are written by the same bulk transaction."""
-        with self._lock:
-            self.term_ids = {t: i for i, t in enumerate(term_postings)}
-            self.postings = [dict(p) for p in term_postings.values()]
-            self.doc_len = dict(doc_len)
-            self.rid_of = dict(rid_of)
-            self.did_of = {_rid_key(r): d for d, r in rid_of.items()}
-            self.next_did = max(rid_of) + 1 if rid_of else 0
-            self.dc = len(self.doc_len)
-            self.tl = sum(self.doc_len.values())
-            self.dirty = True
-            self.built = True
 
     # ------------------------------------------------------------ arrays
     def _ensure_arrays(self) -> None:

@@ -36,8 +36,9 @@ def unpack_vector(raw: bytes):
     return v  # legacy float-list rows
 
 
-def check_vector(ix: dict, val: Any) -> Optional[List[float]]:
-    """Validate/coerce a field value into the index's vector shape."""
+def check_vector(ix: dict, val: Any) -> Optional[np.ndarray]:
+    """Validate/coerce a field value into the index's vector shape
+    (float32 row, the dtype the KV codec and device mirror hold)."""
     if is_nullish(val) or val is None:
         return None
     if not isinstance(val, (list, tuple)):
@@ -47,12 +48,21 @@ def check_vector(ix: dict, val: Any) -> Optional[List[float]]:
         raise TypeError_(
             f"Incorrect vector dimension ({len(val)}). Expected a vector of {dim} dimension."
         )
-    out = []
-    for x in val:
-        if isinstance(x, bool) or not isinstance(x, (int, float)):
-            raise TypeError_("Vector index field must be an array of numbers")
-        out.append(float(x))
-    return out
+    # bulk numeric coercion: one numpy pass replaces a per-element
+    # isinstance/float() loop (the hot path of every indexed vector write);
+    # dtype is inferred first so strings/objects/all-bool rows are rejected,
+    # and a single type() scan catches bools numpy would promote silently
+    try:
+        arr = np.asarray(val)
+    except (TypeError, ValueError):
+        raise TypeError_("Vector index field must be an array of numbers")
+    if (
+        arr.ndim != 1
+        or arr.dtype.kind not in ("i", "u", "f")
+        or any(type(x) is bool for x in val)
+    ):
+        raise TypeError_("Vector index field must be an array of numbers")
+    return arr.astype(np.float32)
 
 
 def _row_key(ns, db, tb, name, rid: Thing) -> bytes:

@@ -317,6 +317,21 @@ class Transaction:
             lambda: self._scan_objs(keys.event_prefix(ns, db, tb)),
         )
 
+    # ------------------------------------------------------------ live queries
+    def all_tb_lives(self, ns: str, db: str, tb: str) -> List[bytes]:
+        """Raw packed live-query records for a table, catalog-cached so the
+        per-record mutation hook doesn't rescan the keyspace on every write
+        (reference: doc/lives.rs lq caching via Transaction)."""
+        pre = keys.live_query_prefix(ns, db, tb)
+        from surrealdb_tpu.key.encode import prefix_end
+
+        return self._cached(
+            pre, lambda: [raw for _, raw in self.scan(pre, prefix_end(pre))]
+        )
+
+    def invalidate_tb_lives(self, ns: str, db: str, tb: str) -> None:
+        self.cache.pop(keys.live_query_prefix(ns, db, tb), None)
+
     def get_tb_event(self, ns: str, db: str, tb: str, ev: str) -> Optional[dict]:
         return self.get_obj(keys.event(ns, db, tb, ev))
 
