@@ -1,0 +1,143 @@
+"""Cross-query device dispatch coalescing (the PARALLEL seam, SURVEY §2.5).
+
+Role of the reference's PARALLEL 4-stage pipeline (reference:
+core/src/dbs/iterator.rs:569-710): where the reference fans one statement's
+records OUT over a thread pool, the TPU-first equivalent fans concurrent
+queries IN — requests against the same index mirror coalesce into one
+batched kernel launch, amortizing per-dispatch latency (dominant on
+tunneled/queued devices, ~100ms here) across every waiting query.
+
+Leader–follower protocol, no artificial batching window: the first request
+on an idle bucket becomes the leader and immediately dispatches everything
+queued (initially just itself). While its batch is on device, later arrivals
+enqueue; when the leader finishes it hands the bucket to the next queued
+request, which dispatches the accumulated batch. Batching therefore emerges
+exactly when dispatch latency exceeds arrival spacing — a lone query pays
+zero extra latency, and no caller waits longer than its own batch.
+
+Consistency note: a batch runs against the LEADER's snapshot of the mirror
+(the runner closure it captured). Followers coalesced into that batch may
+observe a mirror state captured microseconds earlier than their own submit —
+the same committed-state-only guarantee individual mirror reads give.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Hashable, List, Sequence, Tuple
+
+
+class _Req:
+    __slots__ = ("payload", "runner", "event", "result", "error", "promoted", "done")
+
+    def __init__(self, payload, runner):
+        self.payload = payload
+        self.runner = runner
+        self.event = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+        self.promoted = False  # woken to take over bucket leadership
+        self.done = False
+
+
+class _Bucket:
+    __slots__ = ("lock", "queue", "busy")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.queue: List[_Req] = []
+        self.busy = False
+
+
+class DispatchQueue:
+    """Per-datastore coalescing queue for batchable device work.
+
+    submit(key, payload, runner) blocks until the request's result is ready.
+    `key` identifies a batchable family (same index, same metric/k/...): only
+    requests with equal keys share a kernel launch. `runner` is
+    runner(payloads: list) -> list of per-payload results; the leader's
+    runner executes the whole batch.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buckets: Dict[Hashable, _Bucket] = {}
+        # counters (tests / INFO FOR observability)
+        self.submitted = 0
+        self.dispatches = 0
+        self.batched = 0  # requests that rode someone else's dispatch
+
+    def _bucket(self, key: Hashable) -> _Bucket:
+        with self._lock:
+            b = self._buckets.get(key)
+            if b is None:
+                b = self._buckets[key] = _Bucket()
+            self.submitted += 1
+            return b
+
+    def submit(self, key: Hashable, payload: Any, runner: Callable[[Sequence[Any]], Sequence[Any]]) -> Any:
+        b = self._bucket(key)
+        req = _Req(payload, runner)
+        with b.lock:
+            b.queue.append(req)
+            leader = not b.busy
+            if leader:
+                b.busy = True
+        if not leader:
+            req.event.wait()
+            if not req.promoted:
+                if req.error is not None:
+                    raise req.error
+                return req.result
+            # promoted: the previous leader handed the bucket over; our own
+            # request is still queued and rides the batch we now dispatch
+        self._lead(b)
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def _lead(self, b: _Bucket) -> None:
+        """Dispatch exactly ONE batch (containing this leader's request),
+        then hand the bucket to the next queued request — bounding every
+        caller's latency to its own batch even under sustained load."""
+        with b.lock:
+            batch, b.queue = b.queue, []
+        if batch:
+            self._run(batch)
+        with b.lock:
+            if b.queue:
+                nxt = b.queue[0]
+                nxt.promoted = True
+                nxt.event.set()  # busy stays True; nxt owns the bucket now
+            else:
+                b.busy = False
+
+    def _run(self, batch: List[_Req]) -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.batched += len(batch) - 1
+        try:
+            results = batch[0].runner([r.payload for r in batch])
+            if len(results) != len(batch):
+                raise RuntimeError(
+                    f"dispatch runner returned {len(results)} results "
+                    f"for {len(batch)} requests"
+                )
+        except BaseException as e:  # propagate to every waiter
+            for r in batch:
+                r.error = e
+                r.done = True
+                r.event.set()
+            return
+        for r, res in zip(batch, results):
+            r.result = res
+            r.done = True
+            r.event.set()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "submitted": self.submitted,
+                "dispatches": self.dispatches,
+                "batched": self.batched,
+            }

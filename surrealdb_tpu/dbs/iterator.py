@@ -259,15 +259,49 @@ class Iterator:
             except (TypeError, ValueError):
                 pass
 
-        for it in self.entries:
-            self._iterate(it)
-            if self.cancel_on_limit is not None and len(self.results) >= self.cancel_on_limit:
-                break
+        if (
+            verb == "select"
+            and getattr(stm, "parallel", False)
+            and len(self.entries) > 1
+        ):
+            self._iterate_parallel()
+        else:
+            for it in self.entries:
+                self._iterate(it)
+                if self.cancel_on_limit is not None and len(self.results) >= self.cancel_on_limit:
+                    break
 
         rows = self.results
         if verb == "select":
             rows = self._postprocess(rows)
         return rows
+
+    def _iterate_parallel(self) -> None:
+        """PARALLEL SELECT over multiple sources: each source runs on its own
+        worker with an isolated child context; device dispatches issued by
+        concurrent sources coalesce through the datastore's DispatchQueue.
+
+        TPU-first reading of the reference's PARALLEL thread pipeline
+        (core/src/dbs/iterator.rs:569-710): the per-record stages stay
+        sequential per source (the kernel batches already cover them); the
+        parallelism that pays on this hardware is overlapping *dispatches*.
+        Read-only by construction — mutating verbs keep the sequential path.
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        workers = min(len(self.entries), cnf.MAX_CONCURRENT_TASKS)
+
+        def run_entry(entry):
+            sub = Iterator(self.ctx._child(), self.stm, self.verb)
+            sub.cancel_on_limit = self.cancel_on_limit
+            sub._iterate(entry)
+            return sub.results
+
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            for res in pool.map(run_entry, self.entries):
+                self.results.extend(res)
+                if self._full():
+                    break
 
     # -------------------------------------------------------------- dispatch
     def _iterate(self, it) -> None:

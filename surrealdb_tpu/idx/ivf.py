@@ -58,29 +58,55 @@ def _assign_chunk(chunk, cents, k_assign=1):
     return jax.lax.top_k(-d, k_assign)[1]
 
 
+@functools.partial(jax.jit, static_argnames=("k_assign",))
+def _assign_gather(matrix, idx, cents, k_assign=1):
+    """Gather rows from the DEVICE-resident mirror matrix and assign them to
+    their nearest centroids — only the [chunk] index vector crosses the
+    host->device link, not the rows themselves (the tunnel here moves
+    ~20MB/s, so re-uploading a 1Mx768 corpus for assignment would cost
+    minutes)."""
+    import jax.numpy as jnp
+
+    chunk = matrix[jnp.clip(idx, 0, matrix.shape[0] - 1)]
+    d = D.pairwise_distance(chunk, cents, "euclidean")
+    if k_assign == 1:
+        return jnp.argmin(d, axis=1)
+    return jax.lax.top_k(-d, k_assign)[1]
+
+
+@functools.partial(jax.jit, static_argnames=("nlists",))
+def _kmeans_step(xs, c, nlists: int):
+    import jax.numpy as jnp
+
+    d = D.pairwise_distance(xs, c, "euclidean")
+    a = jnp.argmin(d, axis=1)
+    sums = jax.ops.segment_sum(xs.astype(jnp.float32), a, num_segments=nlists)
+    cnts = jax.ops.segment_sum(jnp.ones(xs.shape[0], jnp.float32), a, num_segments=nlists)
+    # empty clusters keep their previous centroid
+    return jnp.where(cnts[:, None] > 0, sums / jnp.maximum(cnts[:, None], 1.0), c.astype(jnp.float32))
+
+
+def _kmeans_xs(xs, nlists: int, iters: int = 8, seed: int = 7):
+    """Device k-means over an already-device-resident sample [n, D]."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    cents = xs[jnp.asarray(rng.choice(xs.shape[0], size=nlists, replace=False))]
+    for _ in range(iters):
+        cents = _kmeans_step(xs, cents, nlists)
+    return cents
+
+
 def _kmeans(x: np.ndarray, nlists: int, iters: int = 8, seed: int = 7) -> np.ndarray:
-    """Device k-means on a training subsample; returns [C, D] centroids."""
+    """Device k-means on a host training subsample; returns [C, D] centroids."""
     import jax.numpy as jnp
 
     rng = np.random.default_rng(seed)
     n = x.shape[0]
     train_n = min(n, max(nlists * 64, 16384))
     sample = x[rng.choice(n, size=train_n, replace=False)] if train_n < n else x
-    cents = jnp.asarray(sample[rng.choice(train_n, size=nlists, replace=False)])
     xs = jnp.asarray(sample)
-
-    @jax.jit
-    def step(c):
-        d = D.pairwise_distance(xs, c, "euclidean")
-        a = jnp.argmin(d, axis=1)
-        sums = jax.ops.segment_sum(xs.astype(jnp.float32), a, num_segments=nlists)
-        cnts = jax.ops.segment_sum(jnp.ones(xs.shape[0], jnp.float32), a, num_segments=nlists)
-        # empty clusters keep their previous centroid
-        return jnp.where(cnts[:, None] > 0, sums / jnp.maximum(cnts[:, None], 1.0), c)
-
-    for _ in range(iters):
-        cents = step(cents)
-    return np.asarray(cents, dtype=np.float32)
+    return np.asarray(_kmeans_xs(xs, nlists, iters, seed), dtype=np.float32)
 
 
 def _full_assign(
@@ -127,15 +153,45 @@ class IvfState:
 
     # ------------------------------------------------------------ build
     @staticmethod
-    def train(data: np.ndarray, alive: np.ndarray, nlists: Optional[int] = None) -> "IvfState":
+    def train(
+        data: np.ndarray,
+        alive: np.ndarray,
+        nlists: Optional[int] = None,
+        matrix=None,
+    ) -> "IvfState":
+        """Train the quantizer. When `matrix` (the mirror's device-resident
+        [cap, D] array) is given, the training sample and the full corpus
+        assignment gather rows ON DEVICE — only index vectors and the [C, D]
+        centroids cross the slow host<->device link."""
+        import jax.numpy as jnp
+
         rows = np.nonzero(alive)[0]
-        x = np.ascontiguousarray(data[rows], dtype=np.float32)
         c = nlists or default_nlists(rows.size)
-        cents = _kmeans(x, c)
+        if matrix is not None and rows.size:
+            rng = np.random.default_rng(7)
+            train_n = min(rows.size, max(c * 64, 16384))
+            sample_slots = rng.choice(rows, size=train_n, replace=False)
+            xs = matrix[jnp.asarray(sample_slots.astype(np.int32))]
+            cents_dev = _kmeans_xs(xs, c)
+            # full assignment by device gather, chunked index uploads only
+            from surrealdb_tpu.utils.num import pad_tail, tile_slices
+
+            chunk = 65536
+            assign2 = np.empty((rows.size, 2), dtype=np.int32)
+            for lo, hi in tile_slices(rows.size, chunk):
+                idx = pad_tail(rows[lo:hi].astype(np.int32), chunk)
+                a = np.asarray(
+                    _assign_gather(matrix, jnp.asarray(idx), cents_dev, k_assign=2)
+                )
+                assign2[lo:hi] = a[: hi - lo]
+            cents = np.asarray(cents_dev, dtype=np.float32)
+        else:
+            x = np.ascontiguousarray(data[rows], dtype=np.float32)
+            cents = _kmeans(x, c)
+            assign2 = _full_assign(x, cents, k_assign=2)
         # balanced assignment: top-2 candidate cells with spill to the
         # runner-up once the nearest is over 2x the mean size — bounds the
         # padded gather at ~2·N/C per probe instead of the worst cell
-        assign2 = _full_assign(x, cents, k_assign=2)
         cap = max(2 * (rows.size + c - 1) // c, 8)
         lists: List[List[int]] = [[] for _ in range(c)]
         for slot, (a1, a2) in zip(rows.tolist(), assign2.tolist()):
@@ -216,17 +272,18 @@ class IvfState:
         nprobe = min(nprobe, self.nlists)
         # the kernel can return at most nprobe·L candidates per query
         k = min(k, nprobe * int(list_rows.shape[1]))
+        from surrealdb_tpu.utils.num import pad_tail, tile_slices
+
         qs = np.asarray(qs, dtype=np.float32)
+        # adapt the tile to the batch: a lone query must not pay a 64x-padded
+        # candidate gather; pow2 tiles keep the compile-cache small
+        tile = min(_next_pow2(max(qs.shape[0], 1)), tile)
         dd = np.empty((qs.shape[0], k), dtype=np.float32)
         rr = np.empty((qs.shape[0], k), dtype=np.int64)
-        for lo in range(0, qs.shape[0], tile):
-            hi = min(lo + tile, qs.shape[0])
-            qt = qs[lo:hi]
-            pad = tile - (hi - lo)
-            if pad:
-                qt = np.concatenate([qt, np.zeros((pad, qs.shape[1]), np.float32)])
+        for lo, hi in tile_slices(qs.shape[0], tile):
             d, r = _ivf_search(
-                jnp.asarray(qt), cents, list_rows, list_mask, matrix,
+                jnp.asarray(pad_tail(qs[lo:hi], tile)), cents, list_rows,
+                list_mask, matrix,
                 metric=metric, probe_metric=probe_metric, k=k, nprobe=nprobe,
             )
             dd[lo:hi] = np.asarray(d)[: hi - lo]

@@ -192,32 +192,80 @@ class VectorMirror:
             return int(self.alive[: self.n_slots].sum()) if self.built and self.alive is not None else 0
 
     def device_view(self):
-        """(jnp matrix [cap, D], host mask [cap]) for the fused kernels."""
+        """(jnp matrix [cap, D], host mask [cap]) for the fused kernels.
+
+        On accelerator backends the matrix uploads as cnf.TPU_VECTOR_DTYPE
+        (bf16 by default: half the host->device transfer, MXU-native
+        matmuls; distance accumulation stays f32 via
+        preferred_element_type). CPU keeps f32 exactness."""
+        import jax
         import jax.numpy as jnp
 
         with self._lock:
             self._maybe_compact()
             if self.dirty or self._dev_matrix is None:
-                self._dev_matrix = jnp.asarray(self.data)
+                data = self.data
+                if (
+                    cnf.TPU_VECTOR_DTYPE == "bfloat16"
+                    and jax.devices()[0].platform != "cpu"
+                ):
+                    import ml_dtypes
+
+                    data = data.astype(ml_dtypes.bfloat16)  # host-side cast
+                self._dev_matrix = jnp.asarray(data)
                 self.mask = self.alive.copy()
                 self.dirty = False
             return self._dev_matrix, self.mask
+
+    def device_snapshot(self):
+        """(matrix, mask, rids) captured atomically: `rids` is the list
+        OBJECT tied to this matrix's slot numbering. A later compaction
+        installs a NEW list (never renumbering this one in place — appends
+        only), so resolving kernel slots through this snapshot stays correct
+        even if the mirror compacts while the batch is on device."""
+        with self._lock:
+            m, mask = self.device_view()
+            return m, mask, self.rids
 
     def host_view(self):
         """(data [n, D], alive [n], rids) — numpy views for small corpora."""
         with self._lock:
             return self.data[: self.n_slots], self.alive[: self.n_slots], self.rids
 
-    def ensure_ivf(self):
+    def ensure_ivf(self, matrix=None):
         from surrealdb_tpu.idx.ivf import IvfState
 
         with self._lock:
             if self.ivf is None or self.ivf.needs_retrain():
-                self.ivf = IvfState.train(self.data[: self.n_slots], self.alive[: self.n_slots])
+                self.ivf = IvfState.train(
+                    self.data[: self.n_slots],
+                    self.alive[: self.n_slots],
+                    matrix=matrix,
+                )
             return self.ivf
 
 
 
+
+
+def _exact_device_batch(qs: np.ndarray, matrix, mask, metric: str, k: int):
+    """Fused exact distance+top-k over a [Q, D] query batch, Q padded to a
+    pow2 tile (≤64) so coalesced batches of any size reuse one compiled
+    kernel shape instead of recompiling per Q."""
+    import jax.numpy as jnp
+
+    from surrealdb_tpu.utils.num import pad_tail, tile_slices
+
+    nq = qs.shape[0]
+    tile = min(_pow2(max(nq, 1)), 64)
+    mj = jnp.asarray(mask)
+    dd = np.empty((nq, k), dtype=np.float32)
+    rr = np.empty((nq, k), dtype=np.int64)
+    for lo, hi in tile_slices(nq, tile):
+        d, r = D.knn_search(pad_tail(qs[lo:hi], tile), matrix, mj, metric, k)
+        dd[lo:hi] = np.asarray(d)[: hi - lo]
+        rr[lo:hi] = np.asarray(r)[: hi - lo]
+    return dd, rr
 
 
 class _KnnResult:
@@ -321,32 +369,45 @@ class KnnPlan(_KnnExecutorMixin):
         # probed-candidate count)
         if not cnf.TPU_DISABLE and n >= cnf.TPU_ANN_MIN_ROWS and self.k * 4 <= n:
             self.strategy = "ivf"
-            # device_view first: it may compact dead slots, which renumbers
-            # the slot space and invalidates any previously trained IVF
-            matrix, _ = mirror.device_view()
-            ivf = mirror.ensure_ivf()
+            # snapshot first: device_view may compact dead slots, which
+            # renumbers the slot space and invalidates any trained IVF; the
+            # snapshot's rids list is tied to this matrix's numbering
+            matrix, _, rids = mirror.device_snapshot()
+            ivf = mirror.ensure_ivf(matrix)
             from surrealdb_tpu.idx.ivf import default_nprobe
 
             ef = self.ef or self.ix["index"].get("efc")
             nprobe = default_nprobe(ivf.nlists, ef)
-            dists, slots = ivf.search(q, matrix, metric, k, nprobe)
+            # concurrent same-shape queries coalesce into one kernel launch
+            # (dbs/dispatch.py — the cross-query PARALLEL seam). Keyed by the
+            # matrix/ivf identities so a batch never mixes slot numberings.
+            key = ("knn-ivf", id(matrix), id(ivf), metric, k, nprobe)
+
+            def runner(qs):
+                dd, rr = ivf.search_batch(np.stack(qs), matrix, metric, k, nprobe)
+                return list(zip(dd, rr))
+
+            dists, slots = ds.dispatch.submit(key, q, runner)
         elif not cnf.TPU_DISABLE and n >= cnf.TPU_KNN_ONDEVICE_THRESHOLD:
             self.strategy = "exact-device"
-            matrix, mask = mirror.device_view()
-            import jax.numpy as jnp
+            matrix, mask, rids = mirror.device_snapshot()
+            key = ("knn-exact", id(matrix), metric, k)
 
-            dists, slots = D.knn_search(q[None, :], matrix, jnp.asarray(mask), metric, k)
-            dists, slots = np.asarray(dists)[0], np.asarray(slots)[0]
+            def runner(qs):
+                dd, rr = _exact_device_batch(np.stack(qs), matrix, mask, metric, k)
+                return list(zip(dd, rr))
+
+            dists, slots = ds.dispatch.submit(key, q, runner)
         else:
             self.strategy = "exact-host"
-            data, alive, _ = mirror.host_view()
+            data, alive, rids = mirror.host_view()
             live = np.nonzero(alive)[0]
             dists, li = D.knn_search_host(q[None, :], data[live], metric, k)
             dists, slots = dists[0], live[np.asarray(li)[0]]
         for d, s in zip(np.asarray(dists), np.asarray(slots)):
-            if not np.isfinite(d) or s < 0 or s >= len(mirror.rids):
+            if not np.isfinite(d) or s < 0 or s >= len(rids):
                 continue
-            rid = mirror.rids[int(s)]
+            rid = rids[int(s)]
             if not isinstance(rid, Thing):
                 rid = Thing(self.tb, rid)
             self.result.add(rid, float(d))

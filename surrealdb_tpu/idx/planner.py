@@ -276,6 +276,15 @@ def explain(ctx, stm, sources: List[Any], full: bool = False) -> List[dict]:
             out.append({"detail": {"thing": s.t}, "operation": "Iterate Thing"})
         elif isinstance(s, IValue):
             out.append({"detail": {"value": s.v}, "operation": "Iterate Value"})
+    if getattr(stm, "parallel", False) and len(planned) > 1:
+        from surrealdb_tpu import cnf as _cnf
+
+        out.append(
+            {
+                "detail": {"workers": min(len(planned), _cnf.MAX_CONCURRENT_TASKS)},
+                "operation": "Parallel",
+            }
+        )
     if full:
         out.append({"detail": {"type": "Memory"}, "operation": "Collector"})
     return out

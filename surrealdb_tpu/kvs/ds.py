@@ -30,8 +30,12 @@ class Datastore:
         from surrealdb_tpu.idx.store import IndexStores
         from surrealdb_tpu.idx.graph_csr import GraphMirrors
 
+        from surrealdb_tpu.dbs.dispatch import DispatchQueue
+
         self.index_stores = IndexStores()
         self.graph_mirrors = GraphMirrors()
+        # cross-query device dispatch coalescing (dbs/dispatch.py)
+        self.dispatch = DispatchQueue()
         # serializes backend commit + mirror-delta application so two
         # concurrently committing transactions can't apply graph/vector
         # deltas in the opposite order of their backend commits (advisor r2)

@@ -116,8 +116,10 @@ def knn_search_host(
     """numpy twin of knn_search for corpora below the device-dispatch
     threshold (cnf.TPU_KNN_ONDEVICE_THRESHOLD) — a tunnel round-trip costs
     more than scanning a few thousand rows on host."""
-    q = np.asarray(q, dtype=np.float64)
-    x = np.asarray(x, dtype=np.float64)
+    # float32 BLAS: the strongest single-thread CPU formulation (an f64 cast
+    # would copy the whole corpus per call and halve gemm throughput)
+    q = np.asarray(q, dtype=np.float32)
+    x = np.asarray(x, dtype=np.float32)
     if metric == "euclidean":
         d = np.sqrt(
             np.maximum(

@@ -5,3 +5,22 @@ def next_pow2(x: int) -> int:
     """Smallest power of two >= x (>=1). All mirror/kernel static dims round
     through this so steady writes never change compiled shapes."""
     return 1 << max(int(x) - 1, 0).bit_length()
+
+
+def tile_slices(n: int, tile: int):
+    """Yield (lo, hi) covering [0, n) in fixed-size tiles (last may be short);
+    pair with pad_tail so every kernel call keeps one static shape."""
+    for lo in range(0, n, tile):
+        yield lo, min(lo + tile, n)
+
+
+def pad_tail(arr, tile: int):
+    """Zero-pad the leading dim of a host array up to `tile` rows, so a tail
+    chunk reuses the same compiled kernel shape as full chunks."""
+    import numpy as np
+
+    n = arr.shape[0]
+    if n == tile:
+        return arr
+    pad = np.zeros((tile - n,) + arr.shape[1:], dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
