@@ -31,6 +31,20 @@ def main(argv=None) -> int:
     p_start.add_argument("--user", "-u")
     p_start.add_argument("--pass", "-p", dest="password")
     p_start.add_argument("--unauthenticated", action="store_true")
+    # capability flags (reference: surreal start --allow-*/--deny-*)
+    p_start.add_argument("--allow-all", "-A", dest="allow_all", action="store_const", const="all", default=None)
+    p_start.add_argument("--deny-all", dest="deny_all", action="store_const", const="all", default=None)
+    p_start.add_argument("--allow-scripting", dest="allow_scripting", action="store_const", const="all", default=None)
+    p_start.add_argument("--allow-guests", dest="allow_guests", action="store_const", const="all", default=None)
+    p_start.add_argument("--deny-guests", dest="allow_guests", action="store_const", const="none")
+    p_start.add_argument("--allow-funcs", dest="allow_funcs", nargs="?", const="all", default=None)
+    p_start.add_argument("--deny-funcs", dest="deny_funcs", nargs="?", const="all", default=None)
+    p_start.add_argument("--allow-net", dest="allow_net", nargs="?", const="all", default=None)
+    p_start.add_argument("--deny-net", dest="deny_net", nargs="?", const="all", default=None)
+    p_start.add_argument("--allow-rpc", dest="allow_rpc", nargs="?", const="all", default=None)
+    p_start.add_argument("--deny-rpc", dest="deny_rpc", nargs="?", const="all", default=None)
+    p_start.add_argument("--allow-http", dest="allow_http", nargs="?", const="all", default=None)
+    p_start.add_argument("--deny-http", dest="deny_http", nargs="?", const="all", default=None)
 
     p_sql = sub.add_parser("sql", help="interactive SurrealQL shell")
     p_sql.add_argument("--endpoint", "-e", default="mem://")
@@ -105,10 +119,13 @@ def _start(args) -> int:
     from surrealdb_tpu.net.server import serve
     from surrealdb_tpu.dbs.session import Session
 
+    from surrealdb_tpu.dbs.capabilities import from_env_and_args
+
     host, _, port = args.bind.partition(":")
     srv = serve(
         args.path, host or "127.0.0.1", int(port or 8000),
         auth_enabled=not args.unauthenticated,
+        capabilities=from_env_and_args(args),
     )
     if args.user and args.password:
         from surrealdb_tpu.sql.value import format_value

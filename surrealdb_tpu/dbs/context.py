@@ -185,6 +185,10 @@ class Context:
     def ds(self):
         return self.executor.ds
 
+    def capabilities(self):
+        """Datastore-wide allow/deny policy (dbs/capabilities.py)."""
+        return self.executor.ds.capabilities
+
     def ns_db(self):
         ns, db = self.session.ns, self.session.db
         if not ns:

@@ -193,6 +193,43 @@ class InvalidAuthError(AuthError):
         super().__init__(msg)
 
 
+class FunctionNotAllowedError(SurrealError):
+    """Capability denial for a builtin function (reference:
+    Error::FunctionNotAllowed)."""
+
+    def __init__(self, name: str):
+        super().__init__(f"Function '{name}' is not allowed to be executed")
+        self.name = name
+
+
+class NetTargetNotAllowedError(SurrealError):
+    """Capability denial for an outbound network target (reference:
+    Error::NetTargetNotAllowed)."""
+
+    def __init__(self, target: str):
+        super().__init__(
+            f"Access to network target '{target}' is not allowed"
+        )
+        self.target = target
+
+
+class MethodNotAllowedError(SurrealError):
+    """Capability denial for an RPC method (reference: RpcError +
+    capabilities allows_rpc_method)."""
+
+    def __init__(self, method: str):
+        super().__init__(f"Method '{method}' is not allowed to be called")
+        self.method = method
+
+
+class RouteNotAllowedError(SurrealError):
+    """Capability denial for an HTTP route (reference: Error::ForbiddenRoute)."""
+
+    def __init__(self, route: str):
+        super().__init__(f"Forbidden route '{route}'")
+        self.route = route
+
+
 class ExpiredTokenError(AuthError):
     def __init__(self):
         super().__init__("The token has expired")

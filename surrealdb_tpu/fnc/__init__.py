@@ -39,11 +39,18 @@ def register_all(mapping: Dict[str, Callable]) -> None:
 
 
 def run(ctx, name: str, args: List[Any], exprs=None) -> Any:
-    """Execute builtin `name` with already-computed args."""
+    """Execute builtin `name` with already-computed args. The datastore's
+    capabilities gate every call (reference: fnc/mod.rs idiom() checks
+    ctx.check_allowed_function before dispatch)."""
     key = name.lower()
     fn = REGISTRY.get(key)
     if fn is None:
         raise SurrealError(f"The function '{name}' does not exist")
+    caps = ctx.capabilities() if hasattr(ctx, "capabilities") else None
+    if caps is not None and not caps.allows_function_name(key):
+        from surrealdb_tpu.err import FunctionNotAllowedError
+
+        raise FunctionNotAllowedError(name)
     try:
         return fn(ctx, *args)
     except TypeError as e:
@@ -77,9 +84,17 @@ def run_method(ctx, method: str, receiver: Any, args: List[Any]) -> Any:
     m = method.lower()
     candidates = [f"{ns}::{m}" for ns in _method_namespaces(receiver)]
     candidates += [f"type::{m}", m]
+    caps = ctx.capabilities() if hasattr(ctx, "capabilities") else None
     for key in candidates:
         fn = REGISTRY.get(key)
         if fn is not None:
+            # method syntax resolves to the same builtin — same capability
+            # gate as a direct call (a denied family must not be reachable
+            # as `value.method()`)
+            if caps is not None and not caps.allows_function_name(key):
+                from surrealdb_tpu.err import FunctionNotAllowedError
+
+                raise FunctionNotAllowedError(key)
             return fn(ctx, receiver, *args)
     raise SurrealError(f"The method '{method}()' does not exist")
 
@@ -115,6 +130,7 @@ from . import crypto_fns  # noqa: E402,F401
 from . import duration_fns  # noqa: E402,F401
 from . import encoding_fns  # noqa: E402,F401
 from . import geo_fns  # noqa: E402,F401
+from . import http_fns  # noqa: E402,F401
 from . import math_fns  # noqa: E402,F401
 from . import object_fns  # noqa: E402,F401
 from . import parse_fns  # noqa: E402,F401

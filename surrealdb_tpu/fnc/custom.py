@@ -38,6 +38,11 @@ def _check_fc_permission(ctx, name: str, fc: dict) -> None:
 
 
 def run_custom(ctx, name: str, args: List[Any]) -> Any:
+    caps = ctx.capabilities() if hasattr(ctx, "capabilities") else None
+    if caps is not None and not caps.allows_function_name(f"fn::{name}"):
+        from surrealdb_tpu.err import FunctionNotAllowedError
+
+        raise FunctionNotAllowedError(f"fn::{name}")
     ns, db = ctx.ns_db()
     fc = ctx.txn().get_fc(ns, db, name)
     if fc is None:

@@ -43,6 +43,12 @@ class Datastore:
         # live queries: uuid(hex) -> LiveSubscription (registered in M10)
         self.notifications = None  # set by enable_notifications()
         self.auth_enabled = False
+        # operator-controllable allow/deny policy (dbs/capabilities.py;
+        # reference core/src/dbs/capabilities.rs). Servers override from
+        # CLI/env; embedded use keeps the defaults.
+        from surrealdb_tpu.dbs.capabilities import Capabilities
+
+        self.capabilities = Capabilities.default()
 
     @staticmethod
     def _open(path: str) -> BackendDatastore:

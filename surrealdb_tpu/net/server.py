@@ -125,23 +125,46 @@ class SurrealHandler(BaseHTTPRequestHandler):
 
     def _authorized_session(self) -> Session:
         """Session for a data-access route: anonymous is rejected when auth
-        is enabled (reference: guest access capability, default deny)."""
+        is enabled unless the operator granted the guest-access capability
+        (reference: capabilities.rs allows_guest_access, default deny)."""
         sess = self._session()
-        if self.auth_enabled and sess.auth.is_anon():
+        if (
+            self.auth_enabled
+            and sess.auth.is_anon()
+            and not self.ds.capabilities.allows_guest_access()
+        ):
             raise InvalidAuthError()
         return sess
+
+    def _route_allowed(self, route: str) -> bool:
+        """HTTP-route capability gate (reference: RouteTarget allow/deny).
+        Sends the 403 itself when denied."""
+        if self.ds.capabilities.allows_http_route(route):
+            return True
+        from surrealdb_tpu.err import RouteNotAllowedError
+
+        self._send(403, {"error": str(RouteNotAllowedError(route))})
+        return False
 
     # ------------------------------------------------------------ routes
     @_capped
     def do_GET(self):
         path = urlparse(self.path).path
         if path == "/health":
+            if not self._route_allowed("health"):
+                return
             return self._send(200, {"status": "ok"})
         if path == "/version":
+            if not self._route_allowed("version"):
+                return
             return self._send(200, f"surrealdb-tpu-{__version__}", "text/plain")
         if path == "/rpc" and (self.headers.get("Upgrade") or "").lower() == "websocket":
+            if not self._route_allowed("rpc"):
+                return
             return self._ws_upgrade()
         if path == "/export":
+            if not self._route_allowed("export"):
+                return
             try:
                 sess = self._authorized_session()
                 # export dumps raw KV state, bypassing table/field PERMISSIONS,
@@ -160,8 +183,12 @@ class SurrealHandler(BaseHTTPRequestHandler):
             except SurrealError as e:
                 return self._send(401, {"error": str(e)})
         if path.startswith("/ml/export/"):
+            if not self._route_allowed("ml"):
+                return
             return self._ml_export(path)
         if path.startswith("/key/"):
+            if not self._route_allowed("key"):
+                return
             return self._key_route("GET")
         return self._send(404, {"error": "not found"})
 
@@ -169,16 +196,28 @@ class SurrealHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         path = urlparse(self.path).path
         if path == "/sql":
+            if not self._route_allowed("sql"):
+                return
             return self._sql()
         if path == "/rpc":
+            if not self._route_allowed("rpc"):
+                return
             return self._rpc_http()
         if path == "/signin":
+            if not self._route_allowed("signin"):
+                return
             return self._auth_route("signin")
         if path == "/signup":
+            if not self._route_allowed("signup"):
+                return
             return self._auth_route("signup")
         if path == "/ml/import":
+            if not self._route_allowed("ml"):
+                return
             return self._ml_import()
         if path == "/import":
+            if not self._route_allowed("import"):
+                return
             try:
                 sess = self._authorized_session()
                 out = self.ds.execute(self._body().decode(), sess)
@@ -194,18 +233,24 @@ class SurrealHandler(BaseHTTPRequestHandler):
     @_capped
     def do_PUT(self):
         if urlparse(self.path).path.startswith("/key/"):
+            if not self._route_allowed("key"):
+                return
             return self._key_route("PUT")
         return self._send(404, {"error": "not found"})
 
     @_capped
     def do_PATCH(self):
         if urlparse(self.path).path.startswith("/key/"):
+            if not self._route_allowed("key"):
+                return
             return self._key_route("PATCH")
         return self._send(404, {"error": "not found"})
 
     @_capped
     def do_DELETE(self):
         if urlparse(self.path).path.startswith("/key/"):
+            if not self._route_allowed("key"):
+                return
             return self._key_route("DELETE")
         return self._send(404, {"error": "not found"})
 
@@ -279,12 +324,30 @@ class SurrealHandler(BaseHTTPRequestHandler):
             return self._send(400, {"error": str(e)})
         return self._send(200, out)
 
-    # RPC methods an unauthenticated client may call (the authentication
-    # bootstrap itself plus connection management); everything else touches
-    # data and follows the /sql route's default-deny guest policy
+    # RPC methods an unauthenticated client may always call (the
+    # authentication bootstrap itself plus connection management); whether
+    # anonymous clients may call anything ELSE is the operator-controlled
+    # guest-access capability (reference: rpc layer + allows_guest_access)
     _RPC_ANON_METHODS = frozenset(
         {"ping", "version", "use", "signin", "signup", "authenticate", "invalidate"}
     )
+
+    def _rpc_denied(self, method: str, sess) -> str | None:
+        """Capability policy for one RPC call; returns a denial message or
+        None. Method allow/deny applies to every caller; anonymous callers
+        additionally need guest access for non-bootstrap methods."""
+        if not self.ds.capabilities.allows_rpc_method(method):
+            from surrealdb_tpu.err import MethodNotAllowedError
+
+            return str(MethodNotAllowedError(method))
+        if (
+            self.auth_enabled
+            and sess.auth.is_anon()
+            and method not in self._RPC_ANON_METHODS
+            and not self.ds.capabilities.allows_guest_access()
+        ):
+            return "Not authenticated"
+        return None
 
     def _system_session(self):
         """Session for model import/export: system user covering the db
@@ -350,9 +413,10 @@ class SurrealHandler(BaseHTTPRequestHandler):
             return self._send(401, {"error": str(e)})
         rid = req.get("id")
         method = req.get("method", "")
-        if self.auth_enabled and sess.auth.is_anon() and method not in self._RPC_ANON_METHODS:
+        denied = self._rpc_denied(method, sess)
+        if denied is not None:
             return self._send(
-                401, {"id": rid, "error": {"code": -32000, "message": "Not authenticated"}}, ct
+                401, {"id": rid, "error": {"code": -32000, "message": denied}}, ct
             )
         ctx = RpcContext(self.ds, sess)
         try:
@@ -442,15 +506,12 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 rid = req.get("id")
                 method = req.get("method", "")
                 try:
-                    # same default-deny guest policy as HTTP /rpc; checked per
+                    # same capability policy as HTTP /rpc; checked per
                     # message because signin/authenticate upgrade the session
                     # mid-connection
-                    if (
-                        self.auth_enabled
-                        and ctx.session.auth.is_anon()
-                        and method not in self._RPC_ANON_METHODS
-                    ):
-                        raise InvalidAuthError()
+                    denied = self._rpc_denied(method, ctx.session)
+                    if denied is not None:
+                        raise InvalidAuthError(denied)
                     result = ctx.execute(method, req.get("params") or [])
                     resp: Dict[str, Any] = {"id": rid, "result": result}
                 except SurrealError as e:
@@ -499,9 +560,17 @@ class Server:
             self._thread.join(timeout=5)
 
 
-def serve(path: str = "memory", host: str = "127.0.0.1", port: int = 8000, auth_enabled: bool = True) -> Server:
+def serve(
+    path: str = "memory",
+    host: str = "127.0.0.1",
+    port: int = 8000,
+    auth_enabled: bool = True,
+    capabilities=None,
+) -> Server:
     from surrealdb_tpu.kvs.ds import Datastore
 
     ds = Datastore(path)
     ds.enable_notifications()
+    if capabilities is not None:
+        ds.capabilities = capabilities
     return Server(ds, host, port, auth_enabled)

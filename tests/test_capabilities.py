@@ -1,0 +1,232 @@
+"""Capabilities system: allow/deny for guests, functions, net targets, RPC
+methods, HTTP routes (VERDICT r2 item 3; reference:
+core/src/dbs/capabilities.rs)."""
+
+import base64
+import http.client
+import json
+
+import pytest
+
+from surrealdb_tpu.dbs.capabilities import (
+    Capabilities,
+    FuncTarget,
+    NetTarget,
+    Targets,
+    _Member,
+    from_env_and_args,
+    parse_targets,
+)
+from surrealdb_tpu.dbs.session import Session
+
+
+# ------------------------------------------------------------------ targets
+def test_func_target_matching():
+    fam = FuncTarget.parse("http")
+    assert fam.matches("http::get") and fam.matches("http") and not fam.matches("math::abs")
+    star = FuncTarget.parse("math::*")
+    assert star.matches("math::abs") and not star.matches("time::now")
+    one = FuncTarget.parse("math::abs")
+    assert one.matches("math::abs") and not one.matches("math::ceil")
+
+
+def test_net_target_matching():
+    cidr = NetTarget.parse("10.0.0.0/8")
+    assert cidr.matches("10.1.2.3") and not cidr.matches("11.0.0.1")
+    host = NetTarget.parse("example.com:443")
+    assert host.matches("EXAMPLE.com", 443) and not host.matches("example.com", 80)
+    ip = NetTarget.parse("127.0.0.1")
+    assert ip.matches("127.0.0.1", 9999)  # no port constraint
+
+
+def test_parse_targets_specs():
+    assert parse_targets("all", FuncTarget.parse).kind == "all"
+    assert parse_targets("none", FuncTarget.parse).kind == "none"
+    t = parse_targets("math,string::lowercase", FuncTarget.parse)
+    assert t.matches("math::abs") and t.matches("string::lowercase")
+    assert not t.matches("string::uppercase")
+
+
+def test_deny_overrides_allow():
+    caps = Capabilities.default().without_functions(
+        parse_targets("crypto", FuncTarget.parse)
+    )
+    assert caps.allows_function_name("math::abs")
+    assert not caps.allows_function_name("crypto::md5")
+
+
+def test_all_none_presets():
+    assert Capabilities.all().allows_guest_access()
+    assert Capabilities.all().allows_network_target("anywhere.example")
+    none = Capabilities.none()
+    assert not none.allows_function_name("math::abs")
+    assert not none.allows_rpc_method("query")
+    assert not none.allows_http_route("sql")
+
+
+def test_from_env(monkeypatch):
+    monkeypatch.setenv("SURREAL_CAPS_ALLOW_GUESTS", "true")
+    monkeypatch.setenv("SURREAL_CAPS_DENY_FUNC", "http")
+    caps = from_env_and_args()
+    assert caps.allows_guest_access()
+    assert not caps.allows_function_name("http::get")
+    assert caps.allows_function_name("math::abs")
+
+
+# ------------------------------------------------------------------ engine
+def test_denied_function_rejected_in_query(ds):
+    ds.capabilities = Capabilities.default().without_functions(
+        parse_targets("rand", FuncTarget.parse)
+    )
+    out = ds.execute("RETURN rand::uuid();")
+    assert out[0]["status"] == "ERR"
+    assert "not allowed" in out[0]["result"]
+    # unrelated namespaces still work
+    ok = ds.execute("RETURN math::abs(-2);")
+    assert ok[0]["status"] == "OK" and ok[0]["result"] == 2
+
+
+def test_function_allowlist_admits(ds):
+    ds.capabilities = Capabilities.default().with_functions(
+        parse_targets("math::abs", FuncTarget.parse)
+    )
+    assert ds.execute("RETURN math::abs(-1);")[0]["result"] == 1
+    out = ds.execute("RETURN math::ceil(1.2);")
+    assert out[0]["status"] == "ERR" and "not allowed" in out[0]["result"]
+
+
+# ------------------------------------------------------------------ server
+@pytest.fixture()
+def capped_server(ds):
+    from surrealdb_tpu.net.server import Server
+
+    ds.execute("CREATE a:1;")
+    ds.execute(
+        "DEFINE USER nsu ON NAMESPACE PASSWORD 'pw' ROLES EDITOR;",
+        Session.owner("test", None),
+    )
+    srv = Server(ds, port=0, auth_enabled=True).start_background()
+    yield srv, ds
+    srv.shutdown()
+
+
+def _req(srv, method, path, body=None, authed=False):
+    c = http.client.HTTPConnection(srv.host, srv.port)
+    hdrs = {"surreal-ns": "test", "surreal-db": "test"}
+    if authed:
+        hdrs["Authorization"] = "Basic " + base64.b64encode(b"nsu:pw").decode()
+    c.request(method, path, body, hdrs)
+    r = c.getresponse()
+    data = r.read()
+    c.close()
+    return r.status, data
+
+
+def test_denied_http_route_403(capped_server):
+    srv, ds = capped_server
+    ds.capabilities = Capabilities.default().without_http_routes(
+        Targets.some([_Member("sql"), _Member("key")])
+    )
+    status, body = _req(srv, "POST", "/sql", "RETURN 1;", authed=True)
+    assert status == 403 and b"Forbidden" in body
+    status, _ = _req(srv, "GET", "/key/a", authed=True)
+    assert status == 403
+    # undenied routes still work
+    status, _ = _req(srv, "GET", "/health")
+    assert status == 200
+
+
+def test_guest_access_capability(capped_server):
+    srv, ds = capped_server
+    # default: guests denied
+    status, _ = _req(srv, "POST", "/sql", "SELECT * FROM a;")
+    assert status == 401
+    # grant guest access: anonymous queries run (subject to PERMISSIONS)
+    ds.capabilities = Capabilities.default().with_guest_access(True)
+    status, body = _req(srv, "POST", "/sql", "RETURN 1;")
+    assert status == 200 and json.loads(body)[0]["result"] == 1
+
+
+def test_denied_rpc_method(capped_server):
+    srv, ds = capped_server
+    ds.capabilities = Capabilities.default().without_rpc_methods(
+        Targets.some([_Member("query")])
+    )
+    req = json.dumps({"id": 1, "method": "query", "params": ["RETURN 1;"]})
+    status, body = _req(srv, "POST", "/rpc", req, authed=True)
+    assert status == 401 and b"not allowed" in body
+    req = json.dumps({"id": 2, "method": "version", "params": []})
+    status, body = _req(srv, "POST", "/rpc", req, authed=True)
+    assert status == 200
+
+
+# ------------------------------------------------------------------ review regressions
+def test_method_syntax_respects_function_capability(ds):
+    ds.capabilities = Capabilities.default().without_functions(
+        parse_targets("string", FuncTarget.parse)
+    )
+    out = ds.execute("LET $v = \"x\"; RETURN $v.uppercase();")
+    assert out[-1]["status"] == "ERR" and "not allowed" in out[-1]["result"]
+
+
+def test_custom_fn_respects_function_capability(ds):
+    ds.execute("DEFINE FUNCTION fn::f() { RETURN 42 };")
+    assert ds.execute("RETURN fn::f();")[0]["result"] == 42
+    ds.capabilities = Capabilities.none()
+    out = ds.execute("RETURN fn::f();")
+    assert out[0]["status"] == "ERR" and "not allowed" in out[0]["result"]
+
+
+def test_env_falsy_values(monkeypatch):
+    monkeypatch.setenv("SURREAL_CAPS_ALLOW_ALL", "false")
+    caps = from_env_and_args()
+    assert not caps.allows_guest_access()  # still the default, not all()
+    monkeypatch.setenv("SURREAL_CAPS_ALLOW_GUESTS", "0")
+    assert not from_env_and_args().allows_guest_access()
+
+
+def test_mixed_case_func_target_spec():
+    caps = Capabilities.default().without_functions(
+        parse_targets("Crypto", FuncTarget.parse)
+    )
+    assert not caps.allows_function_name("crypto::md5")
+
+
+def test_http_fn_denied_without_net_capability(ds):
+    out = ds.execute("RETURN http::get(\"http://127.0.0.1:1/x\");")
+    assert out[0]["status"] == "ERR"
+    assert "network target" in out[0]["result"]
+
+
+def test_http_fn_allowed_net_target_reaches_server(ds):
+    import http.server
+    import threading
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b'{"ok": true}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        port = httpd.server_address[1]
+        ds.capabilities = Capabilities.default().with_network_targets(
+            parse_targets("127.0.0.1", NetTarget.parse)
+        )
+        out = ds.execute(f"RETURN http::get(\"http://127.0.0.1:{port}/\");")
+        assert out[0]["status"] == "OK", out
+        assert out[0]["result"] == {"ok": True}
+        # a non-allowed host is still rejected
+        out = ds.execute("RETURN http::get(\"http://10.9.9.9/\");")
+        assert out[0]["status"] == "ERR" and "network target" in out[0]["result"]
+    finally:
+        httpd.shutdown()
