@@ -146,17 +146,26 @@ def _def_index(ctx, a) -> Any:
     txn.ensure_tb(ns, db, tb)
     if _guard(txn.get_tb_index(ns, db, tb, name), a, "index", name):
         return NONE
+    concurrent = bool(a.get("concurrently"))
     d = {
         "name": name,
         "table": tb,
         "fields": a.get("fields", []),
         "index": a.get("index", {"type": "idx"}),
         "comment": a.get("comment"),
-        "status": "ready",
+        "status": "building" if concurrent else "ready",
     }
     txn.put_tb_index(ns, db, tb, name, d)
-    # build over existing records (CONCURRENTLY builds run inline for now —
-    # the async builder lands with the background-task milestone)
+    if concurrent:
+        # async initial build (reference kvs/index.rs): kick AFTER this
+        # transaction commits so the builder's txns see the definition;
+        # the planner refuses the index until its status flips to ready
+        ds = ctx.ds()
+        sess = ctx.session
+
+        txn.on_commit(lambda: ds.index_builder.build(ns, db, tb, d, sess))
+        return NONE
+    # inline build over existing records
     from surrealdb_tpu.idx.index import rebuild_index
 
     rebuild_index(ctx, tb, d)

@@ -71,7 +71,17 @@ def info_compute(ctx, stm) -> Any:
         ix = txn.get_tb_index(ns, db, tb, name)
         if ix is None:
             raise IxNotFoundError(name)
-        return {"building": {"status": ix.get("status", "ready")}}
+        building: Dict[str, Any] = {"status": ix.get("status", "ready")}
+        live = ctx.ds().index_builder.status(ns, db, tb, name)
+        if live is not None:
+            building.update(live)
+        out: Dict[str, Any] = {"building": building}
+        # ANN state: a trained/stale/absent IVF over the vector mirror
+        if ix.get("index", {}).get("type") in ("hnsw", "mtree"):
+            mirror = ctx.ds().index_stores.get(ns, db, tb, name)
+            if mirror is not None and hasattr(mirror, "ivf_status"):
+                out["ann"] = mirror.ivf_status()
+        return out
     if level == "user":
         user = stm.target
         d = txn.get_root_user(user)

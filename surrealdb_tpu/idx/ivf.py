@@ -144,6 +144,7 @@ class IvfState:
         self.lists = lists  # C lists of row slots
         self.slot_list: Dict[int, int] = {s: i for i, l in enumerate(lists) for s in l}
         self.trained_n = trained_n
+        self._n = len(self.slot_list)  # O(1) size, maintained by add/remove
         self.dirty = True
         self._dev = None  # (cents, list_rows, list_mask)
 
@@ -201,25 +202,29 @@ class IvfState:
 
     # ------------------------------------------------------------ writes
     def add(self, slot: int, vec: np.ndarray) -> None:
+        if slot in self.slot_list:
+            return  # idempotent (reconciliation may revisit a slot)
         d2 = ((self.centroids - vec[None, :]) ** 2).sum(1)
         a1, a2 = np.argpartition(d2, 1)[:2]
-        cap = max(2 * (self.size() // max(self.nlists, 1) + 1), 8)
+        cap = max(2 * (self._n // max(self.nlists, 1) + 1), 8)
         a = int(a1) if len(self.lists[a1]) < cap or len(self.lists[a2]) >= len(self.lists[a1]) else int(a2)
         self.lists[a].append(slot)
         self.slot_list[slot] = a
+        self._n += 1
         self.dirty = True
 
-    def remove(self, slot: int, vec: np.ndarray) -> None:
+    def remove(self, slot: int, vec=None) -> None:
         a = self.slot_list.pop(slot, None)
         if a is not None:
             try:
                 self.lists[a].remove(slot)
+                self._n -= 1
             except ValueError:
                 pass
         self.dirty = True
 
     def size(self) -> int:
-        return sum(len(l) for l in self.lists)
+        return self._n
 
     def needs_retrain(self) -> bool:
         return self.size() > 1.5 * max(self.trained_n, 1)

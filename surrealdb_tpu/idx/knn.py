@@ -16,6 +16,7 @@ vector::distance::knn().
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -62,11 +63,17 @@ class VectorMirror:
         self.alive: Optional[np.ndarray] = None  # [cap] bool
         self.n_slots = 0
         self.dirty = True
+        self.gen = 0  # bumped on every mutation; caches key off it
         self.matrix = None  # device jnp [cap, D]
         self.mask: Optional[np.ndarray] = None
         self._dev_matrix = None
         self.ivf = None  # IvfState, built on demand
+        self._ivf_building = False
+        self._ivf_done = threading.Event()  # signals a finished train round
+        self._train_touched: Optional[set] = None  # slots mutated mid-train
+        self._renumber = 0  # bumped when compaction renumbers slots
         self._pending: Optional[List[tuple]] = None  # deltas during build
+        self._host_cache = None  # (contig data, sq-norms, rids) for host search
         self._lock = threading.RLock()
         self._build_lock = threading.Lock()
 
@@ -108,6 +115,7 @@ class VectorMirror:
                 self.slot_of = {_rid_key(r): i for i, r in enumerate(rids)}
                 self.n_slots = len(rids)
                 self.dirty = True
+                self.gen += 1
                 self.built = True
                 pending, self._pending = self._pending, None
                 # replay INSIDE the lock (RLock): a delta committed after
@@ -136,6 +144,7 @@ class VectorMirror:
                         self.ivf.remove(slot, self.data[slot])
                     del self.slot_of[k]
                 self.dirty = True
+                self.gen += 1
                 return
             v = np.asarray(vec, dtype=np.float32)
             if slot is not None:  # overwrite in place
@@ -144,7 +153,10 @@ class VectorMirror:
                 self.data[slot] = v
                 if self.ivf is not None:
                     self.ivf.add(slot, v)
+                if self._train_touched is not None:
+                    self._train_touched.add(slot)
                 self.dirty = True
+                self.gen += 1
                 return
             if self.n_slots >= self.data.shape[0] or v.shape[0] != self.data.shape[1]:
                 self._grow(v.shape[0])
@@ -160,6 +172,7 @@ class VectorMirror:
             if self.ivf is not None:
                 self.ivf.add(slot, v)
             self.dirty = True
+            self.gen += 1
 
     def _grow(self, dim: int) -> None:
         cap = max(_pow2(self.n_slots + 1), cnf.TPU_BATCH_MIN_TILE)
@@ -184,6 +197,8 @@ class VectorMirror:
         self.rids = [self.rids[i] for i in live.tolist()]
         self.slot_of = {_rid_key(r): i for i, r in enumerate(self.rids)}
         self.data, self.alive, self.n_slots = data, alive, live.size
+        self.gen += 1  # slot space renumbered
+        self._renumber += 1
         self.ivf = None  # slot space changed; retrain on next ANN query
 
     # ------------------------------------------------------------ views
@@ -232,17 +247,104 @@ class VectorMirror:
         with self._lock:
             return self.data[: self.n_slots], self.alive[: self.n_slots], self.rids
 
+    def host_search_view(self):
+        """(contiguous live rows [m, D] f32, their squared norms [m], live
+        rids) cached across queries, keyed off the mutation generation —
+        the CPU search path must not re-copy the corpus or recompute norms
+        per query (it IS the baseline the device path is judged against,
+        so it gets the same care)."""
+        with self._lock:
+            if self._host_cache is None or self._host_cache[0] != self.gen:
+                live = np.nonzero(self.alive[: self.n_slots])[0]
+                data = np.ascontiguousarray(self.data[live], dtype=np.float32)
+                norms = (data.astype(np.float64) ** 2).sum(axis=1).astype(np.float32)
+                rids = [self.rids[i] for i in live.tolist()]
+                self._host_cache = (self.gen, data, norms, rids)
+            return self._host_cache[1:]
+
     def ensure_ivf(self, matrix=None):
+        """Return the current IVF state WITHOUT ever blocking the query:
+        a missing or outgrown quantizer kicks a background training thread
+        and the caller serves this query from the stale IVF (or, when None,
+        the exact fused kernel). No query pays the multi-second training
+        cliff (reference analog: the async builder, kvs/index.rs:28-41)."""
+        with self._lock:
+            ivf = self.ivf
+            if ivf is not None and not ivf.needs_retrain():
+                return ivf
+            if self._ivf_building or matrix is None:
+                return ivf
+            self._ivf_building = True
+            self._ivf_done.clear()
+            self._train_touched = set()
+            alive = self.alive[: self.n_slots].copy()
+            data = self.data
+            renum0 = self._renumber
+        threading.Thread(
+            target=self._train_ivf, args=(data, alive, matrix, renum0), daemon=True
+        ).start()
+        return ivf
+
+    def _train_ivf(self, data, alive, matrix, renum0: int) -> None:
         from surrealdb_tpu.idx.ivf import IvfState
 
+        try:
+            new = IvfState.train(data[: alive.size], alive, matrix=matrix)
+        except BaseException:
+            with self._lock:
+                self._ivf_building = False
+                self._train_touched = None
+                self._ivf_done.set()
+            raise
         with self._lock:
-            if self.ivf is None or self.ivf.needs_retrain():
-                self.ivf = IvfState.train(
-                    self.data[: self.n_slots],
-                    self.alive[: self.n_slots],
-                    matrix=matrix,
-                )
-            return self.ivf
+            self._ivf_building = False
+            touched, self._train_touched = self._train_touched, None
+            self._ivf_done.set()
+            if self._renumber != renum0:
+                return  # slot space renumbered mid-train; next query re-kicks
+            # reconcile rows that changed while training ran on the snapshot
+            cur = self.alive[: self.n_slots]
+            for slot in range(alive.size, self.n_slots):  # appended rows
+                if cur[slot]:
+                    new.add(slot, self.data[slot])
+            for slot in np.nonzero(~cur[: alive.size] & alive)[0]:  # tombstoned
+                new.remove(int(slot), None)
+            for slot in touched or ():  # overwritten in place mid-train
+                new.remove(slot, None)
+                if slot < self.n_slots and cur[slot]:
+                    new.add(slot, self.data[slot])
+            self.ivf = new
+
+    def wait_ivf(self, timeout: float = 60.0) -> bool:
+        """Block until the in-flight training round (if any) finishes —
+        test/bench determinism helper, never used on the query path."""
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            with self._lock:
+                if self.ivf is not None and not self._ivf_building:
+                    return True
+                building = self._ivf_building
+            if not building:
+                return False  # nothing training and no ivf (e.g. never kicked)
+            self._ivf_done.wait(min(1.0, timeout))
+        return False
+
+    def ivf_status(self) -> dict:
+        """INFO FOR INDEX 'ann' section."""
+        with self._lock:
+            if self._ivf_building:
+                state = "training"
+            elif self.ivf is None:
+                state = "none"
+            elif self.ivf.needs_retrain():
+                state = "stale"
+            else:
+                state = "ready"
+            out = {"state": state}
+            if self.ivf is not None:
+                out["nlists"] = self.ivf.nlists
+                out["trained_n"] = self.ivf.trained_n
+            return out
 
 
 
@@ -372,22 +474,35 @@ class KnnPlan(_KnnExecutorMixin):
             # snapshot first: device_view may compact dead slots, which
             # renumbers the slot space and invalidates any trained IVF; the
             # snapshot's rids list is tied to this matrix's numbering
-            matrix, _, rids = mirror.device_snapshot()
+            matrix, mask, rids = mirror.device_snapshot()
             ivf = mirror.ensure_ivf(matrix)
-            from surrealdb_tpu.idx.ivf import default_nprobe
+            if ivf is None:
+                # quantizer still training in the background: serve this
+                # query exactly (no latency cliff, full recall)
+                self.strategy = "exact-device(ivf-training)"
+                key = ("knn-exact", id(matrix), metric, k)
 
-            ef = self.ef or self.ix["index"].get("efc")
-            nprobe = default_nprobe(ivf.nlists, ef)
-            # concurrent same-shape queries coalesce into one kernel launch
-            # (dbs/dispatch.py — the cross-query PARALLEL seam). Keyed by the
-            # matrix/ivf identities so a batch never mixes slot numberings.
-            key = ("knn-ivf", id(matrix), id(ivf), metric, k, nprobe)
+                def runner(qs):
+                    dd, rr = _exact_device_batch(np.stack(qs), matrix, mask, metric, k)
+                    return list(zip(dd, rr))
 
-            def runner(qs):
-                dd, rr = ivf.search_batch(np.stack(qs), matrix, metric, k, nprobe)
-                return list(zip(dd, rr))
+                dists, slots = ds.dispatch.submit(key, q, runner)
+            else:
+                from surrealdb_tpu.idx.ivf import default_nprobe
 
-            dists, slots = ds.dispatch.submit(key, q, runner)
+                ef = self.ef or self.ix["index"].get("efc")
+                nprobe = default_nprobe(ivf.nlists, ef)
+                # concurrent same-shape queries coalesce into one kernel
+                # launch (dbs/dispatch.py — the cross-query PARALLEL seam).
+                # Keyed by the matrix/ivf identities so a batch never mixes
+                # slot numberings.
+                key = ("knn-ivf", id(matrix), id(ivf), metric, k, nprobe)
+
+                def runner(qs):
+                    dd, rr = ivf.search_batch(np.stack(qs), matrix, metric, k, nprobe)
+                    return list(zip(dd, rr))
+
+                dists, slots = ds.dispatch.submit(key, q, runner)
         elif not cnf.TPU_DISABLE and n >= cnf.TPU_KNN_ONDEVICE_THRESHOLD:
             self.strategy = "exact-device"
             matrix, mask, rids = mirror.device_snapshot()
@@ -400,10 +515,11 @@ class KnnPlan(_KnnExecutorMixin):
             dists, slots = ds.dispatch.submit(key, q, runner)
         else:
             self.strategy = "exact-host"
-            data, alive, rids = mirror.host_view()
-            live = np.nonzero(alive)[0]
-            dists, li = D.knn_search_host(q[None, :], data[live], metric, k)
-            dists, slots = dists[0], live[np.asarray(li)[0]]
+            data, norms, rids = mirror.host_search_view()
+            dists, li = D.knn_search_host(
+                q[None, :], data, metric, k, x_sq_norms=norms
+            )
+            dists, slots = dists[0], np.asarray(li)[0]
         for d, s in zip(np.asarray(dists), np.asarray(slots)):
             if not np.isfinite(d) or s < 0 or s >= len(rids):
                 continue

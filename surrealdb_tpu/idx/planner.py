@@ -142,6 +142,8 @@ def build_plan(ctx, stm, tb: str, with_) -> Optional[Any]:
     ns, db = ctx.ns_db()
     txn = ctx.txn()
     indexes = txn.all_tb_indexes(ns, db, tb)
+    # an index mid-build (CONCURRENTLY) must not serve reads yet
+    indexes = [ix for ix in indexes if ix.get("status", "ready") == "ready"]
     if not indexes:
         return None
     if with_ is not None and with_.indexes:

@@ -31,11 +31,14 @@ class Datastore:
         from surrealdb_tpu.idx.graph_csr import GraphMirrors
 
         from surrealdb_tpu.dbs.dispatch import DispatchQueue
+        from surrealdb_tpu.idx.builder import IndexBuilder
 
         self.index_stores = IndexStores()
         self.graph_mirrors = GraphMirrors()
         # cross-query device dispatch coalescing (dbs/dispatch.py)
         self.dispatch = DispatchQueue()
+        # background index builds (DEFINE INDEX ... CONCURRENTLY)
+        self.index_builder = IndexBuilder(self)
         # serializes backend commit + mirror-delta application so two
         # concurrently committing transactions can't apply graph/vector
         # deltas in the opposite order of their backend commits (advisor r2)

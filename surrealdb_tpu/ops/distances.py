@@ -111,19 +111,22 @@ def pad_rows(arr: np.ndarray, multiple: int) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def knn_search_host(
-    q: np.ndarray, x: np.ndarray, metric: str, k: int
+    q: np.ndarray, x: np.ndarray, metric: str, k: int, x_sq_norms=None
 ) -> Tuple[np.ndarray, np.ndarray]:
     """numpy twin of knn_search for corpora below the device-dispatch
     threshold (cnf.TPU_KNN_ONDEVICE_THRESHOLD) — a tunnel round-trip costs
-    more than scanning a few thousand rows on host."""
+    more than scanning a few thousand rows on host. Pass cached
+    `x_sq_norms` (mirror host_search_view) to skip the per-call corpus
+    pass for euclidean."""
     # float32 BLAS: the strongest single-thread CPU formulation (an f64 cast
     # would copy the whole corpus per call and halve gemm throughput)
     q = np.asarray(q, dtype=np.float32)
     x = np.asarray(x, dtype=np.float32)
     if metric == "euclidean":
+        xx = x_sq_norms if x_sq_norms is not None else (x**2).sum(1)
         d = np.sqrt(
             np.maximum(
-                (q**2).sum(1)[:, None] + (x**2).sum(1)[None, :] - 2.0 * (q @ x.T),
+                (q**2).sum(1)[:, None] + xx[None, :] - 2.0 * (q @ x.T),
                 0.0,
             )
         )

@@ -1,0 +1,162 @@
+"""Async index building + background IVF lifecycle (VERDICT r2 item 6;
+reference: core/src/kvs/index.rs:28-41 building statuses)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from surrealdb_tpu import cnf
+
+
+def _wait(pred, timeout=30.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+def test_define_index_concurrently_builds_in_background(ds):
+    ds.execute(
+        "DEFINE TABLE t SCHEMALESS; INSERT INTO t $rows;",
+        vars={"rows": [{"id": i, "n": i % 10} for i in range(500)]},
+    )
+    out = ds.execute("DEFINE INDEX n_idx ON t FIELDS n CONCURRENTLY;")
+    assert out[-1]["status"] == "OK"
+
+    # while building, the planner must not serve reads from it
+    info = ds.execute("INFO FOR INDEX n_idx ON t;")[-1]["result"]
+    assert info["building"]["status"] in ("building", "started", "indexing", "ready")
+
+    assert _wait(
+        lambda: ds.execute("INFO FOR INDEX n_idx ON t;")[-1]["result"]["building"]["status"]
+        == "ready"
+    ), "background build never became ready"
+    info = ds.execute("INFO FOR INDEX n_idx ON t;")[-1]["result"]
+    assert info["building"]["count"] == 500
+
+    # once ready the planner uses it and results are complete
+    plan = ds.execute("SELECT * FROM t WHERE n = 3 EXPLAIN;")[-1]["result"]
+    assert plan[0]["operation"] == "Iterate Index"
+    rows = ds.execute("SELECT count() FROM t WHERE n = 3 GROUP ALL;")[-1]["result"]
+    assert rows[0]["count"] == 50
+
+
+def test_concurrent_build_sees_writes_landed_during_build(ds):
+    """Writes racing the chunked build index themselves; the final index
+    covers both populations."""
+    ds.execute(
+        "DEFINE TABLE t SCHEMALESS; INSERT INTO t $rows;",
+        vars={"rows": [{"id": i, "n": 1} for i in range(300)]},
+    )
+    ds.execute("DEFINE INDEX n_idx ON t FIELDS n CONCURRENTLY;")
+    # land writes immediately, racing the builder
+    ds.execute("INSERT INTO t $rows;", vars={"rows": [{"id": 1000 + i, "n": 1} for i in range(50)]})
+    assert _wait(
+        lambda: ds.execute("INFO FOR INDEX n_idx ON t;")[-1]["result"]["building"]["status"]
+        == "ready"
+    )
+    rows = ds.execute("SELECT count() FROM t WHERE n = 1 GROUP ALL;")[-1]["result"]
+    assert rows[0]["count"] == 350
+
+
+def test_ann_queries_never_block_on_training(ds, monkeypatch):
+    """First ANN query serves exact while training runs in the background;
+    growth past the retrain threshold keeps serving from the stale IVF
+    (VERDICT r2 weak item 3: no multi-second cliff on the query path)."""
+    monkeypatch.setattr(cnf, "TPU_ANN_MIN_ROWS", 64)
+    monkeypatch.setattr(cnf, "TPU_KNN_ONDEVICE_THRESHOLD", 1)
+    ds.execute("DEFINE INDEX v ON item FIELDS emb HNSW DIMENSION 8;")
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((256, 8)).astype(np.float32)
+    ds.execute(
+        "INSERT INTO item $rows;",
+        vars={"rows": [{"id": i, "emb": x[i].tolist()} for i in range(256)]},
+    )
+
+    out = ds.execute("SELECT VALUE id FROM item WHERE emb <|3|> $q;", vars={"q": x[5].tolist()})
+    assert out[-1]["result"][0].id == 5  # exact fallback is correct
+    mirror = ds.index_stores.get("test", "test", "item", "v")
+    assert mirror.wait_ivf(30)
+    assert mirror.ivf_status()["state"] == "ready"
+    trained0 = mirror.ivf.trained_n
+
+    # grow the corpus past the 1.5x retrain threshold: queries keep working
+    # (stale IVF) and a background retrain eventually swaps in
+    ds.execute(
+        "INSERT INTO item $rows;",
+        vars={
+            "rows": [
+                {"id": 1000 + i, "emb": rng.standard_normal(8).tolist()}
+                for i in range(200)
+            ]
+        },
+    )
+    out = ds.execute("SELECT VALUE id FROM item WHERE emb <|3|> $q;", vars={"q": x[5].tolist()})
+    assert out[-1]["result"][0].id == 5  # served from the stale quantizer
+    assert _wait(lambda: mirror.ivf is not None and mirror.ivf.trained_n > trained0, 30)
+
+
+def test_ivf_add_is_o1_and_size_consistent():
+    from surrealdb_tpu.idx.ivf import IvfState
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2000, 16)).astype(np.float32)
+    ivf = IvfState.train(x, np.ones(2000, dtype=bool))
+    assert ivf.size() == 2000
+    ivf.add(5000, x[0])
+    assert ivf.size() == 2001
+    ivf.add(5000, x[0])  # idempotent
+    assert ivf.size() == 2001
+    ivf.remove(5000)
+    assert ivf.size() == 2000
+    assert ivf.size() == sum(len(l) for l in ivf.lists)
+
+
+@pytest.mark.slow
+def test_ivf_recall_at_scale():
+    """Recall floor at 200k x 256 (VERDICT r2 item 6 'done' condition)."""
+    from surrealdb_tpu.idx.ivf import IvfState, default_nprobe
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    n, d, clusters = 200_000, 256, 1000
+    centers = rng.standard_normal((clusters, d)).astype(np.float32)
+    cid = rng.integers(0, clusters, size=n)
+    x = centers[cid] + 0.3 * rng.standard_normal((n, d)).astype(np.float32)
+    ivf = IvfState.train(x, np.ones(n, dtype=bool))
+    mat = jnp.asarray(x)
+    k = 10
+    nprobe = default_nprobe(ivf.nlists, 150)
+    qi = rng.integers(0, n, size=8)
+    qs = x[qi] + 0.05 * rng.standard_normal((8, d)).astype(np.float32)
+    dd, ss = ivf.search_batch(qs, mat, "euclidean", k, nprobe)
+    # brute-force ground truth
+    hits = 0
+    for j in range(8):
+        d2 = ((x - qs[j]) ** 2).sum(1)
+        gt = set(np.argpartition(d2, k)[:k].tolist())
+        hits += len(gt & set(int(v) for v in ss[j]))
+    assert hits / (8 * k) >= 0.9
+
+
+def test_overwrite_concurrently_wipes_old_entries(ds):
+    """DEFINE INDEX OVERWRITE ... CONCURRENTLY must not leave entries keyed
+    on the previous definition's field (review r3 regression)."""
+    ds.execute(
+        "DEFINE TABLE t SCHEMALESS; INSERT INTO t $rows;",
+        vars={"rows": [{"id": i, "a": 7, "b": i} for i in range(50)]},
+    )
+    ds.execute("DEFINE INDEX i ON t FIELDS a;")
+    ds.execute("DEFINE INDEX OVERWRITE i ON t FIELDS b CONCURRENTLY;")
+    assert _wait(
+        lambda: ds.execute("INFO FOR INDEX i ON t;")[-1]["result"]["building"]["status"]
+        == "ready"
+    )
+    # old a=7 entries are gone: an indexed lookup on b=7 returns exactly one
+    plan = ds.execute("SELECT * FROM t WHERE b = 7 EXPLAIN;")[-1]["result"]
+    assert plan[0]["operation"] == "Iterate Index"
+    rows = ds.execute("SELECT VALUE id FROM t WHERE b = 7;")[-1]["result"]
+    assert [t.id for t in rows] == [7]

@@ -100,7 +100,14 @@ def test_sql_knn_ivf_path(vec_ds):
     cnf.TPU_ANN_MIN_ROWS = 10
     try:
         ds.index_stores.clear()
+        # first ANN query serves exact and kicks background training —
+        # correct results, no latency cliff
         got = _knn_ids(ds, x[7], k=5, ef=400)
+        assert 7 in got and len(set(got) & _brute(x[7], x, 5)) >= 4
+        mirror = ds.index_stores.get("test", "test", "item", "v")
+        assert mirror.wait_ivf(30), "background IVF training did not finish"
+        assert mirror.ivf_status()["state"] == "ready"
+        got = _knn_ids(ds, x[7], k=5, ef=400)  # now through IVF
         assert 7 in got, "self-hit missed"
         assert len(set(got) & _brute(x[7], x, 5)) >= 4
     finally:
