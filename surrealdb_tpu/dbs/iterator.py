@@ -234,6 +234,10 @@ class Iterator:
             if not perms_apply(ctx):
                 self.ml_calls = find_model_calls(getattr(stm, "fields", None))
         self.defer_projection = bool(self.ml_calls)
+        # set when the (single) planned source already yields rows in the
+        # statement's ORDER BY order (IndexOrderPlan) — skips the post-sort
+        # and re-enables the LIMIT fast path
+        self.order_pushed = False
 
     def ingest(self, it) -> None:
         self.entries.append(it)
@@ -247,7 +251,7 @@ class Iterator:
         if (
             verb == "select"
             and stm.limit is not None
-            and not stm.order
+            and (not stm.order or self.order_pushed)
             and not stm.group
             and not getattr(stm, "group_all", False)
             and not stm.split
@@ -514,7 +518,7 @@ class Iterator:
             rows = aggregate_groups(ctx, stm, rows)
         if stm.split:
             rows = apply_split(ctx, rows, stm.split)
-        if stm.order:
+        if stm.order and not self.order_pushed:
             rows = apply_order(ctx, rows, stm.order)
         rows = apply_start_limit(ctx, rows, stm.start, stm.limit)
         if stm.omit:

@@ -72,6 +72,14 @@ def select_compute(ctx, stm) -> Any:
         it = Iterator(c, stm, "select")
         for s in sources:
             it.ingest(s)
+        from surrealdb_tpu.dbs.iterator import IIndex
+
+        if (
+            len(sources) == 1
+            and isinstance(sources[0], IIndex)
+            and getattr(sources[0].plan, "provides_order", False)
+        ):
+            it.order_pushed = True
         rows = it.output()
     return _only(stm, rows)
 

@@ -27,13 +27,16 @@ from .ft_search import MatchesPlan
 
 # ------------------------------------------------------------------ plans
 class IndexEqualPlan:
-    """WHERE field = value over an 'idx'/'uniq' index
-    (reference ThingIterator::IndexEqual/UniqueEqual)."""
+    """WHERE field = value (or a compound-prefix of equalities) over an
+    'idx'/'uniq' index (reference ThingIterator::IndexEqual/UniqueEqual).
+    `values` may cover only a PREFIX of a compound index's fields — the
+    lookup becomes a prefix scan."""
 
     def __init__(self, tb: str, ix: dict, values: List[Any]):
         self.tb = tb
         self.ix = ix
         self.values = values
+        self.partial = len(values) < len(ix["fields"])
 
     def explain(self) -> dict:
         return {
@@ -46,18 +49,35 @@ class IndexEqualPlan:
         ns, db = ctx.ns_db()
         txn = ctx.txn()
         name = self.ix["name"]
-        if self.ix["index"]["type"] == "uniq":
+        if self.ix["index"]["type"] == "uniq" and not self.partial:
             raw = txn.get(keys.unique_entry(ns, db, self.tb, name, self.values))
             if raw is not None:
                 rid = unpack(raw)
                 yield rid, None, None
             return
+        # array-valued fields write one entry per element (_combinations),
+        # so scans must dedup record ids or a row repeats in the output
+        seen = set()
+        if self.ix["index"]["type"] == "uniq":
+            pre = keys.unique_entry_prefix(ns, db, self.tb, name, self.values)
+            for chunk in txn.batch(pre, prefix_end(pre), 1000):
+                for _, v in chunk:
+                    rid = unpack(v)
+                    k2 = (rid.tb, repr(rid.id)) if isinstance(rid, Thing) else rid
+                    if k2 in seen:
+                        continue
+                    seen.add(k2)
+                    yield rid, None, None
+            return
         pre = keys.index_entry_prefix(ns, db, self.tb, name, self.values)
+        nvals = len(self.ix["fields"])  # keys hold ALL fields' values
         for chunk in txn.batch(pre, prefix_end(pre), 1000):
             for k, _ in chunk:
-                _, rid = keys.decode_index_entry_id(
-                    k, ns, db, self.tb, name, len(self.values)
-                )
+                _, rid = keys.decode_index_entry_id(k, ns, db, self.tb, name, nvals)
+                k2 = (rid.tb, repr(rid.id)) if isinstance(rid, Thing) else rid
+                if k2 in seen:
+                    continue
+                seen.add(k2)
                 yield rid, None, None
 
 
@@ -105,6 +125,101 @@ class IndexRangePlan:
                 else:
                     _, rid = keys.decode_index_entry_id(k, ns, db, self.tb, name, 1)
                 yield rid, None, None
+
+
+class MultiIndexPlan:
+    """AND/OR condition trees over several index plans (reference
+    Plan::MultiIndex + IndexUnion/IndexJoin thing iterators,
+    plan.rs:27-93, iterators.rs:107-120).
+
+    union:     every branch of an OR is indexable; stream each branch,
+               dedup record ids (the reference's SyncDistinct role).
+    intersect: several AND conjuncts hit different indexes; intersect the
+               candidate id sets, smallest first. Residual conjuncts stay
+               in the statement's WHERE, evaluated per record — plans only
+               ever narrow the candidate set.
+    """
+
+    def __init__(self, tb: str, plans: List[Any], mode: str):
+        self.tb = tb
+        self.plans = plans
+        self.mode = mode  # "union" | "intersect"
+
+    def explain(self) -> dict:
+        return {
+            "type": "MultiIndex",
+            "mode": self.mode,
+            "parts": [p.explain() for p in self.plans],
+        }
+
+    @staticmethod
+    def _key(rid):
+        return (rid.tb, repr(rid.id)) if isinstance(rid, Thing) else rid
+
+    def iterate(self, ctx):
+        if self.mode == "union":
+            seen = set()
+            for p in self.plans:
+                for rid, doc, ir in p.iterate(ctx):
+                    k = self._key(rid)
+                    if k in seen:
+                        continue
+                    seen.add(k)
+                    yield rid, doc, ir
+            return
+        # intersect: materialize candidate id maps, smallest set drives
+        maps = []
+        for p in self.plans:
+            m = {}
+            for rid, _, _ in p.iterate(ctx):
+                m[self._key(rid)] = rid
+            maps.append(m)
+        maps.sort(key=len)
+        inter = set(maps[0])
+        for m in maps[1:]:
+            inter &= set(m)
+        for k in inter:
+            yield maps[0][k], None, None
+
+
+class IndexOrderPlan:
+    """ORDER BY field [ASC] served straight from an ordered index scan with
+    the LIMIT pushed into the scan (reference: order/limit pushdown,
+    planner/mod.rs + iterators.rs IndexRange). Only forward (ASC) order —
+    the KV scans forward."""
+
+    def __init__(self, tb: str, ix: dict, limit: Optional[int]):
+        self.tb = tb
+        self.ix = ix
+        self.limit = limit
+        self.provides_order = True
+
+    def explain(self) -> dict:
+        out = {"index": self.ix["name"], "operator": "order", "direction": "ASC"}
+        if self.limit is not None:
+            out["limit_pushdown"] = self.limit
+        return out
+
+    def iterate(self, ctx):
+        ns, db = ctx.ns_db()
+        txn = ctx.txn()
+        name = self.ix["name"]
+        pre = keys.index_entry_prefix(ns, db, self.tb, name)
+        n = 0
+        seen = set()  # array-valued fields write one entry per element
+        for chunk in txn.batch(pre, prefix_end(pre), 1000):
+            for k, v in chunk:
+                _, rid = keys.decode_index_entry_id(
+                    k, ns, db, self.tb, name, len(self.ix["fields"])
+                )
+                k2 = (rid.tb, repr(rid.id)) if isinstance(rid, Thing) else rid
+                if k2 in seen:
+                    continue
+                seen.add(k2)
+                yield rid, None, None
+                n += 1
+                if self.limit is not None and n >= self.limit:
+                    return
 
 
 class TableScanPlan:
@@ -163,9 +278,46 @@ def build_plan(ctx, stm, tb: str, with_) -> Optional[Any]:
         if plan is not None:
             return plan
 
-    if cond is None:
+    if cond is not None:
+        return _plan_condition(ctx, tb, indexes, cond)
+
+    # no WHERE: ORDER BY field ASC [LIMIT n] can ride an ordered index scan.
+    # Not under GROUP/SPLIT (rows feed an aggregator, truncation would be
+    # wrong), and only over plain 'idx' (uniq indexes are sparse: records
+    # with a NONE field have no entry and would vanish from the result).
+    order = getattr(stm, "order", None)
+    if (
+        order
+        and len(order) == 1
+        and order[0].asc
+        and not getattr(order[0], "rand", False)
+        and not getattr(stm, "group", None)
+        and not getattr(stm, "group_all", False)
+        and not getattr(stm, "split", None)
+    ):
+        field_txt = repr(order[0].idiom)
+        for ix in indexes:
+            if ix["index"]["type"] != "idx":
+                continue
+            if repr(ix["fields"][0]) != field_txt:
+                continue
+            from surrealdb_tpu.iam.check import perms_apply
+
+            # per-record permission filtering drops rows AFTER the plan, so
+            # a plan-level limit would under-fill the result for guests /
+            # record-access sessions — they keep the full ordered scan
+            limit = None if perms_apply(ctx) else _static_limit(ctx, stm)
+            return IndexOrderPlan(tb, ix, limit)
+    return None
+
+
+def _static_limit(ctx, stm) -> Optional[int]:
+    try:
+        limit = int(stm.limit.compute(ctx)) if stm.limit is not None else None
+        start = int(stm.start.compute(ctx)) if stm.start is not None else 0
+    except (TypeError, ValueError):
         return None
-    return _plan_condition(ctx, tb, indexes, cond)
+    return (limit + start) if limit is not None else None
 
 
 def _find_operator(expr, klass):
@@ -210,32 +362,132 @@ def _plan_matches(ctx, tb: str, indexes: List[dict], m: MatchesOp, stm):
 
 
 def _plan_condition(ctx, tb: str, indexes: List[dict], cond):
-    """Match simple `field op literal` shapes against single-column indexes."""
-    shape = _extract_shape(ctx, cond)
-    if shape is None:
+    """Decompose the WHERE condition tree into per-index candidate plans
+    (reference planner/tree.rs analysis + plan.rs PlanBuilder). Residual
+    conjuncts are fine: the iterator re-evaluates the full WHERE per
+    record, so a plan only has to produce a candidate SUPERSET of one
+    AND-branch… (for OR, every branch must be indexable)."""
+    usable = [ix for ix in indexes if ix["index"]["type"] in ("idx", "uniq")]
+    if not usable:
         return None
-    field_txt, op, value = shape
-    for ix in indexes:
-        if ix["index"]["type"] not in ("idx", "uniq"):
+
+    if isinstance(cond, BinaryOp) and cond.op in ("||", "OR"):
+        branches = _or_branches(ctx, cond)
+        if branches is None:
+            return None
+        plans = []
+        for leaves in branches:
+            p = _plan_and(ctx, tb, usable, leaves)
+            if p is None:
+                return None  # one unindexable OR-branch forces a scan
+            plans.append(p)
+        if len(plans) == 1:
+            return plans[0]
+        return MultiIndexPlan(tb, plans, "union")
+
+    leaves, _residual = _and_leaves(ctx, cond)
+    return _plan_and(ctx, tb, usable, leaves)
+
+
+def _plan_and(ctx, tb: str, usable: List[dict], leaves):
+    """Best plan for one AND-branch's leaves: compound-prefix equality
+    first, then single-field plans; ≥2 distinct index hits → intersect."""
+    if not leaves:
+        return None
+    eq_by_field = {f: v for f, op, v in leaves if op == "="}
+    plans: List[Any] = []
+    covered: set = set()
+
+    # compound indexes: longest equality prefix wins
+    best = None
+    for ix in usable:
+        fields = [repr(f) for f in ix["fields"]]
+        if len(fields) < 2:
             continue
-        if len(ix["fields"]) != 1 or repr(ix["fields"][0]) != field_txt:
+        n = 0
+        for f in fields:
+            if f in eq_by_field:
+                n += 1
+            else:
+                break
+        if n >= 2 and (best is None or n > best[1]):
+            best = (ix, n)
+    if best is not None:
+        ix, n = best
+        fields = [repr(f) for f in ix["fields"]][:n]
+        plans.append(IndexEqualPlan(tb, ix, [eq_by_field[f] for f in fields]))
+        covered.update(fields)
+
+    single = {
+        repr(ix["fields"][0]): ix for ix in usable if len(ix["fields"]) == 1
+    }
+    for f, op, v in leaves:
+        if f in covered:
             continue
-        if op == "=":
-            return IndexEqualPlan(tb, ix, [value])
-        if op == "<":
-            return IndexRangePlan(tb, ix, None, value, True, False)
-        if op == "<=":
-            return IndexRangePlan(tb, ix, None, value, True, True)
-        if op == ">":
-            return IndexRangePlan(tb, ix, value, None, False, False)
-        if op == ">=":
-            return IndexRangePlan(tb, ix, value, None, True, False)
+        ix = single.get(f)
+        if ix is None:
+            continue
+        p = _leaf_plan(tb, ix, op, v)
+        if p is not None:
+            plans.append(p)
+            covered.add(f)
+
+    if not plans:
+        # last resort: a compound index whose FIRST field has an equality
+        # serves as a 1-value prefix scan
+        for ix in usable:
+            if len(ix["fields"]) >= 2 and repr(ix["fields"][0]) in eq_by_field:
+                return IndexEqualPlan(tb, ix, [eq_by_field[repr(ix["fields"][0])]])
+        return None
+    if len(plans) == 1:
+        return plans[0]
+    return MultiIndexPlan(tb, plans, "intersect")
+
+
+def _leaf_plan(tb: str, ix: dict, op: str, value):
+    if op == "=":
+        return IndexEqualPlan(tb, ix, [value])
+    if op == "<":
+        return IndexRangePlan(tb, ix, None, value, True, False)
+    if op == "<=":
+        return IndexRangePlan(tb, ix, None, value, True, True)
+    if op == ">":
+        return IndexRangePlan(tb, ix, value, None, False, False)
+    if op == ">=":
+        return IndexRangePlan(tb, ix, value, None, True, False)
     return None
 
 
-def _extract_shape(ctx, cond) -> Optional[Tuple[str, str, Any]]:
-    """`field op constant` (either side) where the WHERE clause is exactly
-    one comparison. Broader trees fall back to scans in v1."""
+def _and_leaves(ctx, cond) -> Tuple[List[Tuple[str, str, Any]], bool]:
+    """Flatten an AND chain into (leaves, residual?) — residual marks
+    subtrees that couldn't be expressed as `field op constant`."""
+    if isinstance(cond, BinaryOp) and cond.op in ("&&", "AND"):
+        l, lr = _and_leaves(ctx, cond.l)
+        r, rr = _and_leaves(ctx, cond.r)
+        return l + r, lr or rr
+    leaf = _extract_leaf(ctx, cond)
+    return ([leaf], False) if leaf is not None else ([], True)
+
+
+def _or_branches(ctx, cond) -> Optional[List[List[Tuple[str, str, Any]]]]:
+    """Flatten an OR chain into per-branch AND-leaf lists; None when any
+    branch contains a residual (the whole OR then needs a scan)."""
+    if isinstance(cond, BinaryOp) and cond.op in ("||", "OR"):
+        l = _or_branches(ctx, cond.l)
+        r = _or_branches(ctx, cond.r)
+        if l is None or r is None:
+            return None
+        return l + r
+    leaves, _residual = _and_leaves(ctx, cond)
+    # a residual conjunct inside a branch is fine (the iterator re-checks
+    # the full WHERE); only a branch with NO indexable leaf forces a scan
+    if not leaves:
+        return None
+    return [leaves]
+
+
+def _extract_leaf(ctx, cond) -> Optional[Tuple[str, str, Any]]:
+    """One `field op constant` comparison (either side)."""
     if not isinstance(cond, BinaryOp):
         return None
     op = cond.op

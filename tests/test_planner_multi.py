@@ -1,0 +1,181 @@
+"""Condition-tree planning: multi-index AND/OR, compound keys, ORDER/LIMIT
+pushdown (VERDICT r2 item 4; reference: core/src/idx/planner/plan.rs:27-93,
+iterators.rs:107-120)."""
+
+import pytest
+
+
+@pytest.fixture
+def t(ds):
+    ds.execute(
+        "DEFINE TABLE t SCHEMALESS; "
+        "DEFINE INDEX ia ON t FIELDS a; "
+        "DEFINE INDEX ib ON t FIELDS b; "
+        "INSERT INTO t $rows;",
+        vars={
+            "rows": [
+                {"id": i, "a": i % 5, "b": i % 7, "name": f"row{i}"}
+                for i in range(70)
+            ]
+        },
+    )
+    return ds
+
+
+def _explain(ds, sql):
+    return ds.execute(sql + " EXPLAIN;")[-1]["result"]
+
+
+def _ids(ds, sql):
+    out = ds.execute(sql + ";")[-1]["result"]
+    return sorted(t.id for t in out)
+
+
+def test_and_two_indexes_is_multiindex_intersect(t):
+    plan = _explain(t, "SELECT * FROM t WHERE a = 1 AND b = 2")
+    assert plan[0]["operation"] == "Iterate Index"
+    detail = plan[0]["detail"]["plan"]
+    assert detail["type"] == "MultiIndex" and detail["mode"] == "intersect"
+    assert {p["index"] for p in detail["parts"]} == {"ia", "ib"}
+    got = _ids(t, "SELECT VALUE id FROM t WHERE a = 1 AND b = 2")
+    want = sorted(i for i in range(70) if i % 5 == 1 and i % 7 == 2)
+    assert got == want
+
+
+def test_or_two_indexes_is_multiindex_union(t):
+    plan = _explain(t, "SELECT * FROM t WHERE a = 1 OR b = 2")
+    detail = plan[0]["detail"]["plan"]
+    assert detail["type"] == "MultiIndex" and detail["mode"] == "union"
+    got = _ids(t, "SELECT VALUE id FROM t WHERE a = 1 OR b = 2")
+    want = sorted(i for i in range(70) if i % 5 == 1 or i % 7 == 2)
+    assert got == want  # sorted-set compare also proves union dedup
+
+
+def test_or_with_unindexable_branch_scans(t):
+    plan = _explain(t, "SELECT * FROM t WHERE a = 1 OR string::len(name) = 4")
+    assert plan[0]["operation"] == "Iterate Table"
+
+
+def test_residual_conjunct_keeps_index(t):
+    plan = _explain(t, "SELECT * FROM t WHERE a = 1 AND string::len(name) >= 5")
+    assert plan[0]["operation"] == "Iterate Index"
+    got = _ids(t, "SELECT VALUE id FROM t WHERE a = 1 AND string::len(name) >= 5")
+    want = sorted(i for i in range(70) if i % 5 == 1 and len(f"row{i}") >= 5)
+    assert got == want
+
+
+def test_range_and_equality_intersect(t):
+    got = _ids(t, "SELECT VALUE id FROM t WHERE a = 1 AND b > 3")
+    want = sorted(i for i in range(70) if i % 5 == 1 and i % 7 > 3)
+    assert got == want
+    detail = _explain(t, "SELECT * FROM t WHERE a = 1 AND b > 3")[0]["detail"]["plan"]
+    assert detail["type"] == "MultiIndex"
+
+
+# ------------------------------------------------------------------ compound
+@pytest.fixture
+def c(ds):
+    ds.execute(
+        "DEFINE TABLE c SCHEMALESS; "
+        "DEFINE INDEX iab ON c FIELDS a, b; "
+        "INSERT INTO c $rows;",
+        vars={"rows": [{"id": i, "a": i % 3, "b": i % 4} for i in range(60)]},
+    )
+    return ds
+
+
+def test_compound_full_equality(c):
+    plan = _explain(c, "SELECT * FROM c WHERE a = 1 AND b = 2")
+    assert plan[0]["operation"] == "Iterate Index"
+    d = plan[0]["detail"]["plan"]
+    assert d["index"] == "iab" and d["value"] == [1, 2]
+    got = _ids(c, "SELECT VALUE id FROM c WHERE a = 1 AND b = 2")
+    assert got == sorted(i for i in range(60) if i % 3 == 1 and i % 4 == 2)
+
+
+def test_compound_prefix_equality(c):
+    plan = _explain(c, "SELECT * FROM c WHERE a = 2")
+    assert plan[0]["operation"] == "Iterate Index"
+    assert plan[0]["detail"]["plan"]["index"] == "iab"
+    got = _ids(c, "SELECT VALUE id FROM c WHERE a = 2")
+    assert got == sorted(i for i in range(60) if i % 3 == 2)
+
+
+def test_compound_unique_roundtrip(ds):
+    ds.execute(
+        "DEFINE TABLE u SCHEMALESS; "
+        "DEFINE INDEX uab ON u FIELDS a, b UNIQUE; "
+        "INSERT INTO u [{id: 1, a: 1, b: 1}, {id: 2, a: 1, b: 2}];"
+    )
+    got = _ids(ds, "SELECT VALUE id FROM u WHERE a = 1 AND b = 2")
+    assert got == [2]
+    got = _ids(ds, "SELECT VALUE id FROM u WHERE a = 1")  # prefix over uniq
+    assert got == [1, 2]
+
+
+# ------------------------------------------------------------------ order pushdown
+def test_order_by_index_with_limit_pushdown(t):
+    plan = _explain(t, "SELECT * FROM t ORDER BY a LIMIT 10")
+    assert plan[0]["operation"] == "Iterate Index"
+    d = plan[0]["detail"]["plan"]
+    assert d["operator"] == "order" and d["limit_pushdown"] == 10
+    rows = t.execute("SELECT a FROM t ORDER BY a LIMIT 10;")[-1]["result"]
+    assert [r["a"] for r in rows] == sorted(i % 5 for i in range(70))[:10]
+
+
+def test_order_desc_not_pushed(t):
+    plan = _explain(t, "SELECT * FROM t ORDER BY a DESC LIMIT 10")
+    assert plan[0]["operation"] == "Iterate Table"
+    rows = t.execute("SELECT a FROM t ORDER BY a DESC LIMIT 3;")[-1]["result"]
+    assert [r["a"] for r in rows] == [4, 4, 4]
+
+
+def test_order_pushdown_respects_start(t):
+    rows = t.execute("SELECT a FROM t ORDER BY a LIMIT 3 START 14;")[-1]["result"]
+    assert [r["a"] for r in rows] == sorted(i % 5 for i in range(70))[14:17]
+
+
+# ------------------------------------------------------------------ review regressions
+def test_order_pushdown_not_under_group(t):
+    plan = _explain(t, "SELECT a, count() FROM t GROUP BY a ORDER BY a LIMIT 2")
+    assert plan[0]["operation"] == "Iterate Table"
+    rows = t.execute("SELECT a, count() FROM t GROUP BY a ORDER BY a LIMIT 2;")[-1]["result"]
+    assert rows[0] == {"a": 0, "count": 14} and rows[1] == {"a": 1, "count": 14}
+
+
+def test_order_pushdown_not_over_sparse_unique(ds):
+    ds.execute(
+        "DEFINE TABLE s SCHEMALESS; DEFINE INDEX se ON s FIELDS email UNIQUE; "
+        "INSERT INTO s [{id: 1, email: 'a@x'}, {id: 2}];"
+    )
+    plan = _explain(ds, "SELECT * FROM s ORDER BY email")
+    assert plan[0]["operation"] == "Iterate Table"
+    rows = ds.execute("SELECT VALUE id FROM s ORDER BY email;")[-1]["result"]
+    assert len(rows) == 2  # the email-less record is not dropped
+
+
+def test_array_field_prefix_scan_dedups(ds):
+    ds.execute(
+        "DEFINE TABLE arr SCHEMALESS; DEFINE INDEX iat ON arr FIELDS a, tags; "
+        "INSERT INTO arr [{id: 1, a: 1, tags: ['x', 'y', 'z']}, {id: 2, a: 1, tags: ['x']}];"
+    )
+    rows = _ids(ds, "SELECT VALUE id FROM arr WHERE a = 1")
+    assert rows == [1, 2]  # each record once despite 3 entries for id 1
+
+
+def test_order_pushdown_suppressed_for_record_access(ds):
+    from surrealdb_tpu.dbs.session import Session
+
+    ds.execute(
+        "DEFINE TABLE post SCHEMALESS PERMISSIONS FOR select WHERE published = true; "
+        "DEFINE INDEX pd ON post FIELDS d; "
+        "INSERT INTO post $rows;",
+        vars={
+            "rows": [
+                {"id": i, "d": i, "published": i >= 5} for i in range(10)
+            ]
+        },
+    )
+    sess = Session.anonymous("test", "test")
+    out = ds.execute("SELECT VALUE id FROM post ORDER BY d LIMIT 3;", sess)
+    assert [t.id for t in out[-1]["result"]] == [5, 6, 7]
