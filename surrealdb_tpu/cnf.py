@@ -49,7 +49,12 @@ REGEX_CACHE_SIZE = _env_int("SURREAL_REGEX_CACHE_SIZE", 1_000)
 TPU_BATCH_MIN_TILE = _env_int("SURREAL_TPU_BATCH_MIN_TILE", 128)
 TPU_VECTOR_DTYPE = os.environ.get("SURREAL_TPU_VECTOR_DTYPE", "bfloat16")
 TPU_KNN_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_KNN_ONDEVICE_THRESHOLD", 4096)
-TPU_FT_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_FT_ONDEVICE_THRESHOLD", 4096)
+# BM25 scoring is memory-light (candidates x terms); host numpy scores a
+# 100k-candidate set in ~2ms, so a device dispatch only pays off when the
+# candidate set is huge or the device is locally attached (measured: ~110ms
+# per dispatch round-trip on a tunneled chip). Operators with on-board TPUs
+# should lower this.
+TPU_FT_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_FT_ONDEVICE_THRESHOLD", 262_144)
 TPU_GRAPH_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_GRAPH_ONDEVICE_THRESHOLD", 2048)
 # corpus size at which `<|k|>` switches from exact search to the IVF ANN
 TPU_ANN_MIN_ROWS = _env_int("SURREAL_TPU_ANN_MIN_ROWS", 8192)

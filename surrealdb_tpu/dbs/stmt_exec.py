@@ -69,18 +69,27 @@ def select_compute(ctx, stm) -> Any:
 
         sources = plan_sources(c, stm, sources)
 
+        from surrealdb_tpu.dbs.iterator import IIndex, ITable
+        from surrealdb_tpu.idx.planner import OrderPushdownBailout
+
         it = Iterator(c, stm, "select")
         for s in sources:
             it.ingest(s)
-        from surrealdb_tpu.dbs.iterator import IIndex
-
         if (
             len(sources) == 1
             and isinstance(sources[0], IIndex)
             and getattr(sources[0].plan, "provides_order", False)
         ):
             it.order_pushed = True
-        rows = it.output()
+        try:
+            rows = it.output()
+        except OrderPushdownBailout:
+            # the ordered scan met an array-valued row: key order would be
+            # wrong, so re-run on the plain scan + post-sort path
+            it = Iterator(c, stm, "select")
+            for s in sources:
+                it.ingest(ITable(s.tb) if isinstance(s, IIndex) else s)
+            rows = it.output()
     return _only(stm, rows)
 
 

@@ -380,25 +380,9 @@ class GraphMirrors:
         keep = c > 0
         return u[keep].astype(np.int32), c[keep].astype(np.int32)
 
-    def chain(
-        self,
-        ctx,
-        start: List[Thing],
-        parts: List,  # List[PGraph]
-    ) -> List[Thing]:
-        """Run a maximal chain of cond-free graph parts `->a->b->c` as
-        batched frontier hops: host adjacency while the frontier is small,
-        then the rest of the chain on device once it crosses
-        TPU_GRAPH_ONDEVICE_THRESHOLD.
-
-        Multiplicity matches the reference's flatten-without-dedup semantics
-        (sql/value/get.rs:404-446): the frontier is deduplicated between hops
-        but each node carries its path count, and the final result expands
-        each node count times. Result order is deterministic (ascending
-        intern order ≈ build-scan key order, with delta-added nodes after)
-        but not identical to the KV walk's key order; graph hop ordering is
-        unspecified upstream.
-        """
+    def _chain_frontier(self, ctx, start: List[Thing], parts: List):
+        """Shared frontier machinery for chain()/chain_count(): returns
+        (frontier int32[], counts int32[], interner)."""
         from surrealdb_tpu import cnf
 
         ns, db = ctx.ns_db()
@@ -430,7 +414,37 @@ class GraphMirrors:
                 break
             frontier, counts = self._host_hop(ns, db, frontier, counts, specs[i])
             i += 1
+        return frontier, counts, it
+
+    def chain(
+        self,
+        ctx,
+        start: List[Thing],
+        parts: List,  # List[PGraph]
+    ) -> List[Thing]:
+        """Run a maximal chain of cond-free graph parts `->a->b->c` as
+        batched frontier hops: host adjacency while the frontier is small,
+        then the rest of the chain on device once it crosses
+        TPU_GRAPH_ONDEVICE_THRESHOLD.
+
+        Multiplicity matches the reference's flatten-without-dedup semantics
+        (sql/value/get.rs:404-446): the frontier is deduplicated between hops
+        but each node carries its path count, and the final result expands
+        each node count times. Result order is deterministic (ascending
+        intern order ≈ build-scan key order, with delta-added nodes after)
+        but not identical to the KV walk's key order; graph hop ordering is
+        unspecified upstream.
+        """
+        frontier, counts, it = self._chain_frontier(ctx, start, parts)
         out: List[Thing] = []
         for j, c in zip(frontier, counts):
             out.extend([it.node_of[int(j)]] * int(c))
         return out
+
+    def chain_count(self, ctx, start: List[Thing], parts: List) -> int:
+        """Path count of a chain WITHOUT materializing the expanded result —
+        `count(->a->b->c)` sums the frontier's path counts directly (on a
+        3-hop over 1M edges the Python expansion would dominate the whole
+        query; the device already holds the counts)."""
+        _, counts, _ = self._chain_frontier(ctx, start, parts)
+        return int(counts.sum())

@@ -25,7 +25,19 @@ from .knn import KnnPlan
 from .ft_search import MatchesPlan
 
 
+def _rid_key(rid):
+    """Dedup identity for record ids yielded by index scans."""
+    return (rid.tb, repr(rid.id)) if isinstance(rid, Thing) else rid
+
+
 # ------------------------------------------------------------------ plans
+class OrderPushdownBailout(Exception):
+    """Raised by IndexOrderPlan when it meets an array-valued entry: key
+    order sorts a record at its smallest element while value_cmp sorts
+    arrays after scalars, so the pushdown is unsound — the statement
+    re-runs on the plain scan + post-sort path."""
+
+
 class IndexEqualPlan:
     """WHERE field = value (or a compound-prefix of equalities) over an
     'idx'/'uniq' index (reference ThingIterator::IndexEqual/UniqueEqual).
@@ -63,7 +75,7 @@ class IndexEqualPlan:
             for chunk in txn.batch(pre, prefix_end(pre), 1000):
                 for _, v in chunk:
                     rid = unpack(v)
-                    k2 = (rid.tb, repr(rid.id)) if isinstance(rid, Thing) else rid
+                    k2 = _rid_key(rid)
                     if k2 in seen:
                         continue
                     seen.add(k2)
@@ -74,7 +86,7 @@ class IndexEqualPlan:
         for chunk in txn.batch(pre, prefix_end(pre), 1000):
             for k, _ in chunk:
                 _, rid = keys.decode_index_entry_id(k, ns, db, self.tb, name, nvals)
-                k2 = (rid.tb, repr(rid.id)) if isinstance(rid, Thing) else rid
+                k2 = _rid_key(rid)
                 if k2 in seen:
                     continue
                 seen.add(k2)
@@ -118,12 +130,17 @@ class IndexRangePlan:
         else:
             ek = base + enc_value_key(self.end)
             end = prefix_end(ek) if self.end_incl else ek
+        seen = set()  # array-valued fields write one entry per element
         for chunk in txn.batch(beg, end, 1000):
             for k, v in chunk:
                 if uniq:
                     rid = unpack(v)
                 else:
                     _, rid = keys.decode_index_entry_id(k, ns, db, self.tb, name, 1)
+                k2 = _rid_key(rid)
+                if k2 in seen:
+                    continue
+                seen.add(k2)
                 yield rid, None, None
 
 
@@ -152,16 +169,12 @@ class MultiIndexPlan:
             "parts": [p.explain() for p in self.plans],
         }
 
-    @staticmethod
-    def _key(rid):
-        return (rid.tb, repr(rid.id)) if isinstance(rid, Thing) else rid
-
     def iterate(self, ctx):
         if self.mode == "union":
             seen = set()
             for p in self.plans:
                 for rid, doc, ir in p.iterate(ctx):
-                    k = self._key(rid)
+                    k = _rid_key(rid)
                     if k in seen:
                         continue
                     seen.add(k)
@@ -172,7 +185,7 @@ class MultiIndexPlan:
         for p in self.plans:
             m = {}
             for rid, _, _ in p.iterate(ctx):
-                m[self._key(rid)] = rid
+                m[_rid_key(rid)] = rid
             maps.append(m)
         maps.sort(key=len)
         inter = set(maps[0])
@@ -201,9 +214,12 @@ class IndexOrderPlan:
         return out
 
     def iterate(self, ctx):
+        from surrealdb_tpu.sql.path import get_path
+
         ns, db = ctx.ns_db()
         txn = ctx.txn()
         name = self.ix["name"]
+        field_parts = self.ix["fields"][0].parts
         pre = keys.index_entry_prefix(ns, db, self.tb, name)
         n = 0
         seen = set()  # array-valued fields write one entry per element
@@ -212,11 +228,20 @@ class IndexOrderPlan:
                 _, rid = keys.decode_index_entry_id(
                     k, ns, db, self.tb, name, len(self.ix["fields"])
                 )
-                k2 = (rid.tb, repr(rid.id)) if isinstance(rid, Thing) else rid
+                k2 = _rid_key(rid)
                 if k2 in seen:
                     continue
+                # fetch the doc here (the SELECT needs it anyway) and check
+                # the order field: an array value writes one entry per
+                # element and key order would place the row at its smallest
+                # element — unsound vs value_cmp, so abandon the pushdown
+                doc = txn.get_record(ns, db, rid.tb, rid.id) if isinstance(rid, Thing) else None
+                if doc is not None:
+                    with ctx.with_doc_value(doc, rid=rid) as c:
+                        if isinstance(get_path(c, doc, field_parts), list):
+                            raise OrderPushdownBailout()
                 seen.add(k2)
-                yield rid, None, None
+                yield rid, doc, None
                 n += 1
                 if self.limit is not None and n >= self.limit:
                     return
@@ -495,11 +520,18 @@ def _extract_leaf(ctx, cond) -> Optional[Tuple[str, str, Any]]:
         return None
     l, r = cond.l, cond.r
     if isinstance(l, Idiom) and _is_const(r):
-        return repr(l), op, r.compute(ctx)
-    if isinstance(r, Idiom) and _is_const(l):
+        leaf = repr(l), op, r.compute(ctx)
+    elif isinstance(r, Idiom) and _is_const(l):
         flip = {"=": "=", "<": ">", "<=": ">=", ">": "<", ">=": "<="}
-        return repr(r), flip[op], l.compute(ctx)
-    return None
+        leaf = repr(r), flip[op], l.compute(ctx)
+    else:
+        return None
+    # array/object constants are not servable from per-element index
+    # entries (an equality on a whole array would match nothing — a
+    # candidate SUBSET, which plans must never produce)
+    if isinstance(leaf[2], (list, dict)):
+        return None
+    return leaf
 
 
 def _is_const(e) -> bool:

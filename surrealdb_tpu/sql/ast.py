@@ -585,6 +585,15 @@ class FunctionCall(Expr):
     def compute(self, ctx):
         from surrealdb_tpu import fnc
 
+        # count(->graph->chain) sums path counts on the mirror frontier
+        # instead of materializing millions of expanded Things just to
+        # len() them (the 3-hop north-star metric's hot path)
+        if self.name == "count" and len(self.args) == 1:
+            from surrealdb_tpu.sql.path import graph_chain_count
+
+            n = graph_chain_count(ctx, self.args[0])
+            if n is not None:
+                return n
         args = [a.compute(ctx) for a in self.args]
         return fnc.run(ctx, self.name, args, exprs=self.args)
 

@@ -410,6 +410,28 @@ def _prefix_end(p: bytes) -> bytes:
     return prefix_end(p)
 
 
+def graph_chain_count(ctx, expr) -> "int | None":
+    """count(->a->b->c) fast path: when the argument is a pure cond-free
+    graph-chain idiom over the current record, sum the path counts on the
+    CSR frontier without expanding (idx/graph_csr.py chain_count). Returns
+    None when ineligible — the caller falls back to normal evaluation, so
+    this is purely an execution strategy, never a semantics change."""
+    if not isinstance(expr, Idiom) or not expr.parts:
+        return None
+    if not all(isinstance(p, PGraph) for p in expr.parts):
+        return None
+    doc = ctx.doc
+    rid = doc.rid if doc is not None else None
+    if not isinstance(rid, Thing):
+        return None
+    for p in expr.parts:
+        if not _mirror_eligible(ctx, p):
+            return None
+    # no exception guard: deadline/internal errors must propagate, not
+    # silently re-run the whole traversal on the slow path
+    return ctx.ds().graph_mirrors.chain_count(ctx, [rid], list(expr.parts))
+
+
 def _mirror_eligible(ctx, p: PGraph) -> bool:
     """A hop can ride the CSR mirrors when its edge tables are named, it has
     no per-record WHERE, and this transaction has no uncommitted edge writes

@@ -226,3 +226,22 @@ def test_graph_multiplicity_converging_paths(ds, jax8):
         assert sorted(t.id for t in out) == [3, 3]
     finally:
         cnf.TPU_GRAPH_ONDEVICE_THRESHOLD = old
+
+
+def test_count_graph_chain_fast_path(ds):
+    """count(->chain) sums frontier counts without expanding; equals the
+    expanded list's length, including parallel-edge multiplicity."""
+    from surrealdb_tpu.sql.value import Thing
+
+    ds.execute("DEFINE TABLE p SCHEMALESS; INSERT INTO p $rows;",
+               vars={"rows": [{"id": i} for i in range(20)]})
+    rows = [{"in": Thing("p", i), "out": Thing("p", (i + j) % 20)}
+            for i in range(20) for j in (1, 2, 3)]
+    rows.append({"in": Thing("p", 0), "out": Thing("p", 1)})  # parallel edge
+    ds.execute("INSERT RELATION INTO knows $rows;", vars={"rows": rows})
+
+    n = ds.execute("SELECT count(->knows->p->knows->p) AS c FROM p:0;")[-1]["result"][0]["c"]
+    expanded = ds.execute("SELECT ->knows->p->knows->p AS e FROM p:0;")[-1]["result"][0]["e"]
+    # 4 first-hop edges (incl. the parallel one), each target has out-degree
+    # 3 -> 12 two-hop paths; the parallel edge doubles p:1's contribution
+    assert n == len(expanded) == 12

@@ -179,3 +179,44 @@ def test_order_pushdown_suppressed_for_record_access(ds):
     sess = Session.anonymous("test", "test")
     out = ds.execute("SELECT VALUE id FROM post ORDER BY d LIMIT 3;", sess)
     assert [t.id for t in out[-1]["result"]] == [5, 6, 7]
+
+
+def test_array_equality_constant_not_index_served(ds):
+    ds.execute(
+        "DEFINE TABLE av SCHEMALESS; DEFINE INDEX at ON av FIELDS tags; "
+        "INSERT INTO av [{id: 1, tags: [1, 2]}, {id: 2, tags: [3]}];"
+    )
+    plan = _explain(ds, "SELECT * FROM av WHERE tags = [1, 2]")
+    assert plan[0]["operation"] == "Iterate Table"
+    rows = _ids(ds, "SELECT VALUE id FROM av WHERE tags = [1, 2]")
+    assert rows == [1]  # the row is found, not silently dropped
+
+
+def test_range_scan_dedups_array_entries(ds):
+    ds.execute(
+        "DEFINE TABLE rr SCHEMALESS; DEFINE INDEX ra ON rr FIELDS a; "
+        "INSERT INTO rr [{id: 1, a: [1, 2]}, {id: 2, a: 5}];"
+    )
+    rows = _ids(ds, "SELECT VALUE id FROM rr WHERE a > 0")
+    assert rows == [1, 2]  # id 1 once despite two entries
+
+
+def test_order_pushdown_bails_on_array_rows(ds):
+    """A row with an array order-field aborts the ordered index scan; the
+    result must match the plain scan + post-sort ground truth (key order
+    would place the row at its SMALLEST element, and with LIMIT could also
+    return it twice or crowd out later scalars)."""
+    ds.execute(
+        "DEFINE TABLE truth SCHEMALESS; "
+        "INSERT INTO truth [{id: 1, a: [9, 0]}, {id: 2, a: 5}, {id: 3, a: 1}];"
+    )
+    want = [t.id for t in ds.execute("SELECT VALUE id FROM truth ORDER BY a;")[-1]["result"]]
+    ds.execute(
+        "DEFINE TABLE ob SCHEMALESS; DEFINE INDEX oa ON ob FIELDS a; "
+        "INSERT INTO ob [{id: 1, a: [9, 0]}, {id: 2, a: 5}, {id: 3, a: 1}];"
+    )
+    rows = ds.execute("SELECT VALUE id FROM ob ORDER BY a;")[-1]["result"]
+    assert [t.id for t in rows] == want
+    # and with LIMIT: the pushed limit must not leak key-order truncation
+    l1 = ds.execute("SELECT VALUE id FROM ob ORDER BY a LIMIT 2;")[-1]["result"]
+    assert [t.id for t in l1] == want[:2]
