@@ -45,16 +45,27 @@ def add(ctx, a, v):
 
 @register("array::all")
 def all_(ctx, a, f=None):
+    """No arg: truthiness of every element; closure: predicate; plain
+    value: every element equals it (reference array.rs all/any accept
+    closure or value)."""
+    from surrealdb_tpu.sql.value import Closure as _C
+
     if f is None:
         return all(truthy(x) for x in _arr(a))
-    return all(truthy(_call(ctx, f, [x])) for x in _arr(a))
+    if isinstance(f, _C):
+        return all(truthy(_call(ctx, f, [x])) for x in _arr(a))
+    return all(value_eq(x, f) for x in _arr(a))
 
 
 @register("array::any")
 def any_(ctx, a, f=None):
+    from surrealdb_tpu.sql.value import Closure as _C
+
     if f is None:
         return any(truthy(x) for x in _arr(a))
-    return any(truthy(_call(ctx, f, [x])) for x in _arr(a))
+    if isinstance(f, _C):
+        return any(truthy(_call(ctx, f, [x])) for x in _arr(a))
+    return any(value_eq(x, f) for x in _arr(a))
 
 
 @register("array::append")
@@ -478,3 +489,38 @@ def windows(ctx, a, size):
     if size < 1:
         raise InvalidArgumentsError("array::windows", "The second argument must be an integer greater than 0.")
     return [a[i : i + size] for i in range(0, len(a) - size + 1)]
+
+
+# aliases + late additions (reference fnc/mod.rs:105-460 name set)
+@register("array::every")
+def every(ctx, a, f=None):
+    return all_(ctx, a, f)
+
+
+@register("array::some")
+def some(ctx, a, f=None):
+    return any_(ctx, a, f)
+
+
+@register("array::includes")
+def includes(ctx, a, v):
+    """Alias of array::any's membership form (closures work too)."""
+    return any_(ctx, a, v)
+
+
+@register("array::index_of")
+def index_of(ctx, a, v):
+    """Alias of array::find_index (value or closure)."""
+    return find_index(ctx, a, v)
+
+
+@register("array::reduce")
+def reduce_(ctx, a, f):
+    """Like fold but seeded with the first element (reference array.rs)."""
+    items = _arr(a)
+    if not items:
+        return NONE
+    acc = items[0]
+    for i, x in enumerate(items[1:]):
+        acc = _call(ctx, f, [acc, x, i])
+    return acc

@@ -34,3 +34,15 @@ def tb(ctx, v):
 @register("record::table")
 def table(ctx, v):
     return Table(_thing(v, "record::table").tb)
+
+
+# meta:: namespace: deprecated aliases the reference still dispatches
+# (fnc/mod.rs "meta::id"/"meta::tb")
+@register("meta::id")
+def meta_id(ctx, v):
+    return _thing(v, "meta::id").id
+
+
+@register("meta::tb")
+def meta_tb(ctx, v):
+    return Table(_thing(v, "meta::tb").tb)

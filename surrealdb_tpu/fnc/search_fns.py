@@ -37,3 +37,13 @@ def offsets(ctx, ref=None, partial=None):
     if qe is not None and doc is not None and hasattr(qe, "offsets"):
         return qe.offsets(ctx, doc, ref)
     return NONE
+
+
+@register("search::analyze")
+def analyze(ctx, analyzer, text):
+    """Run a DEFINEd analyzer over a string and return its terms
+    (reference: fnc/search.rs analyze)."""
+    from surrealdb_tpu.idx.ft_analyzer import analyzer_for
+
+    az = analyzer_for(ctx, str(analyzer))
+    return az.terms(str(text))

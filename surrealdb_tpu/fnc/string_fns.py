@@ -442,3 +442,92 @@ def similarity_smithwaterman(ctx, a, b):
             best = max(best, score)
         prev = cur
     return best
+
+
+# late additions (reference fnc/mod.rs name set)
+@register("string::slug")
+def slug(ctx, s):
+    import re as _re
+    import unicodedata as _ud
+
+    s = _s(s, "string::slug")
+    s = _ud.normalize("NFKD", s).encode("ascii", "ignore").decode()
+    s = _re.sub(r"[^a-zA-Z0-9]+", "-", s).strip("-").lower()
+    return s
+
+
+@register("string::is::domain")
+def is_domain(ctx, s):
+    import re as _re
+
+    s = _s(s, "string::is::domain")
+    if not s or len(s) > 253:
+        return False
+    return bool(
+        _re.fullmatch(
+            r"(?:[a-zA-Z0-9](?:[a-zA-Z0-9-]{0,61}[a-zA-Z0-9])?\.)+[a-zA-Z]{2,63}", s
+        )
+    )
+
+
+@register("string::distance::normalized_levenshtein")
+def norm_levenshtein(ctx, a, b):
+    """Normalized SIMILARITY in [0,1]: 1 - d/max (strsim semantics the
+    reference wraps — identical strings give 1.0, empty/empty gives 1.0)."""
+    a = _s(a, "string::distance::normalized_levenshtein")
+    b = _s(b, "string::distance::normalized_levenshtein")
+    if not a and not b:
+        return 1.0
+    return 1.0 - _levenshtein(a, b) / max(len(a), len(b))
+
+
+@register("string::distance::normalized_damerau_levenshtein")
+def norm_damerau(ctx, a, b):
+    a = _s(a, "string::distance::normalized_damerau_levenshtein")
+    b = _s(b, "string::distance::normalized_damerau_levenshtein")
+    if not a and not b:
+        return 1.0
+    return 1.0 - distance_damerau(ctx, a, b) / max(len(a), len(b))
+
+
+@register("string::distance::osa_distance")
+def osa_distance(ctx, a, b):
+    """Optimal string alignment: damerau-levenshtein with non-overlapping
+    transpositions (the classic OSA recurrence)."""
+    a = _s(a, "string::distance::osa_distance")
+    b = _s(b, "string::distance::osa_distance")
+    la, lb = len(a), len(b)
+    prev2, prev, cur = None, list(range(lb + 1)), [0] * (lb + 1)
+    for i in range(1, la + 1):
+        cur = [i] + [0] * lb
+        for j in range(1, lb + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+            if (
+                prev2 is not None
+                and i > 1
+                and j > 1
+                and a[i - 1] == b[j - 2]
+                and a[i - 2] == b[j - 1]
+            ):
+                cur[j] = min(cur[j], prev2[j - 2] + 1)
+        prev2, prev = prev, cur
+    return prev[lb]
+
+
+@register("string::similarity::sorensen_dice")
+def sorensen_dice(ctx, a, b):
+    """Bigram Sørensen–Dice coefficient over non-whitespace characters
+    (strsim filters whitespace before building bigrams)."""
+    a = "".join(_s(a, "string::similarity::sorensen_dice").split())
+    b = "".join(_s(b, "string::similarity::sorensen_dice").split())
+    if a == b:
+        return 1.0
+    if len(a) < 2 or len(b) < 2:
+        return 0.0
+    from collections import Counter
+
+    ba = Counter(a[i : i + 2] for i in range(len(a) - 1))
+    bb = Counter(b[i : i + 2] for i in range(len(b) - 1))
+    inter = sum((ba & bb).values())
+    return 2.0 * inter / (sum(ba.values()) + sum(bb.values()))

@@ -170,3 +170,46 @@ def similarity_jaccard(ctx, a, b):
 def similarity_pearson(ctx, a, b):
     va, vb = _pair(a, b, "vector::similarity::pearson")
     return 1.0 - distance_single(va, vb, "pearson")
+
+
+@register("vector::similarity::spearman")
+def spearman(ctx, a, b):
+    """Spearman rank correlation — implemented for real where the reference
+    returns FeatureNotYetImplemented (fnc/vector.rs:132)."""
+    import numpy as _np
+
+    va = _np.asarray(_vec(a, "vector::similarity::spearman"), dtype=float)
+    vb = _np.asarray(_vec(b, "vector::similarity::spearman"), dtype=float)
+    if va.shape != vb.shape:
+        from surrealdb_tpu.err import InvalidArgumentsError
+
+        raise InvalidArgumentsError(
+            "vector::similarity::spearman",
+            "The two vectors must be of the same dimension.",
+        )
+
+    def rank(x):
+        order = _np.argsort(x, kind="stable")
+        r = _np.empty_like(order, dtype=float)
+        r[order] = _np.arange(len(x), dtype=float)
+        # average ties
+        for v in _np.unique(x):
+            m = x == v
+            if m.sum() > 1:
+                r[m] = r[m].mean()
+        return r
+
+    ra, rb = rank(va), rank(vb)
+    da, db_ = ra - ra.mean(), rb - rb.mean()
+    denom = float(_np.sqrt((da**2).sum() * (db_**2).sum()))
+    return float((da * db_).sum() / denom) if denom else 0.0
+
+
+@register("vector::distance::mahalanobis")
+def mahalanobis(ctx, a, b):
+    from surrealdb_tpu.err import SurrealError
+
+    raise SurrealError(
+        "vector::distance::mahalanobis() is not implemented (it requires a "
+        "covariance matrix; the reference leaves it unimplemented too)"
+    )
