@@ -463,7 +463,10 @@ def bench_ml_scan(ds, s, rng):
     }
     run(ds, s, "DEFINE MODEL ml::scorer<1>")
     import_model(ds, s, "scorer", "1", spec)
-    sql = "SELECT count() AS n, math::max(ml::scorer<1>(emb)) AS mx FROM item GROUP ALL"
+    # VALUE-mode single ml:: call over the indexed field rides the columnar
+    # fast path: the feature column is already device-resident in the
+    # vector mirror, so the whole scan is ONE forward dispatch
+    sql = "SELECT VALUE ml::scorer<1>(emb) FROM item"
 
     run(ds, s, sql)  # warmup: compile the batched forward
     t0 = time.perf_counter()

@@ -65,6 +65,12 @@ def select_compute(ctx, stm) -> Any:
 
             return explain(c, stm, sources, full=stm.explain_full)
 
+        from surrealdb_tpu.ml.exec import try_columnar_ml_scan
+
+        fast = try_columnar_ml_scan(c, stm, sources)
+        if fast is not None:
+            return _only(stm, fast)
+
         from surrealdb_tpu.idx.planner import plan_sources
 
         sources = plan_sources(c, stm, sources)

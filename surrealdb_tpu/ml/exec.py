@@ -226,3 +226,132 @@ def run_model_batch(ctx, name: str, version: str, per_row_args: dict) -> dict:
             vals = [[float(x) for x in row] for row in rows]
         results[i] = vals if batched else vals[0]
     return results
+
+
+def try_columnar_ml_scan(ctx, stm, sources):
+    """Columnar fast path for `SELECT VALUE ml::m<v>(field) FROM tbl`:
+    when `field` is vector-indexed, the feature column already lives
+    device-resident in the index mirror — score the WHOLE table in one
+    forward over that matrix; rows never round-trip through Python
+    (BASELINE config 5; the reference runs Model::compute per document,
+    core/src/sql/model.rs). Returns the result list, or None when the
+    statement shape / snapshot state makes the path inapplicable — falling
+    back is always just an execution-strategy change.
+
+    Applicability: single full-table source; VALUE-mode projection that is
+    exactly one ml:: call on a simple field; no WHERE/GROUP/SPLIT/ORDER/
+    LIMIT/START/FETCH/OMIT; a ready HNSW/MTREE index on that field; a bare
+    statement whose snapshot IS the latest commit, with no uncommitted
+    writes (the mirror only holds latest committed state — inside
+    BEGIN..COMMIT or against an older snapshot the row path preserves
+    snapshot isolation); not a permission-filtered session (per-row
+    PERMISSIONS must see each document); and the mirror covers every table
+    row (records missing the field would silently vanish instead of
+    erroring per-row).
+
+    Results come back in table key order (matching the row path) and, on
+    accelerator backends, are computed from the mirror's compute dtype
+    (bf16 features, f32 accumulation — the same numerical policy as the
+    distance kernels; CPU keeps full f32).
+    """
+    from surrealdb_tpu import key as keys
+    from surrealdb_tpu.dbs.iterator import ITable
+    from surrealdb_tpu.iam.check import perms_apply
+    from surrealdb_tpu.idx.knn import VectorMirror
+    from surrealdb_tpu.key.encode import prefix_end
+    from surrealdb_tpu.sql.ast import ModelCall
+    from surrealdb_tpu.sql.path import Idiom
+
+    if len(sources) != 1 or not isinstance(sources[0], ITable):
+        return None
+    if not getattr(stm, "value_mode", False) or len(stm.fields) != 1:
+        return None
+    f = stm.fields[0]
+    if getattr(f, "all", False):
+        return None
+    call = f.expr
+    if not isinstance(call, ModelCall) or len(call.args) != 1:
+        return None
+    arg = call.args[0]
+    if not isinstance(arg, Idiom) or arg.simple_name() is None:
+        return None
+    for attr in ("cond", "group", "split", "order", "limit", "start", "fetch", "omit"):
+        if getattr(stm, attr, None):
+            return None
+    if getattr(stm, "group_all", False) or perms_apply(ctx):
+        return None
+    if getattr(ctx.executor, "explicit", False):
+        return None  # inside BEGIN..COMMIT: snapshot may predate the mirror
+    txn = ctx.txn()
+    if getattr(txn.tr, "writes", None):
+        return None  # uncommitted writes are invisible to the mirror
+    # the mirror holds LATEST committed state; serve only a snapshot that
+    # is the latest commit (a concurrent commit between this txn's open and
+    # now would otherwise leak future values into an older read snapshot)
+    snap = getattr(txn.tr, "snapshot", None)
+    store_v = getattr(getattr(txn.tr, "store", None), "version", None)
+    if snap is None or store_v is None or snap != store_v:
+        return None
+    ns, db = ctx.ns_db()
+    tb = sources[0].tb
+    field_txt = repr(arg)
+    ix = None
+    for cand in txn.all_tb_indexes(ns, db, tb):
+        if (
+            cand["index"].get("type") in ("hnsw", "mtree")
+            and cand.get("status", "ready") == "ready"
+            and cand["fields"]
+            and repr(cand["fields"][0]) == field_txt
+        ):
+            ix = cand
+            break
+    if ix is None:
+        return None
+
+    ds = ctx.ds()
+    mirror = ds.index_stores.get_or_create(ns, db, tb, ix["name"], VectorMirror)
+    mirror.ensure_built(ctx, ix)
+    # completeness: every table row must be in the mirror. The O(N) key
+    # count is cached per (mirror gen, committed store version) — any
+    # commit or mirror mutation invalidates it.
+    cache_key = (mirror.gen, store_v)
+    cached = getattr(mirror, "_columnar_rows", None)
+    if cached is not None and cached[0] == cache_key:
+        n_rows = cached[1]
+    else:
+        pre = keys.thing_prefix(ns, db, tb)
+        n_rows = sum(1 for _ in txn.keys(pre, prefix_end(pre)))
+        mirror._columnar_rows = (cache_key, n_rows)
+    if mirror.count() != n_rows:
+        return None
+
+    # NOTE: no model PERMISSIONS check needed — the path already bailed for
+    # every session where permissions apply
+    cm = _compiled(ctx, ns, db, call.name, call.version)
+    from surrealdb_tpu import cnf
+    from surrealdb_tpu.key.encode import enc_value_key
+
+    if cnf.TPU_DISABLE:
+        data, _norms, rids_live = mirror.host_search_view()
+        if data.shape[1] != cm.in_dim:
+            return None
+        cm.dispatches += 1
+        out = cm.forward_host(data)
+    else:
+        matrix, mask, rids = mirror.device_snapshot()
+        if int(matrix.shape[1]) != cm.in_dim:
+            return None
+        import jax.numpy as jnp
+
+        cm.dispatches += 1
+        full = np.asarray(cm._device_fn()(matrix.astype(jnp.float32)))
+        live = np.nonzero(mask[: full.shape[0]])[0]
+        out = full[live]
+        rids_live = [rids[int(i)] for i in live]
+    # table key order (the row path's order): sort by encoded record id
+    order = sorted(
+        range(len(rids_live)), key=lambda i: enc_value_key(rids_live[i].id)
+    )
+    if cm.out_dim == 1:
+        return [float(out[i, 0]) for i in order]
+    return [[float(x) for x in out[i]] for i in order]

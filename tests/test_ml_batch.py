@@ -112,3 +112,58 @@ def test_function_permissions_none_denies_guest(ds):
     assert out[0]["status"] == "ERR"
     out = ds.execute("RETURN fn::sq(3);")
     assert out[0]["result"] == 9
+
+
+# ------------------------------------------------------------------ columnar
+def test_columnar_scan_over_vector_mirror(ds):
+    """SELECT VALUE ml::m(field) FROM t with a vector index on `field`
+    scores the device-resident mirror in ONE dispatch, matching the
+    row-collected path's values."""
+    _import(ds)
+    ds.execute("DEFINE INDEX iv ON h FIELDS f HNSW DIMENSION 2;")
+    ds.execute(";".join(f"CREATE h:{i} SET f = [{i}.0, {2*i}.0]" for i in range(12)))
+    out = ds.execute("SELECT VALUE ml::score<1>(f) FROM h;")
+    vals = sorted(out[-1]["result"])
+    assert vals == sorted(10.0 + 2.0 * i + 6.0 * i for i in range(12))
+    cm = _compiled_model(ds)
+    assert cm.dispatches == 1
+
+    # a WHERE clause falls back to the row path (still batched, 1 dispatch)
+    out = ds.execute("SELECT VALUE ml::score<1>(f) FROM h WHERE f[0] > 5;")
+    assert len(out[-1]["result"]) == 6
+    assert cm.dispatches == 2
+
+
+def test_columnar_scan_skipped_when_mirror_incomplete(ds):
+    """A record missing the indexed field keeps the row path (the columnar
+    scan would silently drop it instead of erroring per-row)."""
+    _import(ds)
+    ds.execute("DEFINE INDEX iv ON h FIELDS f HNSW DIMENSION 2;")
+    ds.execute("CREATE h:1 SET f = [1.0, 1.0]; CREATE h:2 SET g = 1;")
+    out = ds.execute("SELECT VALUE ml::score<1>(f) FROM h;")
+    assert out[-1]["status"] == "ERR"  # row 2's missing field errors, as per-row does
+
+
+def test_columnar_scan_skipped_inside_write_txn(ds):
+    _import(ds)
+    ds.execute("DEFINE INDEX iv ON h FIELDS f HNSW DIMENSION 2;")
+    ds.execute("CREATE h:1 SET f = [1.0, 1.0];")
+    out = ds.execute(
+        "BEGIN; CREATE h:2 SET f = [2.0, 2.0]; "
+        "SELECT VALUE ml::score<1>(f) FROM h; COMMIT;"
+    )
+    # the uncommitted row must be visible -> row path, 2 results
+    assert len(out[-1]["result"]) == 2
+
+
+def test_columnar_scan_key_order_after_mixed_inserts(ds):
+    """Columnar results come back in table key order, matching the row
+    path, even when mirror slot order differs (review r3 regression)."""
+    _import(ds)
+    ds.execute("DEFINE INDEX iv ON h FIELDS f HNSW DIMENSION 2;")
+    ds.execute(";".join(f"CREATE h:{i} SET f = [{i}.0, {i}.0]" for i in (5, 6, 7)))
+    ds.execute("SELECT VALUE ml::score<1>(f) FROM h;")  # build mirror
+    ds.execute("CREATE h:1 SET f = [1.0, 1.0];")  # appends to a later slot
+    fast = ds.execute("SELECT VALUE ml::score<1>(f) FROM h;")[-1]["result"]
+    slow = ds.execute("SELECT VALUE ml::score<1>(f) FROM h WHERE f[0] >= 0;")[-1]["result"]
+    assert fast == slow  # positionally identical, key order
