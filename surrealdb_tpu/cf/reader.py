@@ -32,20 +32,32 @@ def show_changes(ctx, stm) -> List[dict]:
         )
 
     since_vs = 0
+    since_ts = None
     if stm.since is not None:
         v = stm.since.compute(ctx) if hasattr(stm.since, "compute") else stm.since
         if isinstance(v, Datetime):
-            since_vs = 0  # datetime SINCE: replay all retained (ts→vs map later)
+            # datetime SINCE: entries carry their commit timestamp; skip
+            # those older than the requested instant (keys are vs-ordered =
+            # time-ordered, so the retained scan stays bounded by GC)
+            since_ts = v.nanos
         else:
             since_vs = int(v)
 
     beg = keys.change(ns, db, u64_to_vs(since_vs))
     end = prefix_end(keys.change_prefix(ns, db))
-    limit = stm.limit if stm.limit is not None else -1
+    # the LIMIT counts RETURNED change sets, so it must apply after the
+    # ts filter, not to the raw key scan
+    limit = stm.limit if stm.limit is not None else None
 
     out: List[dict] = []
-    for k, raw in txn.scan(beg, end, limit):
+    for k, raw in txn.scan(beg, end):
+        if limit is not None and len(out) >= limit:
+            break
         entry = unpack(raw)
+        ts = entry.get("ts")
+        # entries written before timestamps existed replay (never drop)
+        if since_ts is not None and ts is not None and ts < since_ts:
+            continue
         vs = keys.decode_change(k, ns, db)
         changes: List[Any] = []
         for tb, muts in entry.get("tables", {}).items():

@@ -330,7 +330,7 @@ class Iterator:
                 for rid, doc in scan_table(self.ctx, it.tb):
                     self._process_record(rid, doc)
                 if self.mutated == before:
-                    self._process_defer(Thing(it.tb))
+                    self._process_defer(Thing(it.tb), generated_id=True)
                 return
             for rid, doc in scan_table(self.ctx, it.tb):
                 self._process_record(rid, doc)
@@ -378,9 +378,12 @@ class Iterator:
             return
         self._process_record(t, doc)
 
-    def _process_defer(self, t: Thing) -> None:
+    def _process_defer(self, t: Thing, generated_id: bool = False) -> None:
         from surrealdb_tpu.doc import pipeline as doc
+        from surrealdb_tpu.err import IndexExistsError
 
+        txn = self.ctx.txn()
+        sp = txn.savepoint()
         try:
             if self.verb in ("create", "upsert"):
                 self._push(doc.process_create(self.ctx, t, self.stm, check_exists=self.verb == "create"))
@@ -390,6 +393,29 @@ class Iterator:
         except IgnoreError as e:
             if e.mutated:
                 self.mutated += 1
+        except IndexExistsError as e:
+            # a table-level UPSERT (generated id) hitting a unique-index
+            # holder retries as an UPDATE of that record (reference
+            # RetryWithId, doc/process.rs:24-120); the savepoint discards
+            # the half-written create first. An explicit-id UPSERT keeps
+            # the error — the user named a DIFFERENT record.
+            txn.rollback_to(sp)
+            if (
+                self.verb != "upsert"
+                or not generated_id
+                or not isinstance(e.thing, Thing)
+            ):
+                raise
+            ns, db = self.ctx.ns_db()
+            existing = txn.get_record(ns, db, e.thing.tb, e.thing.id)
+            if existing is None:
+                raise
+            try:
+                self._push(doc.process_update(self.ctx, e.thing, existing, self.stm))
+                self.mutated += 1
+            except IgnoreError as ig:
+                if ig.mutated:
+                    self.mutated += 1
 
     def _process_record(self, rid: Thing, docv: dict, ir=None) -> None:
         from surrealdb_tpu.doc import pipeline as doc
@@ -429,11 +455,38 @@ class Iterator:
 
     def _process_mergeable(self, it: IMergeable) -> None:
         from surrealdb_tpu.doc import pipeline as doc
+        from surrealdb_tpu.err import IndexExistsError
 
+        txn = self.ctx.txn()
+        sp = txn.savepoint()
         try:
             self._push(doc.process_insert(self.ctx, it.t, it.row, self.stm))
         except IgnoreError:
             pass
+        except IndexExistsError as e:
+            # a UNIQUE INDEX conflict (not an id conflict) on INSERT: roll
+            # the half-written record back, then honor IGNORE / ON
+            # DUPLICATE KEY UPDATE against the HOLDER record (reference
+            # RetryWithId, doc/process.rs:24-120)
+            txn.rollback_to(sp)
+            if getattr(self.stm, "ignore", False):
+                return
+            update = getattr(self.stm, "update", None)
+            if update is None or not isinstance(e.thing, Thing):
+                raise
+            ns, db = self.ctx.ns_db()
+            existing = txn.get_record(ns, db, e.thing.tb, e.thing.id)
+            if existing is None:
+                raise
+            from surrealdb_tpu.sql.statements import Data
+
+            sub = doc._StmView(
+                data=Data("set", update), output=getattr(self.stm, "output", None)
+            )
+            try:
+                self._push(doc.process_update(self.ctx, e.thing, existing, sub))
+            except IgnoreError:
+                pass
 
     def _process_relatable(self, it: IRelatable) -> None:
         from surrealdb_tpu.doc import pipeline as doc

@@ -1,7 +1,8 @@
 """GraphQL endpoint (reference: core/src/gql/ — dynamic schema from table
-DEFINEs, gated by SURREAL_EXPERIMENTAL_GRAPHQL). The schema generator and
-query translator land in the GraphQL milestone; until then the endpoint
-reports itself disabled, matching the reference's default."""
+DEFINEs, gated by SURREAL_EXPERIMENTAL_GRAPHQL, matching the reference's
+experimental default-off). Enabled, requests execute via gql/exec.py: a
+self-contained GraphQL subset parser + SurrealQL translation through the
+normal engine, so permissions/planner/capabilities all apply."""
 
 from __future__ import annotations
 

@@ -1,0 +1,317 @@
+"""GraphQL query execution over the table catalog.
+
+Role of the reference's gql module (reference: core/src/gql/schema.rs — a
+dynamic schema where every table becomes a root query field with
+filter/limit/start/order arguments, resolved by translating to SurrealQL).
+This is a self-contained subset implementation (no external GraphQL
+dependency): a spec-shaped lexer/parser for executable documents, then
+translation of each root field into a SELECT through the normal engine
+(permissions, planner, and capabilities all apply).
+
+Supported: query operations (anonymous or named), variables, arguments
+`limit`, `start`, `order` (field name, or {field: ASC|DESC}), `filter`
+({field: value} equality conjunction), field selections with aliases,
+nested selection sets on record links (resolved by fetching the linked
+record), and `__typename`. Mutations/subscriptions/fragments report a
+clean unsupported error.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from surrealdb_tpu.err import SurrealError
+from surrealdb_tpu.sql.value import Thing
+
+_TOKEN = re.compile(
+    r"""
+    (?P<ws>[\s,]+|\#[^\n]*)
+  | (?P<name>[_A-Za-z][_0-9A-Za-z]*)
+  | (?P<float>-?\d+\.\d+([eE][+-]?\d+)?|-?\d+[eE][+-]?\d+)
+  | (?P<int>-?\d+)
+  | (?P<string>"(?:\\.|[^"\\])*")
+  | (?P<punct>\.\.\.|[!$():=@\[\]{}|])
+    """,
+    re.VERBOSE,
+)
+
+
+def _lex(src: str) -> List[Tuple[str, str]]:
+    out, i = [], 0
+    while i < len(src):
+        m = _TOKEN.match(src, i)
+        if m is None:
+            raise SurrealError(f"GraphQL syntax error at offset {i}")
+        i = m.end()
+        kind = m.lastgroup
+        if kind != "ws":
+            out.append((kind, m.group()))
+    out.append(("eof", ""))
+    return out
+
+
+class _Parser:
+    def __init__(self, src: str):
+        self.toks = _lex(src)
+        self.i = 0
+
+    def peek(self) -> Tuple[str, str]:
+        return self.toks[self.i]
+
+    def next(self) -> Tuple[str, str]:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def eat(self, kind: str, value: Optional[str] = None) -> bool:
+        k, v = self.peek()
+        if k == kind and (value is None or v == value):
+            self.i += 1
+            return True
+        return False
+
+    def expect(self, kind: str, value: Optional[str] = None) -> str:
+        k, v = self.next()
+        if k != kind or (value is not None and v != value):
+            raise SurrealError(f"GraphQL syntax error: expected {value or kind}, got {v!r}")
+        return v
+
+    # ---------------------------------------------------------- document
+    def document(self) -> dict:
+        """Returns the single executable operation."""
+        ops = []
+        while self.peek()[0] != "eof":
+            k, v = self.peek()
+            if k == "punct" and v == "{":
+                ops.append({"type": "query", "name": None, "vars": [], "sel": self.selection_set()})
+            elif k == "name" and v in ("query", "mutation", "subscription"):
+                self.next()
+                if v != "query":
+                    raise SurrealError(f"GraphQL {v} operations are not supported")
+                name = None
+                if self.peek()[0] == "name":
+                    name = self.next()[1]
+                var_defs = []
+                if self.eat("punct", "("):
+                    while not self.eat("punct", ")"):
+                        self.expect("punct", "$")
+                        vname = self.expect("name")
+                        self.expect("punct", ":")
+                        self._type_ref()
+                        default = None
+                        if self.eat("punct", "="):
+                            default = self.value_node()
+                        var_defs.append((vname, default))
+                ops.append({"type": "query", "name": name, "vars": var_defs, "sel": self.selection_set()})
+            elif k == "name" and v == "fragment":
+                raise SurrealError("GraphQL fragments are not supported")
+            else:
+                raise SurrealError(f"GraphQL syntax error near {v!r}")
+        if len(ops) != 1:
+            raise SurrealError("Exactly one GraphQL operation is supported per request")
+        return ops[0]
+
+    def _type_ref(self) -> None:
+        if self.eat("punct", "["):
+            self._type_ref()
+            self.expect("punct", "]")
+        else:
+            self.expect("name")
+        self.eat("punct", "!")
+
+    def selection_set(self) -> List[dict]:
+        self.expect("punct", "{")
+        out = []
+        while not self.eat("punct", "}"):
+            out.append(self.field())
+        return out
+
+    def field(self) -> dict:
+        if self.peek() == ("punct", "..."):
+            raise SurrealError("GraphQL fragments are not supported")
+        name = self.expect("name")
+        alias = None
+        if self.eat("punct", ":"):
+            alias, name = name, self.expect("name")
+        args: Dict[str, Any] = {}
+        if self.eat("punct", "("):
+            while not self.eat("punct", ")"):
+                an = self.expect("name")
+                self.expect("punct", ":")
+                args[an] = self.value_node()
+        sel = None
+        if self.peek() == ("punct", "{"):
+            sel = self.selection_set()
+        return {"name": name, "alias": alias or name, "args": args, "sel": sel}
+
+    # ---------------------------------------------------------- values
+    def value_node(self):
+        """Parse a value tree; `_Var` markers resolve at execution time
+        (variables may sit anywhere, including inside objects/lists)."""
+        k, v = self.next()
+        if k == "int":
+            return int(v)
+        if k == "float":
+            return float(v)
+        if k == "string":
+            return _unquote(v)
+        if k == "name":
+            return {"true": True, "false": False, "null": None}.get(v, v)
+        if k == "punct" and v == "$":
+            return _Var(self.expect("name"))
+        if k == "punct" and v == "[":
+            out = []
+            while not self.eat("punct", "]"):
+                out.append(self.value_node())
+            return out
+        if k == "punct" and v == "{":
+            out = {}
+            while not self.eat("punct", "}"):
+                key = self.expect("name")
+                self.expect("punct", ":")
+                out[key] = self.value_node()
+            return out
+        raise SurrealError(f"GraphQL syntax error near {v!r}")
+
+
+def _unquote(s: str) -> str:
+    import json
+
+    return json.loads(s)
+
+
+class _Var:
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+def _resolve(node, variables: Dict[str, Any]):
+    """Deep-resolve _Var markers against the request's variables."""
+    if isinstance(node, _Var):
+        if node.name not in variables:
+            raise SurrealError(f"Unknown GraphQL variable ${node.name}")
+        return variables[node.name]
+    if isinstance(node, list):
+        return [_resolve(x, variables) for x in node]
+    if isinstance(node, dict):
+        return {k: _resolve(v, variables) for k, v in node.items()}
+    return node
+
+
+# ------------------------------------------------------------------ execution
+_IDENT = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+def _safe_ident(name: str, what: str) -> str:
+    if not _IDENT.match(name):
+        raise SurrealError(f"Invalid GraphQL {what} {name!r}")
+    return name
+
+
+def run_graphql(ds, session, request: dict) -> dict:
+    try:
+        if not isinstance(request, dict):
+            raise SurrealError("GraphQL request must be an object")
+        vars_in = request.get("variables") or {}
+        if not isinstance(vars_in, dict):
+            raise SurrealError("GraphQL variables must be an object")
+        op = _Parser(str(request.get("query") or "")).document()
+        variables = dict(vars_in)
+        for vname, default in op["vars"]:
+            if vname not in variables and default is not None:
+                variables[vname] = default
+        data = {}
+        for field in op["sel"]:
+            data[field["alias"]] = _root_field(ds, session, field, variables)
+        return {"data": data}
+    except SurrealError as e:
+        return {"errors": [{"message": str(e)}]}
+
+
+def _root_field(ds, session, field: dict, variables: Dict[str, Any]):
+    if field["name"] == "__typename":
+        return "Query"
+    tb = _safe_ident(field["name"], "table")
+    ns, db = session.ns, session.db
+    if not ns or not db:
+        raise SurrealError("GraphQL requires a namespace and database on the session")
+
+    sql = [f"SELECT * FROM {tb}"]
+    vars: Dict[str, Any] = {}
+    args = {k: _resolve(v, variables) for k, v in field["args"].items()}
+    flt = args.get("filter") or args.get("where")
+    if flt is not None:
+        if not isinstance(flt, dict) or not flt:
+            raise SurrealError("GraphQL filter must be a non-empty object")
+        conds = []
+        for i, (f, v) in enumerate(flt.items()):
+            conds.append(f"{_safe_ident(f, 'field')} = $_gf{i}")
+            vars[f"_gf{i}"] = v
+        sql.append("WHERE " + " AND ".join(conds))
+    order = args.get("order")
+    if order is not None:
+        if isinstance(order, dict) and len(order) == 1:
+            f, d = next(iter(order.items()))
+            direction = "DESC" if str(d).upper() == "DESC" else "ASC"
+            sql.append(f"ORDER BY {_safe_ident(f, 'field')} {direction}")
+        elif isinstance(order, str):
+            sql.append(f"ORDER BY {_safe_ident(order, 'field')}")
+        else:
+            raise SurrealError("GraphQL order must be a field name or {field: ASC|DESC}")
+    for arg_name, clause, var in (("limit", "LIMIT", "_glimit"), ("start", "START", "_gstart")):
+        if args.get(arg_name) is not None:
+            try:
+                vars[var] = int(args[arg_name])
+            except (TypeError, ValueError):
+                raise SurrealError(f"GraphQL {arg_name} must be an integer")
+            sql.append(f"{clause} ${var}")
+
+    out = ds.execute(" ".join(sql) + ";", session, vars=vars)
+    resp = out[-1]
+    if resp["status"] != "OK":
+        raise SurrealError(str(resp["result"]))
+    rows = resp["result"]
+    sel = field["sel"]
+    if sel is None:
+        raise SurrealError(f"GraphQL field '{tb}' requires a selection set")
+    return [_project(ds, session, row, sel, depth=0) for row in rows]
+
+
+_MAX_LINK_DEPTH = 5
+
+
+def _project(ds, session, row, sel: List[dict], depth: int):
+    out = {}
+    for f in sel:
+        if f["name"] == "__typename":
+            rid = row.get("id") if isinstance(row, dict) else None
+            out[f["alias"]] = rid.tb if isinstance(rid, Thing) else "Record"
+            continue
+        v = row.get(f["name"]) if isinstance(row, dict) else None
+        out[f["alias"]] = _render(ds, session, v, f["sel"], depth)
+    return out
+
+
+def _render(ds, session, v, sel, depth: int):
+    if isinstance(v, list):
+        return [_render(ds, session, x, sel, depth) for x in v]
+    if isinstance(v, Thing):
+        if sel is None:
+            return str(v)
+        if depth >= _MAX_LINK_DEPTH:
+            raise SurrealError("GraphQL record-link nesting too deep")
+        out = ds.execute("SELECT * FROM $r;", session, vars={"r": v})
+        rows = out[-1]["result"] if out[-1]["status"] == "OK" else []
+        if not rows:
+            return None
+        return _project(ds, session, rows[0], sel, depth + 1)
+    if sel is not None:
+        if isinstance(v, dict):
+            return _project(ds, session, v, sel, depth)
+        return None
+    from surrealdb_tpu.sql.value import to_json_value
+
+    return to_json_value(v)

@@ -110,5 +110,14 @@ class Datastore:
         ex = Executor(self, session, vars or {})
         return ex.compute_expression(expr)
 
+    # ------------------------------------------------------------ maintenance
+    def tick(self) -> int:
+        """One maintenance pass (reference kvs/ds.rs tick): changefeed GC.
+        Called periodically by the server loop; embedded users may call it
+        directly. Returns the number of change entries collected."""
+        from surrealdb_tpu.cf.gc import gc_all
+
+        return gc_all(self)
+
     def close(self) -> None:
         self.backend.close()

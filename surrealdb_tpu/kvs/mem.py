@@ -82,12 +82,18 @@ class MemDatastore(BackendDatastore):
                 del self.data[key]
 
 
+_ABSENT = object()  # "key had no local write" marker in the undo log
+
+
 class MemTransaction(BackendTransaction):
     def __init__(self, store: MemDatastore, write: bool):
         super().__init__(write)
         self.store = store
         self.snapshot = store._acquire_snapshot()
         self.writes: Dict[bytes, Optional[bytes]] = {}
+        # savepoint undo log: (key, previous write-buffer state) per
+        # mutation while recording; None = not recording (zero overhead)
+        self.undo: Optional[List[tuple]] = None
 
     # -- lifecycle ---------------------------------------------------------
     def commit(self) -> None:
@@ -131,10 +137,14 @@ class MemTransaction(BackendTransaction):
 
     def set(self, key: bytes, val: bytes) -> None:
         self._check_open(True)
+        if self.undo is not None:
+            self.undo.append((key, self.writes.get(key, _ABSENT)))
         self.writes[key] = val
 
     def delete(self, key: bytes) -> None:
         self._check_open(True)
+        if self.undo is not None:
+            self.undo.append((key, self.writes.get(key, _ABSENT)))
         self.writes[key] = None
 
     # -- range ops ---------------------------------------------------------

@@ -88,6 +88,51 @@ class Transaction:
         """Defer a side effect until this transaction has committed."""
         self._on_commit.append(fn)
 
+    # ------------------------------------------------------------ savepoints
+    def savepoint(self):
+        """Mark the uncommitted state so a mid-record failure can roll back
+        just its own writes (role of the reference's kvs savepoints backing
+        the RetryWithId protocol, doc/process.rs:24-120). O(1): the backend
+        records an undo log from here on; delta buffers are append-only so
+        their lengths suffice."""
+        tr = self.tr
+        if getattr(tr, "undo", None) is None:
+            tr.undo = []
+        return (
+            len(tr.undo),
+            {k: len(v) for k, v in self.cf_buffer.items()},
+            len(self.graph_deltas),
+            len(self.vector_deltas),
+            len(self.ft_deltas),
+            len(self._on_commit),
+        )
+
+    def rollback_to(self, sp) -> None:
+        n_undo, cf_lens, ng, nv, nf, noc = sp
+        tr = self.tr
+        undo = getattr(tr, "undo", None)
+        if undo is not None:
+            from surrealdb_tpu.kvs.mem import _ABSENT
+
+            for key, prev in reversed(undo[n_undo:]):
+                if prev is _ABSENT:
+                    tr.writes.pop(key, None)
+                else:
+                    tr.writes[key] = prev
+            del undo[n_undo:]
+        for k in list(self.cf_buffer):
+            if k in cf_lens:
+                del self.cf_buffer[k][cf_lens[k] :]
+            else:
+                del self.cf_buffer[k]
+        self.graph_deltas = self.graph_deltas[:ng]
+        self.vector_deltas = self.vector_deltas[:nv]
+        self.ft_deltas = self.ft_deltas[:nf]
+        self._on_commit = self._on_commit[:noc]
+        # catalog entries written in the rolled-back span (ensure_tb etc.)
+        # would otherwise survive in the cache while their KV rows are gone
+        self.cache.clear()
+
     def graph_delta(self, ns, db, src_tb, d: bytes, ft: str, src, dst, add: bool) -> None:
         """Record one edge-pointer mutation for post-commit mirror upkeep."""
         self.graph_deltas.append((ns, db, src_tb, bytes(d), ft, src, dst, add))
@@ -516,6 +561,10 @@ class Transaction:
         for (ns, db, tb), muts in self.cf_buffer.items():
             by_db.setdefault((ns, db), {}).setdefault(tb, []).extend(muts)
         for (ns, db), tables in by_db.items():
-            vs = self.oracle.next_vs(self.clock.now_nanos())
-            self.tr.set(keys.change(ns, db, vs), pack({"vs": vs, "tables": tables}))
+            now = self.clock.now_nanos()
+            vs = self.oracle.next_vs(now)
+            # ts enables datetime SINCE filtering and retention GC
+            self.tr.set(
+                keys.change(ns, db, vs), pack({"vs": vs, "ts": now, "tables": tables})
+            )
         self.cf_buffer = {}

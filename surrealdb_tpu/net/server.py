@@ -215,6 +215,10 @@ class SurrealHandler(BaseHTTPRequestHandler):
             if not self._route_allowed("ml"):
                 return
             return self._ml_import()
+        if path == "/graphql":
+            if not self._route_allowed("graphql"):
+                return
+            return self._graphql()
         if path == "/import":
             if not self._route_allowed("import"):
                 return
@@ -400,6 +404,28 @@ class SurrealHandler(BaseHTTPRequestHandler):
         except SurrealError as e:
             return self._send(404, {"error": str(e)})
 
+    def _graphql(self):
+        """POST /graphql: {"query": ..., "variables": {...}} (reference:
+        src/net/gql.rs; gated by SURREAL_EXPERIMENTAL_GRAPHQL)."""
+        try:
+            sess = self._authorized_session()
+        except SurrealError as e:
+            return self._send(401, {"error": str(e)})
+        try:
+            req = json.loads(self._body())
+        except json.JSONDecodeError:
+            return self._send(400, {"error": "invalid JSON body"})
+        if not isinstance(req, dict):
+            return self._send(400, {"error": "GraphQL request must be a JSON object"})
+        from surrealdb_tpu.gql import execute_graphql
+
+        try:
+            return self._send(200, execute_graphql(self.ds, sess, req))
+        except SurrealError as e:
+            return self._send(400, {"error": str(e)})
+        except Exception as e:  # malformed inputs must never kill the handler
+            return self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
     def _rpc_http(self):
         ct = (self.headers.get("Content-Type") or "application/json").split(";")[0]
         body = self._body()
@@ -541,6 +567,20 @@ class Server:
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+        # periodic maintenance (changefeed GC — reference engine/tasks.rs)
+        self._tick_stop = threading.Event()
+
+        def tick_loop():
+            from surrealdb_tpu import cnf
+
+            while not self._tick_stop.wait(cnf.CHANGEFEED_GC_INTERVAL_SECS):
+                try:
+                    ds.tick()
+                except Exception:  # noqa: BLE001 — maintenance must not die
+                    pass
+
+        self._ticker = threading.Thread(target=tick_loop, daemon=True)
+        self._ticker.start()
 
     @property
     def url(self) -> str:
@@ -555,6 +595,7 @@ class Server:
         self.httpd.serve_forever()
 
     def shutdown(self) -> None:
+        self._tick_stop.set()
         self.httpd.shutdown()
         if self._thread:
             self._thread.join(timeout=5)

@@ -1,0 +1,94 @@
+"""GraphQL endpoint (VERDICT r2 items 5/8; reference: core/src/gql/)."""
+
+import json
+
+import pytest
+
+from surrealdb_tpu.dbs.session import Session
+from surrealdb_tpu.gql import execute_graphql
+
+
+@pytest.fixture
+def gds(ds, monkeypatch):
+    monkeypatch.setenv("SURREAL_EXPERIMENTAL_GRAPHQL", "true")
+    ds.execute(
+        "DEFINE TABLE person SCHEMALESS; "
+        "INSERT INTO person $rows;",
+        vars={
+            "rows": [
+                {"id": i, "name": f"p{i}", "age": 20 + i, "tags": ["x"]}
+                for i in range(6)
+            ]
+        },
+    )
+    ds.execute("CREATE person:99 SET name = 'link', age = 1, friend = person:1;")
+    return ds
+
+
+def _sess():
+    s = Session.owner()
+    s.ns, s.db = "test", "test"
+    return s
+
+
+def test_disabled_by_default(ds):
+    import os
+
+    os.environ.pop("SURREAL_EXPERIMENTAL_GRAPHQL", None)
+    from surrealdb_tpu.err import SurrealError
+
+    with pytest.raises(SurrealError):
+        execute_graphql(ds, _sess(), {"query": "{ person { id } }"})
+
+
+def test_basic_table_query(gds):
+    out = execute_graphql(gds, _sess(), {"query": "{ person(limit: 3) { id name } }"})
+    assert "errors" not in out
+    rows = out["data"]["person"]
+    assert len(rows) == 3
+    assert rows[0]["name"].startswith("p")
+    assert isinstance(rows[0]["id"], str) and rows[0]["id"].startswith("person:")
+
+
+def test_filter_order_alias_and_variables(gds):
+    q = "query Q($n: String) { people: person(filter: {name: $n}) { age } }"
+    out = execute_graphql(gds, _sess(), {"query": q, "variables": {"n": "p3"}})
+    assert out["data"]["people"] == [{"age": 23}]
+    q = "{ person(order: {age: DESC}, limit: 2) { age } }"
+    out = execute_graphql(gds, _sess(), {"query": q})
+    ages = [r["age"] for r in out["data"]["person"]]
+    assert ages == sorted(ages, reverse=True)
+
+
+def test_nested_record_link(gds):
+    q = "{ person(filter: {name: \"link\"}) { name friend { name age } } }"
+    out = execute_graphql(gds, _sess(), {"query": q})
+    row = out["data"]["person"][0]
+    assert row["friend"] == {"name": "p1", "age": 21}
+
+
+def test_typename_and_errors(gds):
+    out = execute_graphql(gds, _sess(), {"query": "{ person(limit: 1) { __typename id } }"})
+    assert out["data"]["person"][0]["__typename"] == "person"
+    out = execute_graphql(gds, _sess(), {"query": "mutation { x }"})
+    assert "not supported" in out["errors"][0]["message"]
+    out = execute_graphql(gds, _sess(), {"query": "{ person(filter: {\"a;DROP\": 1}) { id } }"})
+    assert "errors" in out
+
+
+def test_http_route(gds, monkeypatch):
+    import http.client
+
+    from surrealdb_tpu.net.server import Server
+
+    srv = Server(gds, port=0, auth_enabled=False).start_background()
+    try:
+        c = http.client.HTTPConnection(srv.host, srv.port)
+        body = json.dumps({"query": "{ person(limit: 2) { name } }"})
+        c.request("POST", "/graphql", body, {"surreal-ns": "test", "surreal-db": "test"})
+        r = c.getresponse()
+        out = json.loads(r.read())
+        c.close()
+        assert r.status == 200 and len(out["data"]["person"]) == 2
+    finally:
+        srv.shutdown()

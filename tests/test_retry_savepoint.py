@@ -1,0 +1,75 @@
+"""Unique-conflict retry (RetryWithId analog) + savepoint rollback."""
+
+
+def test_insert_on_duplicate_unique_key_updates_holder(ds):
+    ds.execute(
+        "DEFINE TABLE u SCHEMALESS; DEFINE INDEX ue ON u FIELDS email UNIQUE; "
+        "CREATE u:1 SET email = 'a@x', n = 1;"
+    )
+    out = ds.execute(
+        "INSERT INTO u {id: 2, email: 'a@x', n: 9} ON DUPLICATE KEY UPDATE n = 9;"
+    )
+    assert out[-1]["status"] == "OK"
+    rows = ds.execute("SELECT id, n FROM u ORDER BY id;")[-1]["result"]
+    assert len(rows) == 1 and rows[0]["n"] == 9  # holder updated, no u:2
+    # the half-written u:2 record was rolled back
+    assert ds.execute("SELECT * FROM u:2;")[-1]["result"] == []
+
+
+def test_insert_ignore_unique_conflict_rolls_back(ds):
+    ds.execute(
+        "DEFINE TABLE u SCHEMALESS; DEFINE INDEX ue ON u FIELDS email UNIQUE; "
+        "CREATE u:1 SET email = 'a@x';"
+    )
+    out = ds.execute("INSERT IGNORE INTO u {id: 3, email: 'a@x'};")
+    assert out[-1]["status"] == "OK"
+    rows = ds.execute("SELECT VALUE id FROM u;")[-1]["result"]
+    assert [t.id for t in rows] == [1]
+
+
+def test_upsert_unique_conflict_retries_as_update(ds):
+    ds.execute(
+        "DEFINE TABLE u SCHEMALESS; DEFINE INDEX ue ON u FIELDS email UNIQUE; "
+        "CREATE u:1 SET email = 'a@x', n = 1;"
+    )
+    out = ds.execute("UPSERT u SET email = 'a@x', n = 5;")
+    assert out[-1]["status"] == "OK", out
+    rows = ds.execute("SELECT id, n FROM u;")[-1]["result"]
+    assert len(rows) == 1 and rows[0]["n"] == 5
+
+
+def test_failed_statement_leaves_no_partial_writes(ds):
+    """A unique violation halfway through a multi-row INSERT rolls the
+    whole bare statement back (statement atomicity via txn cancel)."""
+    ds.execute(
+        "DEFINE TABLE u SCHEMALESS; DEFINE INDEX ue ON u FIELDS email UNIQUE;"
+    )
+    out = ds.execute(
+        "INSERT INTO u [{id: 1, email: 'a'}, {id: 2, email: 'a'}, {id: 3, email: 'c'}];"
+    )
+    assert out[-1]["status"] == "ERR"
+    assert ds.execute("SELECT * FROM u;")[-1]["result"] == []
+
+
+def test_upsert_explicit_id_unique_conflict_errors(ds):
+    """UPSERT of a SPECIFIC id must not silently mutate the holder record
+    (review r3 regression)."""
+    ds.execute(
+        "DEFINE TABLE u SCHEMALESS; DEFINE INDEX ue ON u FIELDS email UNIQUE; "
+        "CREATE u:1 SET email = 'a@x';"
+    )
+    out = ds.execute("UPSERT u:2 SET email = 'a@x';")
+    assert out[-1]["status"] == "ERR"
+    assert ds.execute("SELECT * FROM u:2;")[-1]["result"] == []
+
+
+def test_retry_with_return_none(ds):
+    ds.execute(
+        "DEFINE TABLE u SCHEMALESS; DEFINE INDEX ue ON u FIELDS email UNIQUE; "
+        "CREATE u:1 SET email = 'a@x', n = 1;"
+    )
+    out = ds.execute(
+        "INSERT INTO u {email: 'a@x', n: 7} ON DUPLICATE KEY UPDATE n = 7 RETURN NONE;"
+    )
+    assert out[-1]["status"] == "OK", out
+    assert ds.execute("SELECT VALUE n FROM u:1;")[-1]["result"] == [7]
