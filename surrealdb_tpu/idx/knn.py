@@ -67,6 +67,8 @@ class VectorMirror:
         self.matrix = None  # device jnp [cap, D]
         self.mask: Optional[np.ndarray] = None
         self._dev_matrix = None
+        self._dev_mask = None  # sharded mask (mesh placement only)
+        self._mesh = None  # mesh the device arrays are placed over
         self.ivf = None  # IvfState, built on demand
         self._ivf_building = False
         self._ivf_done = threading.Event()  # signals a finished train round
@@ -206,19 +208,22 @@ class VectorMirror:
         with self._lock:
             return int(self.alive[: self.n_slots].sum()) if self.built and self.alive is not None else 0
 
-    def device_view(self):
+    def device_view(self, mesh=None):
         """(jnp matrix [cap, D], host mask [cap]) for the fused kernels.
 
         On accelerator backends the matrix uploads as cnf.TPU_VECTOR_DTYPE
         (bf16 by default: half the host->device transfer, MXU-native
         matmuls; distance accumulation stays f32 via
-        preferred_element_type). CPU keeps f32 exactness."""
+        preferred_element_type). CPU keeps f32 exactness. With a device
+        mesh the matrix is placed row-SHARDED over the 'data' axis (cap is
+        pow2, so it divides across any pow2 device count) and the mask is
+        sharded alongside — the distributed-kNN layout (parallel/mesh.py)."""
         import jax
         import jax.numpy as jnp
 
         with self._lock:
             self._maybe_compact()
-            if self.dirty or self._dev_matrix is None:
+            if self.dirty or self._dev_matrix is None or self._mesh is not mesh:
                 data = self.data
                 if (
                     cnf.TPU_VECTOR_DTYPE == "bfloat16"
@@ -227,20 +232,37 @@ class VectorMirror:
                     import ml_dtypes
 
                     data = data.astype(ml_dtypes.bfloat16)  # host-side cast
-                self._dev_matrix = jnp.asarray(data)
+                if mesh is not None:
+                    from jax.sharding import NamedSharding, PartitionSpec as P
+
+                    axis = mesh.axis_names[0]
+                    self._dev_matrix = jax.device_put(
+                        data, NamedSharding(mesh, P(axis, None))
+                    )
+                    self._dev_mask = jax.device_put(
+                        self.alive, NamedSharding(mesh, P(axis))
+                    )
+                else:
+                    self._dev_matrix = jnp.asarray(data)
+                    self._dev_mask = None
+                self._mesh = mesh
                 self.mask = self.alive.copy()
                 self.dirty = False
             return self._dev_matrix, self.mask
 
-    def device_snapshot(self):
+    def device_snapshot(self, mesh=None):
         """(matrix, mask, rids) captured atomically: `rids` is the list
         OBJECT tied to this matrix's slot numbering. A later compaction
         installs a NEW list (never renumbering this one in place — appends
         only), so resolving kernel slots through this snapshot stays correct
         even if the mirror compacts while the batch is on device."""
         with self._lock:
-            m, mask = self.device_view()
+            m, mask = self.device_view(mesh)
             return m, mask, self.rids
+
+    def device_sharded_mask(self):
+        with self._lock:
+            return self._dev_mask
 
     def host_view(self):
         """(data [n, D], alive [n], rids) — numpy views for small corpora."""
@@ -469,7 +491,36 @@ class KnnPlan(_KnnExecutorMixin):
         # ANN pays off only when k is a small fraction of the corpus; a big-k
         # query gets the exact fused kernel (IVF would cap results at the
         # probed-candidate count)
-        if not cnf.TPU_DISABLE and n >= cnf.TPU_ANN_MIN_ROWS and self.k * 4 <= n:
+        mesh = None if cnf.TPU_DISABLE else ds.mesh()
+        if mesh is not None and n >= cnf.TPU_KNN_ONDEVICE_THRESHOLD:
+            # multi-chip: the mirror shards row-wise over the mesh and the
+            # search runs as per-shard distance+top-k with an O(k*devices)
+            # all-gather (parallel/mesh.py sharded_knn). Exact — the
+            # sharded corpus makes brute force the scalable strategy.
+            self.strategy = "exact-sharded"
+            matrix, _, rids = mirror.device_snapshot(mesh)
+            mask_dev = mirror.device_sharded_mask()
+            key = ("knn-sharded", id(matrix), metric, k)
+
+            def runner(qs):
+                from surrealdb_tpu.parallel.mesh import sharded_knn
+                from surrealdb_tpu.utils.num import pad_tail, tile_slices
+
+                qs_m = np.stack(qs)
+                nq = qs_m.shape[0]
+                tile = min(_pow2(max(nq, 1)), 64)
+                dd = np.empty((nq, k), dtype=np.float32)
+                rr = np.empty((nq, k), dtype=np.int64)
+                for lo, hi in tile_slices(nq, tile):
+                    d, r = sharded_knn(
+                        mesh, matrix, mask_dev, pad_tail(qs_m[lo:hi], tile), k, metric
+                    )
+                    dd[lo:hi] = np.asarray(d)[: hi - lo]
+                    rr[lo:hi] = np.asarray(r)[: hi - lo]
+                return list(zip(dd, rr))
+
+            dists, slots = ds.dispatch.submit(key, q, runner)
+        elif not cnf.TPU_DISABLE and n >= cnf.TPU_ANN_MIN_ROWS and self.k * 4 <= n:
             self.strategy = "ivf"
             # snapshot first: device_view may compact dead slots, which
             # renumbers the slot space and invalidates any trained IVF; the

@@ -110,6 +110,28 @@ class Datastore:
         ex = Executor(self, session, vars or {})
         return ex.compute_expression(expr)
 
+    # ------------------------------------------------------------ mesh
+    _mesh_cache = ("unset", None)
+
+    def mesh(self):
+        """The device mesh for sharded mirrors: a 1-D 'data' mesh over all
+        visible devices when there are 2+, else None (single-chip path).
+        Shared across datastores — the devices are process-global."""
+        kind, m = Datastore._mesh_cache
+        if kind != "unset":
+            return m
+        import jax
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            Datastore._mesh_cache = ("none", None)
+            return None
+        from surrealdb_tpu.parallel.mesh import make_mesh
+
+        m = make_mesh(len(devs))
+        Datastore._mesh_cache = ("mesh", m)
+        return m
+
     # ------------------------------------------------------------ maintenance
     def tick(self) -> int:
         """One maintenance pass (reference kvs/ds.rs tick): changefeed GC.
