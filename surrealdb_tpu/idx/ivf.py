@@ -147,6 +147,8 @@ class IvfState:
         self._n = len(self.slot_list)  # O(1) size, maintained by add/remove
         self.dirty = True
         self._dev = None  # (cents, list_rows, list_mask)
+        self._mut = 0  # bumped on every list mutation; sharded cache keys off it
+        self._sharded_cache = None  # (key, (cents, rows, mask, shard_rows))
 
     @property
     def nlists(self) -> int:
@@ -212,6 +214,7 @@ class IvfState:
         self.slot_list[slot] = a
         self._n += 1
         self.dirty = True
+        self._mut += 1
 
     def remove(self, slot: int, vec=None) -> None:
         a = self.slot_list.pop(slot, None)
@@ -222,6 +225,7 @@ class IvfState:
             except ValueError:
                 pass
         self.dirty = True
+        self._mut += 1
 
     def size(self) -> int:
         return self._n
@@ -293,6 +297,75 @@ class IvfState:
             )
             dd[lo:hi] = np.asarray(d)[: hi - lo]
             rr[lo:hi] = np.asarray(r)[: hi - lo]
+        return dd, rr
+
+
+    # -------------------------------------------------------- mesh search
+    def _device_sharded(self, mesh, n_total: int, axis: str = "data"):
+        """Per-shard inverted-list tables for sharded_ivf_search: bucket each
+        list's slots by owning shard (slot // shard_rows) into a
+        [n_dev, C, L] local-row table placed sharded over the mesh axis —
+        each chip holds only ITS slab, aligned with its corpus rows."""
+        import jax as _jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n_dev = mesh.shape[axis]
+        shard_rows = n_total // n_dev
+        key = (self._mut, id(mesh), n_total)
+        if self._sharded_cache is not None and self._sharded_cache[0] == key:
+            return self._sharded_cache[1]
+        c = self.nlists
+        per: List[List[List[int]]] = [[[] for _ in range(c)] for _ in range(n_dev)]
+        for ci, l in enumerate(self.lists):
+            for s in l:
+                d = min(s // shard_rows, n_dev - 1)
+                per[d][ci].append(s - d * shard_rows)
+        maxlen = max((len(pl) for shard in per for pl in shard), default=1)
+        maxlen = _next_pow2(max(maxlen, 1))
+        rows = np.zeros((n_dev, c, maxlen), dtype=np.int32)
+        mask = np.zeros((n_dev, c, maxlen), dtype=bool)
+        for d in range(n_dev):
+            for ci in range(c):
+                pl = per[d][ci]
+                rows[d, ci, : len(pl)] = pl
+                mask[d, ci, : len(pl)] = True
+        sh = NamedSharding(mesh, P(axis, None, None))
+        dev = (
+            jnp.asarray(self.centroids),
+            _jax.device_put(rows, sh),
+            _jax.device_put(mask, sh),
+            shard_rows,
+        )
+        self._sharded_cache = (key, dev)
+        return dev
+
+    def search_batch_sharded(
+        self, qs: np.ndarray, mesh, matrix, metric: str, k: int, nprobe: int,
+        tile: int = 64,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Batched sharded probe+rerank over a mesh-sharded mirror matrix.
+        Same contract as search_batch; misses surface as +inf/-1."""
+        from surrealdb_tpu.parallel.mesh import sharded_ivf_search
+        from surrealdb_tpu.utils.num import pad_tail, tile_slices
+        import jax.numpy as jnp
+
+        cents, list_rows, list_mask, _ = self._device_sharded(mesh, matrix.shape[0])
+        probe_metric = metric if metric in _PROBE_METRICS else "euclidean"
+        nprobe = min(nprobe, self.nlists)
+        qs = np.asarray(qs, dtype=np.float32)
+        tile = min(_next_pow2(max(qs.shape[0], 1)), tile)
+        dd = np.full((qs.shape[0], k), np.inf, dtype=np.float32)
+        rr = np.full((qs.shape[0], k), -1, dtype=np.int64)
+        for lo, hi in tile_slices(qs.shape[0], tile):
+            d, r = sharded_ivf_search(
+                mesh, cents, list_rows, list_mask, matrix,
+                jnp.asarray(pad_tail(qs[lo:hi], tile)),
+                k, nprobe, metric=metric, probe_metric=probe_metric,
+            )
+            k_out = int(np.asarray(d).shape[1])
+            dd[lo:hi, :k_out] = np.asarray(d)[: hi - lo]
+            rr[lo:hi, :k_out] = np.asarray(r)[: hi - lo]
         return dd, rr
 
 
