@@ -35,6 +35,8 @@ NORMAL_FETCH_SIZE = _env_int("SURREAL_NORMAL_FETCH_SIZE", 500)
 MAX_STREAM_BATCH_SIZE = _env_int("SURREAL_MAX_STREAM_BATCH_SIZE", 1000)
 EXPORT_BATCH_SIZE = _env_int("SURREAL_EXPORT_BATCH_SIZE", 1000)
 INDEXING_BATCH_SIZE = _env_int("SURREAL_INDEXING_BATCH_SIZE", 250)
+# row count past which INSERT INTO t $rows takes the bulk write path
+BULK_INSERT_MIN = _env_int("SURREAL_BULK_INSERT_MIN", 64)
 COUNT_BATCH_SIZE = _env_int("SURREAL_COUNT_BATCH_SIZE", 10_000)
 
 # Result handling

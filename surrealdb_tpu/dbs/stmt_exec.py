@@ -12,6 +12,7 @@ from typing import Any, List, Optional
 
 import uuid as _uuid
 
+from surrealdb_tpu import cnf
 from surrealdb_tpu import key as keys
 from surrealdb_tpu.err import SurrealError, TypeError_
 from surrealdb_tpu.sql.ast import Expr
@@ -177,6 +178,16 @@ def insert_compute(ctx, stm) -> Any:
             into_tb = tv
         else:
             raise TypeError_(f"Cannot INSERT INTO {format_value(tv)}")
+
+    # bulk fast path: big single-shot row batches skip the per-row pipeline
+    # when table state allows (doc/bulk.py); None means fall through
+    if len(rows) >= cnf.BULK_INSERT_MIN:
+        from surrealdb_tpu.doc.bulk import try_bulk_insert
+
+        with _with_timeout(ctx, stm) as c:
+            bulk_out = try_bulk_insert(c, stm, rows, into_tb)
+        if bulk_out is not None:
+            return bulk_out
 
     if stm.relation:
         # the rows themselves carry the data; process_relate must not

@@ -1,0 +1,325 @@
+"""Bulk INSERT fast path.
+
+Role of the reference's batched indexing writes (reference:
+core/src/cnf/mod.rs:44 INDEXING_BATCH_SIZE; doc/insert.rs per-row flow):
+`INSERT INTO t $rows` resolves table state — definitions, field defs,
+indexes, changefeed, reactive hooks — ONCE per statement instead of once per
+row, then applies record + index writes in vectorized batches:
+
+- vector (HNSW/MTREE) indexes convert the whole [B, D] block in one numpy
+  pass instead of per-element coercion loops;
+- full-text (SEARCH) indexes tokenize per document but merge term metadata
+  and statistics across the batch, turning 2 read-modify-writes per (term,
+  doc) into one per distinct term per batch;
+- plain/unique indexes keep per-row writes (they are pure KV ops) with the
+  same IGNORE-on-unique-conflict savepoint semantics as the per-row path.
+
+The fast path only engages when it is semantically identical to the per-row
+document pipeline: no live queries, no events, no ON DUPLICATE KEY UPDATE,
+owner-level permissions, and AFTER/NONE output. Anything else falls back.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from surrealdb_tpu import cnf
+from surrealdb_tpu import key as keys
+from surrealdb_tpu.err import IndexExistsError, RecordExistsError, TypeError_
+from surrealdb_tpu.key.encode import T_THING, enc_value_key
+from surrealdb_tpu.sql.value import Thing, is_nullish
+from surrealdb_tpu.utils.ser import pack
+
+
+def try_bulk_insert(ctx, stm, rows: List[dict], into_tb: Optional[str]):
+    """Bulk-run an INSERT statement; returns the output rows, or None when
+    the statement or any target table needs the per-row pipeline."""
+    from surrealdb_tpu.iam.check import check_data_write, perms_apply
+
+    if len(rows) < cnf.BULK_INSERT_MIN:
+        return None
+    if getattr(stm, "update", None) is not None:
+        return None
+    output = getattr(stm, "output", None)
+    out_kind = "after" if output is None else output.kind
+    if out_kind not in ("after", "none"):
+        return None
+    check_data_write(ctx)
+    if perms_apply(ctx):
+        return None
+
+    relation = bool(getattr(stm, "relation", False))
+    ignore = bool(getattr(stm, "ignore", False))
+
+    # group rows by target table, preserving statement order per table
+    by_tb: Dict[str, List[Tuple[Thing, dict]]] = {}
+    order: List[Tuple[str, int]] = []  # (tb, index within table batch)
+    for row in rows:
+        row = dict(row)
+        rid_v = row.pop("id", None)
+        tb = into_tb or (rid_v.tb if isinstance(rid_v, Thing) else None)
+        if tb is None:
+            raise TypeError_(
+                "INSERT RELATION requires a target table"
+                if relation
+                else "INSERT requires a target table"
+            )
+        if relation:
+            f, w = row.get("in"), row.get("out")
+            if not isinstance(f, Thing) or not isinstance(w, Thing):
+                raise TypeError_("INSERT RELATION requires `in` and `out` record links")
+        rid = _make_rid(tb, rid_v)
+        batch = by_tb.setdefault(tb, [])
+        order.append((tb, len(batch)))
+        batch.append((rid, row))
+
+    txn = ctx.txn()
+    ns, db = ctx.ns_db()
+
+    # eligibility per table — checked BEFORE any mutation so fallback is clean
+    plans = {}
+    for tb in by_tb:
+        if txn.all_tb_lives(ns, db, tb) or txn.all_tb_events(ns, db, tb):
+            return None
+        plans[tb] = _TablePlan(ctx, tb)
+
+    results: Dict[str, List[Any]] = {}
+    for tb, batch in by_tb.items():
+        results[tb] = _insert_table_batch(
+            ctx, plans[tb], batch, relation=relation, ignore=ignore, out_kind=out_kind
+        )
+
+    if out_kind == "none":
+        return []
+    out: List[Any] = []
+    for tb, i in order:
+        v = results[tb][i]
+        if v is not _SKIPPED:
+            out.append(v)
+    return out
+
+
+_SKIPPED = object()  # row dropped by IGNORE
+
+
+class _TablePlan:
+    """Per-table state resolved once per bulk statement."""
+
+    def __init__(self, ctx, tb: str):
+        txn = ctx.txn()
+        ns, db = ctx.ns_db()
+        self.tb = tb
+        self.tb_def = txn.ensure_tb(ns, db, tb)
+        self.fds = txn.all_tb_fields(ns, db, tb)
+        self.schemafull = bool(self.tb_def.get("schemafull"))
+        self.needs_fields = bool(self.fds) or self.schemafull
+        db_def = txn.get_db(ns, db)
+        self.cf = self.tb_def.get("changefeed") or (db_def or {}).get("changefeed")
+        self.cf_original = bool(self.cf and self.cf.get("original"))
+        self.indexes = txn.all_tb_indexes(ns, db, tb)
+        self.thing_pre = keys.thing_prefix(ns, db, tb)
+        self.enforced = bool(self.tb_def.get("enforced"))
+
+
+def _insert_table_batch(ctx, plan: _TablePlan, batch, relation, ignore, out_kind):
+    from surrealdb_tpu.doc import pipeline as doc
+    from surrealdb_tpu.idx.index import (
+        _update_idx,
+        _update_uniq,
+        extract_index_values,
+    )
+
+    txn = ctx.txn()
+    ns, db = ctx.ns_db()
+    tb = plan.tb
+    kv_ix = [ix for ix in plan.indexes if ix["index"]["type"] in ("idx", "uniq")]
+    vec_ix = [ix for ix in plan.indexes if ix["index"]["type"] in ("mtree", "hnsw")]
+    ft_ix = [ix for ix in plan.indexes if ix["index"]["type"] == "search"]
+    vec_batch: Dict[str, List[Tuple[Thing, Any]]] = {ix["name"]: [] for ix in vec_ix}
+    ft_batch: Dict[str, List[Tuple[Thing, Any]]] = {ix["name"]: [] for ix in ft_ix}
+    edge_writer = _EdgeWriter(ctx, tb) if relation else None
+
+    out: List[Any] = []
+    for rid, row in batch:
+        kb = plan.thing_pre + enc_value_key(rid.id)
+        if txn.get(kb) is not None:
+            if ignore:
+                out.append(_SKIPPED)
+                continue
+            raise RecordExistsError(rid)
+        current = dict(row)
+        current["id"] = rid
+        if relation:
+            f, w = current["in"], current["out"]
+            if plan.enforced:
+                for t in (f, w):
+                    if not txn.record_exists(ns, db, t.tb, t.id):
+                        from surrealdb_tpu.err import SurrealError
+
+                        raise SurrealError(
+                            f"Cannot create a relation to a non-existent record `{t}`"
+                        )
+        if plan.needs_fields:
+            current = doc.process_field_defs(ctx, rid, current, {}, is_create=True)
+            current["id"] = rid
+
+        sp = txn.savepoint() if (kv_ix and ignore) else None
+        txn.set(kb, pack(current))
+        if relation:
+            edge_writer.write(rid, current["in"], current["out"])
+        try:
+            for ix in kv_ix:
+                vals = extract_index_values(ctx, ix, current)
+                if ix["index"]["type"] == "idx":
+                    _update_idx(ctx, ix, rid, None, vals)
+                else:
+                    _update_uniq(ctx, ix, rid, None, vals)
+        except IndexExistsError:
+            if sp is not None:
+                txn.rollback_to(sp)
+                out.append(_SKIPPED)
+                continue
+            raise
+        for ix in vec_ix:
+            vec_batch[ix["name"]].append((rid, extract_index_values(ctx, ix, current)))
+        for ix in ft_ix:
+            ft_batch[ix["name"]].append((rid, extract_index_values(ctx, ix, current)))
+        if plan.cf:
+            mut: Dict[str, Any] = {"id": rid, "update": current}
+            if plan.cf_original:
+                mut["original"] = None
+            txn.buffer_change(ns, db, tb, mut)
+        out.append(current if out_kind == "after" else _SKIPPED)
+
+    for ix in vec_ix:
+        _bulk_vector_index(ctx, ix, vec_batch[ix["name"]])
+    for ix in ft_ix:
+        _bulk_ft_index(ctx, ix, ft_batch[ix["name"]])
+    return out
+
+
+def _make_rid(tb: str, rid_v) -> Thing:
+    if isinstance(rid_v, Thing):
+        return rid_v if rid_v.tb == tb else Thing(tb, rid_v.id)
+    if rid_v is None or is_nullish(rid_v):
+        return Thing(tb)
+    return Thing(tb, rid_v)
+
+
+class _EdgeWriter:
+    """Batch writer for RELATE graph pointers (same 4 keys + 4 mirror deltas
+    as doc.pipeline.store_edges, reference core/src/doc/edges.rs:16-75) with
+    per-batch memoized encodings: endpoint Things repeat heavily in edge
+    batches (N nodes, E >> N references), so their order-preserving key
+    encodings are computed once each instead of once per pointer."""
+
+    def __init__(self, ctx, edge_tb: str):
+        self.txn = ctx.txn()
+        self.ns, self.db = ctx.ns_db()
+        self.edge_tb = edge_tb
+        self._gp: Dict[str, bytes] = {}  # tb -> graph keyspace prefix
+        self._tbe: Dict[str, bytes] = {}  # tb -> enc_str(tb)
+        self._things: Dict[Tuple[str, Any], Tuple[bytes, bytes]] = {}
+        self._edge_tb_enc = self._tb_enc(edge_tb)
+
+    def _prefix(self, tb: str) -> bytes:
+        p = self._gp.get(tb)
+        if p is None:
+            p = self._gp[tb] = keys.graph_prefix(self.ns, self.db, tb)
+        return p
+
+    def _tb_enc(self, tb: str) -> bytes:
+        e = self._tbe.get(tb)
+        if e is None:
+            from surrealdb_tpu.key.encode import enc_str
+
+            e = self._tbe[tb] = enc_str(tb)
+        return e
+
+    def _enc(self, t: Thing) -> Tuple[bytes, bytes]:
+        """(enc_value_key(t.id), enc_value_key(t)) — memoized per endpoint."""
+        try:
+            k = (t.tb, t.id)
+            hit = self._things.get(k)
+        except TypeError:  # unhashable id (array/object) — encode directly
+            ide = enc_value_key(t.id)
+            return ide, bytes([T_THING]) + self._tb_enc(t.tb) + ide
+        if hit is None:
+            ide = enc_value_key(t.id)
+            hit = self._things[k] = (ide, bytes([T_THING]) + self._tb_enc(t.tb) + ide)
+        return hit
+
+    def write(self, edge: Thing, f: Thing, w: Thing) -> None:
+        txn = self.txn
+        eid_enc, edge_enc = self._enc(edge)
+        fid_enc, f_enc = self._enc(f)
+        wid_enc, w_enc = self._enc(w)
+        etb = self.edge_tb
+        etb_enc = self._edge_tb_enc
+        epre = self._prefix(etb)
+        txn.set(self._prefix(f.tb) + fid_enc + keys.DIR_OUT + etb_enc + edge_enc, b"")
+        txn.set(epre + eid_enc + keys.DIR_IN + self._tb_enc(f.tb) + f_enc, b"")
+        txn.set(epre + eid_enc + keys.DIR_OUT + self._tb_enc(w.tb) + w_enc, b"")
+        txn.set(self._prefix(w.tb) + wid_enc + keys.DIR_IN + etb_enc + edge_enc, b"")
+        ns, db = self.ns, self.db
+        txn.graph_delta(ns, db, f.tb, keys.DIR_OUT, etb, f, edge, True)
+        txn.graph_delta(ns, db, etb, keys.DIR_IN, f.tb, edge, f, True)
+        txn.graph_delta(ns, db, etb, keys.DIR_OUT, w.tb, edge, w, True)
+        txn.graph_delta(ns, db, w.tb, keys.DIR_IN, etb, w, edge, True)
+
+
+# ------------------------------------------------------------------ vector
+def _bulk_vector_index(ctx, ix: dict, batch: List[Tuple[Thing, Any]]) -> None:
+    """Block-convert a batch of vectors and write index rows + mirror deltas.
+    One numpy pass validates/coerces the whole [B, D] block; ragged or
+    non-numeric batches fall back to per-row validation for precise errors
+    (same checks as idx/vector_index.check_vector)."""
+    from surrealdb_tpu.idx.vector_index import _ROW, check_vector, pack_vector
+
+    if not batch:
+        return
+    txn = ctx.txn()
+    ns, db = ctx.ns_db()
+    tb, name = ix["table"], ix["name"]
+    spre = keys.index_state(ns, db, tb, name, _ROW)
+    dim = ix["index"].get("dimension", 0)
+
+    items = [(rid, vals[0]) for rid, vals in batch if vals and not is_nullish(vals[0])]
+    if not items:
+        return
+    vecs: Optional[np.ndarray] = None
+    try:
+        block = np.asarray([v for _, v in items])
+        if (
+            block.ndim == 2
+            and block.dtype.kind in ("i", "u", "f")
+            and (not dim or block.shape[1] == dim)
+        ):
+            vecs = block.astype(np.float32)
+    except (TypeError, ValueError):
+        vecs = None
+    if vecs is None:
+        vecs = np.empty((len(items), dim or len(items[0][1])), dtype=np.float32)
+        for i, (rid, v) in enumerate(items):
+            arr = check_vector(ix, v)
+            if arr is None or arr.shape[0] != vecs.shape[1]:
+                raise TypeError_(
+                    f"Incorrect vector dimension ({0 if arr is None else arr.shape[0]})."
+                    f" Expected a vector of {vecs.shape[1]} dimension."
+                )
+            vecs[i] = arr
+
+    for (rid, _), vec in zip(items, vecs):
+        txn.set(spre + enc_value_key(rid), pack_vector(vec))
+        txn.vector_delta(ns, db, tb, name, rid, vec)
+
+
+# ------------------------------------------------------------------ full-text
+def _bulk_ft_index(ctx, ix: dict, batch: List[Tuple[Thing, Any]]) -> None:
+    from surrealdb_tpu.idx.ft_index import FtIndex
+
+    if not batch:
+        return
+    FtIndex.for_index(ctx, ix).index_documents_bulk(ctx, batch)

@@ -18,6 +18,7 @@ Keyspace (under the index's state prefix `+{ix}!m`):
 
 from __future__ import annotations
 
+import struct
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -28,6 +29,22 @@ from surrealdb_tpu.sql.value import Thing, is_nullish
 from surrealdb_tpu.utils.ser import pack, unpack
 
 from .ft_analyzer import Analyzer, analyzer_for
+
+
+def pack_posting(tf: int, offs=None) -> bytes:
+    """Posting codec: without highlight offsets a posting is a bare 4-byte
+    LE term frequency (the hot bulk-ingest write); with offsets it is the
+    msgpack dict the highlighter consumes. Offset-less msgpack postings are
+    never 4 bytes, so the decoder keys off length."""
+    if offs is None:
+        return struct.pack("<I", tf)
+    return pack({"tf": tf, "os": offs})
+
+
+def unpack_posting(raw: bytes) -> dict:
+    if len(raw) == 4:
+        return {"tf": struct.unpack("<I", raw)[0]}
+    return unpack(raw)
 
 
 def _tf(tokens) -> Dict[str, Tuple[int, List[List[int]]]]:
@@ -136,12 +153,9 @@ class FtIndex:
                     st["nt"] += 1
                 meta["df"] += 1
                 self._put_term(ctx, term, meta)
-                posting: Dict[str, Any] = {"tf": count}
-                if self.highlights:
-                    posting["os"] = offs
                 txn.set(
                     self._k(ctx, b"p" + enc_u64(meta["id"]) + enc_u64(did)),
-                    pack(posting),
+                    pack_posting(count, offs if self.highlights else None),
                 )
             length = len(new_tokens)
             txn.set(self._k(ctx, b"l" + enc_u64(did)), pack(length))
@@ -165,6 +179,54 @@ class FtIndex:
             {t: c for t, (c, _) in tfs.items()} if tfs is not None else None,
             len(new_tokens) if new_tokens is not None else 0,
         )
+
+    def index_documents_bulk(self, ctx, batch) -> None:
+        """Index a batch of NEW documents (no prior posting sets — the bulk
+        insert path verified the records did not exist). Statistics and term
+        metadata are merged in memory across the batch and written once per
+        distinct term / once per batch, instead of the per-(term, doc)
+        read-modify-write the single-document path pays."""
+        st = self._stats(ctx)
+        txn = ctx.txn()
+        az = self.analyzer(ctx)
+        ns, db = ctx.ns_db()
+        term_cache: Dict[str, Optional[dict]] = {}
+        touched: set = set()
+        base = self._k(ctx, b"")
+
+        for rid, vals in batch:
+            tokens = self._tokens_of(az, vals)
+            if tokens is None:
+                continue
+            did = self._doc_id(ctx, rid, st, create=True)
+            tfs = _tf(tokens)
+            for term, (count, offs) in tfs.items():
+                meta = term_cache.get(term)
+                if meta is None and term not in term_cache:
+                    meta = self._term(ctx, term)
+                    term_cache[term] = meta
+                if meta is None:
+                    meta = {"id": st["nt"], "df": 0}
+                    st["nt"] += 1
+                    term_cache[term] = meta
+                meta["df"] += 1
+                touched.add(term)
+                txn.set(
+                    base + b"p" + enc_u64(meta["id"]) + enc_u64(did),
+                    pack_posting(count, offs if self.highlights else None),
+                )
+            length = len(tokens)
+            txn.set(self._k(ctx, b"l" + enc_u64(did)), pack(length))
+            st["tl"] += length
+            st["dc"] += 1
+            txn.ft_delta(
+                ns, db, self.tb, self.name, rid, None,
+                {t: c for t, (c, _) in tfs.items()}, length,
+            )
+
+        for term in touched:
+            self._put_term(ctx, term, term_cache[term])
+        self._put_stats(ctx, st)
 
     def _tokens_of(self, az: Analyzer, vals) -> Optional[list]:
         if vals is None:
@@ -205,7 +267,7 @@ class FtIndex:
             found: Dict[int, dict] = {}
             for k, raw in txn.scan(pre, prefix_end(pre)):
                 did, _ = dec_u64(k, len(pre))
-                found[did] = unpack(raw)
+                found[did] = unpack_posting(raw)
             if candidate is None:
                 candidate = {did: [p["tf"]] for did, p in found.items()}
             else:
@@ -270,7 +332,7 @@ class FtIndex:
                 continue
             p = txn.get(self._k(ctx, b"p" + enc_u64(meta["id"]) + enc_u64(did)))
             if p is not None:
-                offs.extend((s, e) for s, e in unpack(p).get("os", []))
+                offs.extend((s, e) for s, e in unpack_posting(p).get("os", []))
         return sorted(set(offs))
 
 

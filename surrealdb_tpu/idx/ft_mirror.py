@@ -25,6 +25,7 @@ from surrealdb_tpu import key as keys
 from surrealdb_tpu.key.encode import dec_u64, enc_u64, prefix_end
 from surrealdb_tpu.sql.value import Thing
 from surrealdb_tpu.utils.ser import unpack
+from surrealdb_tpu.idx.ft_index import unpack_posting
 
 
 def _rid_key(rid) -> tuple:
@@ -95,7 +96,7 @@ class FtMirror:
                         did, _ = dec_u64(k, off)
                         local = kv_tid_local.get(tid)
                         if local is not None:
-                            postings[local][did] = unpack(v)["tf"]
+                            postings[local][did] = unpack_posting(v)["tf"]
                 # doc lengths: l{did}
                 doc_len: Dict[int, int] = {}
                 pre = base + b"l"

@@ -35,6 +35,7 @@ Record ids / field values use the order-preserving value encoding in
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Any, List, Tuple
 
 from .encode import (
@@ -84,6 +85,7 @@ def namespace_prefix() -> bytes:
 
 
 # ------------------------------------------------------------------- ns level
+@lru_cache(maxsize=4096)
 def _ns(ns: str) -> bytes:
     return b"/*" + enc_str(ns)
 
@@ -113,6 +115,7 @@ def ns_access_prefix(ns: str) -> bytes:
 
 
 # ------------------------------------------------------------------- db level
+@lru_cache(maxsize=4096)
 def _db(ns: str, db: str) -> bytes:
     return _ns(ns) + b"*" + enc_str(db)
 
@@ -206,6 +209,7 @@ def decode_change(key: bytes, ns: str, db: str) -> bytes:
 
 
 # ------------------------------------------------------------------- tb level
+@lru_cache(maxsize=8192)
 def _tb(ns: str, db: str, tb: str) -> bytes:
     return _db(ns, db) + b"*" + enc_str(tb)
 

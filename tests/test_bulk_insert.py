@@ -1,0 +1,223 @@
+"""Bulk INSERT fast path (doc/bulk.py) parity with the per-row pipeline.
+
+Every test runs the same statement twice — once with BULK_INSERT_MIN forced
+above the batch size (per-row path) and once below (bulk path) — and asserts
+identical observable results (reference semantics: core/src/doc/insert.rs).
+"""
+
+import numpy as np
+import pytest
+
+from surrealdb_tpu import cnf
+from surrealdb_tpu.kvs.ds import Datastore
+from surrealdb_tpu.sql.value import Thing
+
+
+def _pair(monkeypatch):
+    """(bulk_ds, perrow_ds) factories under forced thresholds."""
+    return Datastore("memory"), Datastore("memory")
+
+
+def _run_both(monkeypatch, fn):
+    outs = []
+    for nmin in (1_000_000, 1):  # per-row first, then bulk
+        monkeypatch.setattr(cnf, "BULK_INSERT_MIN", max(nmin, 1))
+        outs.append(fn(Datastore("memory")))
+    assert outs[0] == outs[1]
+    return outs[1]
+
+
+def test_bulk_plain_rows_match(monkeypatch):
+    def go(ds):
+        out = ds.execute(
+            "INSERT INTO t $rows;",
+            vars={"rows": [{"id": i, "n": i * 2} for i in range(100)]},
+        )
+        assert out[-1]["status"] == "OK"
+        rows = ds.execute("SELECT VALUE n FROM t ORDER BY n;")[-1]["result"]
+        return rows
+
+    assert _run_both(monkeypatch, go) == [i * 2 for i in range(100)]
+
+
+def test_bulk_ignore_duplicates(monkeypatch):
+    def go(ds):
+        ds.execute("CREATE t:5 SET n = 'orig';")
+        out = ds.execute(
+            "INSERT IGNORE INTO t $rows;",
+            vars={"rows": [{"id": i, "n": i} for i in range(100)]},
+        )
+        assert out[-1]["status"] == "OK"
+        # the pre-existing record is untouched; output excludes it
+        kept = ds.execute("SELECT VALUE n FROM t:5;")[-1]["result"]
+        return (len(out[-1]["result"]), kept)
+
+    assert _run_both(monkeypatch, go) == (99, ["orig"])
+
+
+def test_bulk_duplicate_errors_without_ignore(monkeypatch):
+    def go(ds):
+        ds.execute("CREATE t:5;")
+        out = ds.execute(
+            "INSERT INTO t $rows;",
+            vars={"rows": [{"id": i} for i in range(100)]},
+        )
+        return out[-1]["status"]
+
+    assert _run_both(monkeypatch, go) == "ERR"
+
+
+def test_bulk_unique_index_conflict_ignore(monkeypatch):
+    def go(ds):
+        ds.execute("DEFINE INDEX u ON t FIELDS email UNIQUE;")
+        rows = [{"id": i, "email": f"e{i % 60}"} for i in range(100)]
+        out = ds.execute("INSERT IGNORE INTO t $rows;", vars={"rows": rows})
+        assert out[-1]["status"] == "OK", out[-1]
+        n = ds.execute("SELECT count() FROM t GROUP ALL;")[-1]["result"][0]["count"]
+        return n
+
+    assert _run_both(monkeypatch, go) == 60
+
+
+def test_bulk_field_defaults_apply(monkeypatch):
+    def go(ds):
+        ds.execute("DEFINE FIELD status ON t DEFAULT 'new'; DEFINE FIELD n ON t TYPE int;")
+        out = ds.execute(
+            "INSERT INTO t $rows;", vars={"rows": [{"id": i, "n": i} for i in range(80)]}
+        )
+        assert out[-1]["status"] == "OK"
+        return ds.execute("SELECT VALUE status FROM t:3;")[-1]["result"]
+
+    assert _run_both(monkeypatch, go) == ["new"]
+
+
+def test_bulk_vector_index_queries(monkeypatch):
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((128, 8)).astype(np.float32)
+
+    def go(ds):
+        ds.execute("DEFINE INDEX v ON item FIELDS emb HNSW DIMENSION 8;")
+        ds.execute(
+            "INSERT INTO item $rows;",
+            vars={"rows": [{"id": i, "emb": x[i].tolist()} for i in range(128)]},
+        )
+        out = ds.execute(
+            "SELECT VALUE id FROM item WHERE emb <|1|> $q;", vars={"q": x[17].tolist()}
+        )
+        return [t.id for t in out[-1]["result"]]
+
+    assert _run_both(monkeypatch, go) == [17]
+
+
+def test_bulk_vector_dimension_error(monkeypatch):
+    def go(ds):
+        ds.execute("DEFINE INDEX v ON item FIELDS emb HNSW DIMENSION 8;")
+        rows = [{"id": i, "emb": [0.0] * 8} for i in range(64)]
+        rows[40]["emb"] = [0.0] * 5  # wrong dimension mid-batch
+        out = ds.execute("INSERT INTO item $rows;", vars={"rows": rows})
+        return out[-1]["status"]
+
+    assert _run_both(monkeypatch, go) == "ERR"
+
+
+def test_bulk_ft_index_matches(monkeypatch):
+    def go(ds):
+        ds.execute(
+            "DEFINE ANALYZER a TOKENIZERS blank FILTERS lowercase;"
+            "DEFINE INDEX ft ON doc FIELDS body SEARCH ANALYZER a BM25;"
+        )
+        rows = [
+            {"id": i, "body": f"word{i % 7} common tail"} for i in range(70)
+        ]
+        ds.execute("INSERT INTO doc $rows;", vars={"rows": rows})
+        n = ds.execute("SELECT count() FROM doc WHERE body @@ 'word3' GROUP ALL;")[-1][
+            "result"
+        ][0]["count"]
+        m = ds.execute("SELECT count() FROM doc WHERE body @@ 'common' GROUP ALL;")[-1][
+            "result"
+        ][0]["count"]
+        return (n, m)
+
+    assert _run_both(monkeypatch, go) == (10, 70)
+
+
+def test_bulk_ft_then_single_updates_compose(monkeypatch):
+    """Bulk-built postings must merge correctly with later per-row updates."""
+    monkeypatch.setattr(cnf, "BULK_INSERT_MIN", 1)
+    ds = Datastore("memory")
+    ds.execute(
+        "DEFINE ANALYZER a TOKENIZERS blank FILTERS lowercase;"
+        "DEFINE INDEX ft ON doc FIELDS body SEARCH ANALYZER a BM25;"
+    )
+    ds.execute(
+        "INSERT INTO doc $rows;",
+        vars={"rows": [{"id": i, "body": "alpha beta"} for i in range(64)]},
+    )
+    ds.execute("UPDATE doc:3 SET body = 'gamma';")
+    ds.execute("CREATE doc:999 SET body = 'alpha';")
+    n_alpha = ds.execute("SELECT count() FROM doc WHERE body @@ 'alpha' GROUP ALL;")[-1][
+        "result"
+    ][0]["count"]
+    n_gamma = ds.execute("SELECT count() FROM doc WHERE body @@ 'gamma' GROUP ALL;")[-1][
+        "result"
+    ][0]["count"]
+    assert (n_alpha, n_gamma) == (64, 1)
+
+
+def test_bulk_relation_traversal(monkeypatch):
+    def go(ds):
+        ds.execute(
+            "INSERT INTO p $rows;", vars={"rows": [{"id": i} for i in range(100)]}
+        )
+        rows = [{"in": Thing("p", i), "out": Thing("p", (i + 1) % 100)} for i in range(100)]
+        ds.execute("INSERT RELATION INTO knows $rows;", vars={"rows": rows})
+        hop2 = ds.execute("SELECT VALUE ->knows->p->knows->p FROM p:7;")[-1]["result"][0]
+        return [t.id for t in hop2]
+
+    assert _run_both(monkeypatch, go) == [9]
+
+
+def test_bulk_falls_back_with_live_queries(monkeypatch):
+    """A registered live query forces the per-row path (notifications must
+    fire per record)."""
+    monkeypatch.setattr(cnf, "BULK_INSERT_MIN", 1)
+    ds = Datastore("memory")
+    ds.enable_notifications()
+    from surrealdb_tpu.dbs.session import Session
+
+    s = Session.owner()
+    s.rt = True
+    out = ds.execute("LIVE SELECT * FROM t;", s)
+    assert out[-1]["status"] == "OK"
+    ds.execute(
+        "INSERT INTO t $rows;", vars={"rows": [{"id": i} for i in range(70)]}
+    )
+    # notifications were delivered for bulk-sized inserts too
+    lq = str(out[-1]["result"])
+    notes = ds.notifications.drain(lq) if hasattr(ds.notifications, "drain") else None
+    n = ds.execute("SELECT count() FROM t GROUP ALL;")[-1]["result"][0]["count"]
+    assert n == 70
+
+
+def test_bulk_changefeed_rows_recorded(monkeypatch):
+    def go(ds):
+        ds.execute("DEFINE TABLE t CHANGEFEED 1h;")
+        ds.execute(
+            "INSERT INTO t $rows;", vars={"rows": [{"id": i} for i in range(70)]}
+        )
+        ch = ds.execute("SHOW CHANGES FOR TABLE t SINCE 0;")[-1]["result"]
+        n = sum(len(c.get("changes", [])) for c in ch)
+        return n
+
+    assert _run_both(monkeypatch, go) == 70
+
+
+def test_bulk_output_none(monkeypatch):
+    def go(ds):
+        out = ds.execute(
+            "INSERT INTO t $rows RETURN NONE;",
+            vars={"rows": [{"id": i} for i in range(70)]},
+        )
+        return out[-1]["result"]
+
+    assert _run_both(monkeypatch, go) == []
