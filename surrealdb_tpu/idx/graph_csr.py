@@ -143,13 +143,17 @@ _JITTED: dict = {}
 
 
 def _kernels():
-    """Lazily build the jitted hop kernels (keeps jax off the import path)."""
+    """Lazily build the jitted hop kernels (keeps jax off the import path).
+
+    The whole remaining chain compiles into ONE jitted call — on a tunneled
+    or queued device each dispatch costs ~100ms RTT, so per-hop kernels made
+    a 3-hop query ~7 round trips (BENCH_r03 p50 816ms); fused it is one.
+    """
     if _JITTED:
-        return _JITTED["hop"], _JITTED["accum"]
+        return _JITTED["chain"]
     import jax
     import jax.numpy as jnp
 
-    @partial(jax.jit, static_argnames=("md",))
     def gather_hop(ptr, idx, frontier, weights, md):
         # one weighted CSR gather: frontier [F] ints with multiplicities →
         # neighbor slots [F*md] + per-slot weight (0 = padding). Carrying a
@@ -167,7 +171,6 @@ def _kernels():
         w = jnp.where(valid, weights[:, None], 0)
         return idx[take].reshape(-1), w.reshape(-1)
 
-    @partial(jax.jit, static_argnames=("n_nodes", "out_size"))
     def accum_cap(nodes, w, n_nodes, out_size):
         # dense scatter-add dedup: per-node path counts survive the frontier
         # compaction (capped, jit-static output size)
@@ -177,9 +180,31 @@ def _kernels():
         present = jnp.nonzero(dense > 0, size=out_size, fill_value=n_nodes)[0]
         return present, jnp.where(present < n_nodes, dense[present], 0)
 
-    _JITTED["hop"] = gather_hop
-    _JITTED["accum"] = accum_cap
-    return gather_hop, accum_cap
+    @partial(
+        jax.jit, static_argnames=("mds", "n_cap", "out_sizes", "count_only")
+    )
+    def chain_kernel(hops, frontier, weights, mds, n_cap, out_sizes, count_only):
+        """Full multi-hop chain in one dispatch. hops: tuple (one per hop) of
+        tuples of (indptr, indices) device arrays (one per contributing
+        mirror); mds/out_sizes: matching static pow2 paddings. count_only
+        skips the final compaction and returns the scalar path count."""
+        frj, cwj = frontier, weights
+        last = len(hops) - 1
+        for h, mirrors in enumerate(hops):
+            pieces, ws = [], []
+            for (ptr, idx), md in zip(mirrors, mds[h]):
+                nodes, w = gather_hop(ptr, idx, frj, cwj, md)
+                pieces.append(nodes)
+                ws.append(w)
+            allnodes = jnp.concatenate(pieces) if len(pieces) > 1 else pieces[0]
+            allw = jnp.concatenate(ws) if len(ws) > 1 else ws[0]
+            if h == last and count_only:
+                return allw.sum()
+            frj, cwj = accum_cap(allnodes, allw, n_cap, out_sizes[h])
+        return frj, cwj
+
+    _JITTED["chain"] = chain_kernel
+    return chain_kernel
 
 
 class GraphMirrors:
@@ -342,15 +367,18 @@ class GraphMirrors:
         nodes = np.fromiter(sorted(out), dtype=np.int32, count=len(out))
         return nodes, np.array([out[int(n)] for n in nodes], dtype=np.int32)
 
-    def _device_chain(self, ns, db, frontier: np.ndarray, counts: np.ndarray, specs):
-        """Run the remaining hops entirely on device: one upload, H weighted
-        gathers with on-device scatter-add dedup between hops, one download
-        at the end. Every static dimension (frontier size, max degree, node
-        capacity, dedup output) is pow2-rounded so steady writes don't
-        recompile."""
+    def _device_chain(
+        self, ns, db, frontier: np.ndarray, counts: np.ndarray, specs,
+        count_only: bool = False,
+    ):
+        """Run the remaining hops entirely on device in ONE fused dispatch:
+        one upload, H weighted gathers with on-device scatter-add dedup
+        between hops, one download at the end (a scalar when count_only).
+        Every static dimension (frontier size, max degree, node capacity,
+        dedup output) is pow2-rounded so steady writes don't recompile."""
         import jax.numpy as jnp
 
-        gather_hop, accum_cap = _kernels()
+        chain_kernel = _kernels()
         it = self.interner(ns, db)
         n_cap = _next_pow2(len(it))
         fsz = _next_pow2(frontier.size)
@@ -358,31 +386,42 @@ class GraphMirrors:
         fr[: frontier.size] = frontier
         cw = np.zeros(fsz, dtype=np.int32)
         cw[: counts.size] = counts
-        frj = jnp.asarray(fr)
-        cwj = jnp.asarray(cw)
+
+        hops, mds, out_sizes = [], [], []
+        width = fsz
         for spec in specs:
-            pieces, ws = [], []
-            for m in self._hop_mirrors(ns, db, spec):
-                ptr, idx = m.device_arrays()
-                md = _next_pow2(max(m.max_degree, 1))
-                nodes, w = gather_hop(ptr, idx, frj, cwj, md=md)
-                pieces.append(nodes)
-                ws.append(w)
-            if not pieces:
+            mirrors = self._hop_mirrors(ns, db, spec)
+            if not mirrors:
+                if count_only:
+                    return 0
                 e = np.empty(0, dtype=np.int32)
                 return e, e
-            allnodes = jnp.concatenate(pieces) if len(pieces) > 1 else pieces[0]
-            allw = jnp.concatenate(ws) if len(ws) > 1 else ws[0]
-            out_size = _next_pow2(min(int(allnodes.shape[0]), n_cap))
-            frj, cwj = accum_cap(allnodes, allw, n_nodes=n_cap, out_size=out_size)
-        u = np.asarray(frj)
-        c = np.asarray(cwj)
+            hop_arrs, hop_mds, total = [], [], 0
+            for m in mirrors:
+                hop_arrs.append(m.device_arrays())
+                md = _next_pow2(max(m.max_degree, 1))
+                hop_mds.append(md)
+                total += width * md
+            hops.append(tuple(hop_arrs))
+            mds.append(tuple(hop_mds))
+            width = _next_pow2(min(total, n_cap))
+            out_sizes.append(width)
+        out = chain_kernel(
+            tuple(hops), jnp.asarray(fr), jnp.asarray(cw),
+            mds=tuple(mds), n_cap=n_cap, out_sizes=tuple(out_sizes),
+            count_only=count_only,
+        )
+        if count_only:
+            return int(out)
+        u = np.asarray(out[0])
+        c = np.asarray(out[1])
         keep = c > 0
         return u[keep].astype(np.int32), c[keep].astype(np.int32)
 
-    def _chain_frontier(self, ctx, start: List[Thing], parts: List):
+    def _chain_frontier(self, ctx, start: List[Thing], parts: List, count_only: bool = False):
         """Shared frontier machinery for chain()/chain_count(): returns
-        (frontier int32[], counts int32[], interner)."""
+        (frontier int32[], counts int32[], interner) — or the scalar path
+        count when count_only (the device chain then downloads one int)."""
         from surrealdb_tpu import cnf
 
         ns, db = ctx.ns_db()
@@ -410,10 +449,17 @@ class GraphMirrors:
                 not cnf.TPU_DISABLE
                 and frontier.size >= cnf.TPU_GRAPH_ONDEVICE_THRESHOLD
             ):
-                frontier, counts = self._device_chain(ns, db, frontier, counts, specs[i:])
+                res = self._device_chain(
+                    ns, db, frontier, counts, specs[i:], count_only=count_only
+                )
+                if count_only:
+                    return res
+                frontier, counts = res
                 break
             frontier, counts = self._host_hop(ns, db, frontier, counts, specs[i])
             i += 1
+        if count_only:
+            return int(counts.sum())
         return frontier, counts, it
 
     def chain(
@@ -445,6 +491,6 @@ class GraphMirrors:
         """Path count of a chain WITHOUT materializing the expanded result —
         `count(->a->b->c)` sums the frontier's path counts directly (on a
         3-hop over 1M edges the Python expansion would dominate the whole
-        query; the device already holds the counts)."""
-        _, counts, _ = self._chain_frontier(ctx, start, parts)
-        return int(counts.sum())
+        query; the device already holds the counts, and the fused chain
+        kernel downloads a single scalar)."""
+        return self._chain_frontier(ctx, start, parts, count_only=True)
