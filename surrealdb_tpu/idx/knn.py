@@ -372,24 +372,37 @@ class VectorMirror:
 
 
 
-def _exact_device_batch(qs: np.ndarray, matrix, mask, metric: str, k: int):
-    """Fused exact distance+top-k over a [Q, D] query batch, Q padded to a
-    pow2 tile (≤64) so coalesced batches of any size reuse one compiled
-    kernel shape instead of recompiling per Q."""
+def _exact_device_launch(qs: np.ndarray, matrix, mask, metric: str, k: int):
+    """Async fused exact distance+top-k over a [Q, D] query batch, Q padded
+    to a pow2 tile (≤64) so coalesced batches of any size reuse one compiled
+    kernel shape. Returns a collect() closure (two-phase dispatch)."""
     import jax.numpy as jnp
 
+    from surrealdb_tpu.idx.ivf import _start_host_copy
     from surrealdb_tpu.utils.num import pad_tail, tile_slices
 
     nq = qs.shape[0]
     tile = min(_pow2(max(nq, 1)), 64)
     mj = jnp.asarray(mask)
-    dd = np.empty((nq, k), dtype=np.float32)
-    rr = np.empty((nq, k), dtype=np.int64)
+    pending = []
     for lo, hi in tile_slices(nq, tile):
         d, r = D.knn_search(pad_tail(qs[lo:hi], tile), matrix, mj, metric, k)
-        dd[lo:hi] = np.asarray(d)[: hi - lo]
-        rr[lo:hi] = np.asarray(r)[: hi - lo]
-    return dd, rr
+        _start_host_copy(d, r)
+        pending.append((lo, hi, d, r))
+
+    def collect():
+        dd = np.empty((nq, k), dtype=np.float32)
+        rr = np.empty((nq, k), dtype=np.int64)
+        for lo, hi, d, r in pending:
+            dd[lo:hi] = np.asarray(d)[: hi - lo]
+            rr[lo:hi] = np.asarray(r)[: hi - lo]
+        return dd, rr
+
+    return collect
+
+
+def _exact_device_batch(qs: np.ndarray, matrix, mask, metric: str, k: int):
+    return _exact_device_launch(qs, matrix, mask, metric, k)()
 
 
 class _KnnResult:
@@ -514,10 +527,15 @@ class KnnPlan(_KnnExecutorMixin):
                 key = ("knn-ivf-sharded", id(matrix), id(ivf), metric, k, nprobe)
 
                 def runner(qs):
-                    dd, rr = ivf.search_batch_sharded(
-                        np.stack(qs), mesh, matrix, metric, k, nprobe
-                    )
-                    return list(zip(dd, rr))
+                    qm = np.stack(qs)
+
+                    def collect():
+                        dd, rr = ivf.search_batch_sharded(
+                            qm, mesh, matrix, metric, k, nprobe
+                        )
+                        return list(zip(dd, rr))
+
+                    return collect
 
                 dists, slots = ds.dispatch.submit(key, q, runner)
             else:
@@ -558,8 +576,13 @@ class KnnPlan(_KnnExecutorMixin):
                 key = ("knn-exact", id(matrix), metric, k)
 
                 def runner(qs):
-                    dd, rr = _exact_device_batch(np.stack(qs), matrix, mask, metric, k)
-                    return list(zip(dd, rr))
+                    collect = _exact_device_launch(np.stack(qs), matrix, mask, metric, k)
+
+                    def finish():
+                        dd, rr = collect()
+                        return list(zip(dd, rr))
+
+                    return finish
 
                 dists, slots = ds.dispatch.submit(key, q, runner)
             else:
@@ -574,8 +597,15 @@ class KnnPlan(_KnnExecutorMixin):
                 key = ("knn-ivf", id(matrix), id(ivf), metric, k, nprobe)
 
                 def runner(qs):
-                    dd, rr = ivf.search_batch(np.stack(qs), matrix, metric, k, nprobe)
-                    return list(zip(dd, rr))
+                    collect = ivf.search_batch_launch(
+                        np.stack(qs), matrix, metric, k, nprobe
+                    )
+
+                    def finish():
+                        dd, rr = collect()
+                        return list(zip(dd, rr))
+
+                    return finish
 
                 dists, slots = ds.dispatch.submit(key, q, runner)
         elif not cnf.TPU_DISABLE and n >= cnf.TPU_KNN_ONDEVICE_THRESHOLD:
@@ -584,8 +614,13 @@ class KnnPlan(_KnnExecutorMixin):
             key = ("knn-exact", id(matrix), metric, k)
 
             def runner(qs):
-                dd, rr = _exact_device_batch(np.stack(qs), matrix, mask, metric, k)
-                return list(zip(dd, rr))
+                collect = _exact_device_launch(np.stack(qs), matrix, mask, metric, k)
+
+                def finish():
+                    dd, rr = collect()
+                    return list(zip(dd, rr))
+
+                return finish
 
             dists, slots = ds.dispatch.submit(key, q, runner)
         else:

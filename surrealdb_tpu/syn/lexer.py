@@ -159,9 +159,11 @@ class Lexer:
             raise self.error("invalid number")
         raw = m.group().replace("_", "")
         self.pos = m.end()
-        # duration? only if integer-ish part followed directly by a unit
+        # duration? only if a PLAIN INTEGER part is followed directly by a
+        # unit — float/scientific forms like `2e6y` are a number + ident run
+        # (a flexible record id), never a duration
         um = _DUR_UNIT_RE.match(self.text, self.pos)
-        if um and m.group(1) is None and not (
+        if um and m.group(1) is None and raw.isdigit() and not (
             um.group() in ("s", "m", "h", "d", "w", "y")
             and self.pos + len(um.group()) < self.n
             and (self.text[self.pos + len(um.group())].isalnum() or self.text[self.pos + len(um.group())] == "_")
@@ -172,7 +174,11 @@ class Lexer:
             self.pos += len(um.group())
             while self.pos < self.n and self.text[self.pos].isdigit():
                 m2 = _NUM_RE.match(self.text, self.pos)
-                u2 = m2 and _DUR_UNIT_RE.match(self.text, m2.end())
+                u2 = (
+                    m2
+                    and m2.group().replace("_", "").isdigit()
+                    and _DUR_UNIT_RE.match(self.text, m2.end())
+                )
                 if not (m2 and u2):
                     break
                 total_text += m2.group().replace("_", "") + u2.group()

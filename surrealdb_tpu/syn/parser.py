@@ -2028,25 +2028,29 @@ class Parser:
         def id_atom() -> Any:
             t = self.peek()
             if t.kind in ("NUMBER", "DURATION"):
-                # digit-leading alphanumeric ids (`likes:8abc2`, `t:1h30x`)
-                # lex as NUMBER/DURATION [+ IDENT]; merge adjacent source text
-                # back into one string id
+                # flexible record ids (reference syn/parser/thing.rs:251
+                # flexible_record_id): digit-leading alphanumeric ids like
+                # `likes:8abc2`, `t:1h30x`, `t:5h44m5f4x` lex as a run of
+                # NUMBER/DURATION/IDENT tokens; merge the whole adjacent
+                # [A-Za-z0-9_]+ source run back into one string id and
+                # resync the token stream past every token inside it
                 nxt = self.peek(1)
-                merged = None
-                if nxt.kind in ("IDENT", "NUMBER", "DURATION"):
-                    seg = self.text[t.pos : nxt.pos]
-                    if not any(c.isspace() for c in seg):
+                if nxt.kind in ("IDENT", "NUMBER", "DURATION") and not any(
+                    c.isspace() for c in self.text[t.pos : nxt.pos]
+                ):
+                    end = t.pos
+                    while end < len(self.text) and (
+                        self.text[end].isalnum() or self.text[end] == "_"
+                    ):
+                        end += 1
+                    while self.peek().kind != "EOF" and self.peek().pos < end:
                         self.next()
-                        end_tok = self.next()
-                        end = end_tok.pos
-                        # extend through the end token's literal text
-                        while end < len(self.text) and (
-                            self.text[end].isalnum() or self.text[end] == "_"
-                        ):
-                            end += 1
-                        merged = self.text[t.pos : end]
-                if merged is not None:
-                    return merged
+                    # a token straddling the run boundary (e.g. `8e+2`)
+                    # cannot merge cleanly into an id
+                    gap = self.text[end : self.peek().pos]
+                    if gap.strip():
+                        raise self.error("invalid record id", t)
+                    return self.text[t.pos : end]
                 if t.kind == "DURATION":
                     # a bare duration-shaped id (`t:1h`) is a string id
                     self.next()
@@ -2058,6 +2062,11 @@ class Parser:
                     return self.text[t.pos : end]
                 self.next()
                 if isinstance(t.value, float):
+                    # `t:8e2` — number-shaped but alnum text is a string id
+                    # (reference Digits + identifier-chars → Id::String)
+                    raw = self.text[t.pos : self.peek().pos].rstrip()
+                    if raw and all(c.isalnum() or c == "_" for c in raw):
+                        return raw
                     raise self.error("record id must be an integer", t)
                 return t.value
             if t.kind == "IDENT":

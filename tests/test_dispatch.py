@@ -17,7 +17,8 @@ def test_queue_single_request_no_extra_latency():
     q = DispatchQueue()
     out = q.submit("k", 3, lambda xs: [x * 2 for x in xs])
     assert out == 6
-    assert q.stats() == {"submitted": 1, "dispatches": 1, "batched": 0}
+    st = q.stats()
+    assert (st["submitted"], st["dispatches"], st["batched"]) == (1, 1, 0)
 
 
 def test_queue_coalesces_while_leader_busy():
@@ -92,6 +93,55 @@ def test_queue_keys_do_not_cross_batch():
     b = q.submit(("knn", 20), 1, lambda xs: [("b", x) for x in xs])
     assert a == ("a", 1) and b == ("b", 1)
     assert q.stats()["dispatches"] == 2
+
+
+def test_two_phase_runner_overlaps_batches():
+    """A two-phase runner hands the bucket over after LAUNCH: the next
+    batch's launch phase runs while the previous batch's collect is still
+    blocked (double buffering; VERDICT r3 weak #4)."""
+    q = DispatchQueue()
+    first_collect_release = threading.Event()
+    second_launched = threading.Event()
+    results = {}
+
+    def runner_first(xs):
+        def collect():
+            # blocked "download": the second batch must launch meanwhile
+            assert second_launched.wait(5), "second batch never launched during collect"
+            return [x * 2 for x in xs]
+
+        return collect
+
+    def runner_second(xs):
+        second_launched.set()
+        return [x * 3 for x in xs]
+
+    def submit(i, runner):
+        results[i] = q.submit("k", i, runner)
+
+    t1 = threading.Thread(target=submit, args=(1, runner_first))
+    t1.start()
+    time.sleep(0.05)  # let t1 become leader and enter collect
+    t2 = threading.Thread(target=submit, args=(2, runner_second))
+    t2.start()
+    t1.join(10)
+    t2.join(10)
+    assert results == {1: 2, 2: 6}
+
+
+def test_two_phase_collect_error_propagates():
+    q = DispatchQueue()
+
+    def runner(xs):
+        def collect():
+            raise ValueError("download failed")
+
+        return collect
+
+    with pytest.raises(ValueError, match="download failed"):
+        q.submit("k", 1, runner)
+    # bucket released after the failure
+    assert q.submit("k", 4, lambda xs: [x + 1 for x in xs]) == 5
 
 
 # ------------------------------------------------------------------ engine
