@@ -444,12 +444,7 @@ def test_flexible_record_ids_roundtrip():
         "8e2",                   # pure scientific-notation shape
         "1h30x",
     ):
-        q = parse_query(f"SELECT * FROM likes:{rid};")
-        thing = q.stmts[0].what[0]
-        tgt = thing
-        while hasattr(tgt, "parts"):
-            tgt = tgt.parts[0].v if hasattr(tgt.parts[0], "v") else tgt.parts[0]
-        # evaluate through the engine instead of poking AST internals
+        parse_query(f"SELECT * FROM likes:{rid};")  # must not raise
     from surrealdb_tpu.kvs.ds import Datastore
 
     ds = Datastore("memory")
