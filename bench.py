@@ -148,7 +148,7 @@ def gen_corpus(n, d, seed=42):
 def ingest_person_graph(ds, s, rng):
     log(f"ingest person graph: {NP_NODES} nodes, {NE} edges")
     run(ds, s, "DEFINE TABLE person SCHEMALESS; DEFINE TABLE knows SCHEMALESS")
-    B = 5000
+    B = 25000
     for i in range(0, NP_NODES, B):
         rows = [{"id": j} for j in range(i, min(i + B, NP_NODES))]
         run(ds, s, "INSERT INTO person $rows", {"rows": rows})
@@ -172,7 +172,7 @@ def ingest_items(ds, s, corpus):
         "DEFINE TABLE item SCHEMALESS; "
         f"DEFINE INDEX iemb ON item FIELDS emb HNSW DIMENSION {D} DIST EUCLIDEAN EFC 64",
     )
-    B = 2000
+    B = 20000
     for i in range(0, NI, B):
         ids = range(i, min(i + B, NI))
         run(ds, s, "INSERT INTO item $rows", {"rows": vec_rows(corpus[i : i + B], ids, flag_every=4)})
@@ -187,7 +187,7 @@ def ingest_hybrid_edges(ds, s, rng):
     run(ds, s, "DEFINE TABLE rel SCHEMALESS")
     from surrealdb_tpu.sql.value import Thing
 
-    B = 5000
+    B = 25000
     srcs = np.repeat(np.arange(EH_REGION), EH_DEG)
     dsts = rng.integers(0, EH_REGION, size=n_edges)
     for i in range(0, n_edges, B):
@@ -219,7 +219,7 @@ def ingest_docs(ds, s, rng):
     # zipf-ish: word rank r sampled with p ~ 1/(r+10)
     w = 1.0 / (np.arange(VOCAB_N) + 10.0)
     p = w / w.sum()
-    B = 2000
+    B = 20000
     L = 12
     for i in range(0, ND, B):
         n = min(B, ND - i)

@@ -159,6 +159,7 @@ class IvfState:
         self._dev = None  # (cents, list_rows, list_mask)
         self._mut = 0  # bumped on every list mutation; sharded cache keys off it
         self._sharded_cache = None  # (key, (cents, rows, mask, shard_rows))
+        self._warmed: set = set()  # (tile, k, nprobe, metric) combos compiled
 
     @property
     def nlists(self) -> int:
@@ -291,11 +292,13 @@ class IvfState:
         k = min(k, nprobe * int(list_rows.shape[1]))
         from surrealdb_tpu.utils.num import pad_tail, tile_slices
 
+        from surrealdb_tpu.utils.num import dispatch_tile
+
         qs = np.asarray(qs, dtype=np.float32)
-        # adapt the tile to the batch: a lone query must not pay a 64x-padded
-        # candidate gather; pow2 tiles keep the compile-cache small
-        tile = min(_next_pow2(max(qs.shape[0], 1)), tile)
+        # small tile vocabulary: every distinct padded shape is a separate
+        # XLA compile; {1, 8, tile} bounds compiles AND padding waste
         nq = qs.shape[0]
+        tile = dispatch_tile(nq, tile)
         pending = []
         for lo, hi in tile_slices(nq, tile):
             d, r = _ivf_search(
@@ -314,7 +317,43 @@ class IvfState:
                 rr[lo:hi] = np.asarray(r)[: hi - lo]
             return dd, rr
 
+        self._warm_tiles(qs.shape[1], cents, list_rows, list_mask, matrix,
+                         metric, probe_metric, k, nprobe, tile)
         return collect
+
+    def _warm_tiles(self, dim, cents, list_rows, list_mask, matrix,
+                    metric, probe_metric, k, nprobe, served_tile) -> None:
+        """Background-compile the OTHER dispatch tile shapes for these query
+        params: a burst of concurrent queries coalesces into 8/64-wide
+        batches whose first dispatch would otherwise stall seconds on XLA
+        compilation (the r3 concurrent-qps killer). Zero-queries through the
+        same kernel carry no correctness risk — results are discarded."""
+        import threading
+
+        todo = []
+        for t in (1, 8, 64):
+            key = (t, k, nprobe, metric)
+            if t != served_tile and key not in self._warmed:
+                self._warmed.add(key)
+                todo.append(t)
+        self._warmed.add((served_tile, k, nprobe, metric))
+        if not todo:
+            return
+
+        def warm():
+            import jax.numpy as jnp
+
+            for t in todo:
+                try:
+                    _ivf_search(
+                        jnp.zeros((t, dim), jnp.float32), cents, list_rows,
+                        list_mask, matrix,
+                        metric=metric, probe_metric=probe_metric, k=k, nprobe=nprobe,
+                    )
+                except Exception:
+                    pass
+
+        threading.Thread(target=warm, daemon=True).start()
 
     def search_batch(
         self, qs: np.ndarray, matrix, metric: str, k: int, nprobe: int, tile: int = 64
@@ -378,11 +417,13 @@ class IvfState:
         from surrealdb_tpu.utils.num import pad_tail, tile_slices
         import jax.numpy as jnp
 
+        from surrealdb_tpu.utils.num import dispatch_tile
+
         cents, list_rows, list_mask, _ = self._device_sharded(mesh, matrix.shape[0])
         probe_metric = metric if metric in _PROBE_METRICS else "euclidean"
         nprobe = min(nprobe, self.nlists)
         qs = np.asarray(qs, dtype=np.float32)
-        tile = min(_next_pow2(max(qs.shape[0], 1)), tile)
+        tile = dispatch_tile(qs.shape[0], tile)
         dd = np.full((qs.shape[0], k), np.inf, dtype=np.float32)
         rr = np.full((qs.shape[0], k), -1, dtype=np.int64)
         for lo, hi in tile_slices(qs.shape[0], tile):

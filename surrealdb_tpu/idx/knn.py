@@ -379,10 +379,10 @@ def _exact_device_launch(qs: np.ndarray, matrix, mask, metric: str, k: int):
     import jax.numpy as jnp
 
     from surrealdb_tpu.idx.ivf import _start_host_copy
-    from surrealdb_tpu.utils.num import pad_tail, tile_slices
+    from surrealdb_tpu.utils.num import dispatch_tile, pad_tail, tile_slices
 
     nq = qs.shape[0]
-    tile = min(_pow2(max(nq, 1)), 64)
+    tile = dispatch_tile(nq)
     mj = jnp.asarray(mask)
     pending = []
     for lo, hi in tile_slices(nq, tile):
@@ -398,7 +398,36 @@ def _exact_device_launch(qs: np.ndarray, matrix, mask, metric: str, k: int):
             rr[lo:hi] = np.asarray(r)[: hi - lo]
         return dd, rr
 
+    _warm_exact_tiles(qs.shape[1], matrix, mj, metric, k, tile)
     return collect
+
+
+_EXACT_WARMED: set = set()
+
+
+def _warm_exact_tiles(dim, matrix, mask_j, metric, k, served_tile) -> None:
+    """Background-compile the other dispatch tile shapes of the exact fused
+    kernel (same rationale as IvfState._warm_tiles)."""
+    todo = []
+    for t in (1, 8, 64):
+        key = (t, id(matrix), metric, k)
+        if t != served_tile and key not in _EXACT_WARMED:
+            _EXACT_WARMED.add(key)
+            todo.append(t)
+    _EXACT_WARMED.add((served_tile, id(matrix), metric, k))
+    if not todo:
+        return
+
+    def warm():
+        import jax.numpy as jnp
+
+        for t in todo:
+            try:
+                D.knn_search(jnp.zeros((t, dim), jnp.float32), matrix, mask_j, metric, k)
+            except Exception:
+                pass
+
+    threading.Thread(target=warm, daemon=True).start()
 
 
 def _exact_device_batch(qs: np.ndarray, matrix, mask, metric: str, k: int):
@@ -546,11 +575,11 @@ class KnnPlan(_KnnExecutorMixin):
 
                 def runner(qs):
                     from surrealdb_tpu.parallel.mesh import sharded_knn
-                    from surrealdb_tpu.utils.num import pad_tail, tile_slices
+                    from surrealdb_tpu.utils.num import dispatch_tile, pad_tail, tile_slices
 
                     qs_m = np.stack(qs)
                     nq = qs_m.shape[0]
-                    tile = min(_pow2(max(nq, 1)), 64)
+                    tile = dispatch_tile(nq)
                     dd = np.empty((nq, k), dtype=np.float32)
                     rr = np.empty((nq, k), dtype=np.int64)
                     for lo, hi in tile_slices(nq, tile):

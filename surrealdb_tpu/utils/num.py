@@ -24,3 +24,16 @@ def pad_tail(arr, tile: int):
         return arr
     pad = np.zeros((tile - n,) + arr.shape[1:], dtype=arr.dtype)
     return np.concatenate([arr, pad], axis=0)
+
+
+def dispatch_tile(nq: int, cap: int = 64) -> int:
+    """Query-batch tile size with a SMALL shape vocabulary {1, 8, cap}: a
+    coalesced batch can arrive at any size, and every distinct padded shape
+    is a separate XLA compile (~seconds on a tunneled chip) — three shapes
+    keep the compile cache tiny while bounding padding waste at 8x only for
+    2..7-query batches whose kernels are small anyway."""
+    if nq <= 1:
+        return 1
+    if nq <= 8:
+        return 8
+    return cap
