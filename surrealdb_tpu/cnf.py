@@ -37,6 +37,10 @@ EXPORT_BATCH_SIZE = _env_int("SURREAL_EXPORT_BATCH_SIZE", 1000)
 INDEXING_BATCH_SIZE = _env_int("SURREAL_INDEXING_BATCH_SIZE", 250)
 # row count past which INSERT INTO t $rows takes the bulk write path
 BULK_INSERT_MIN = _env_int("SURREAL_BULK_INSERT_MIN", 64)
+# file backend: fsync the WAL on every commit (power-loss durability)
+SYNC_DATA = _env_int("SURREAL_SYNC_DATA", 0) != 0
+# file backend: WAL size that triggers snapshot compaction
+WAL_COMPACT_MIN = _env_int("SURREAL_WAL_COMPACT_MIN", 8 * 1024 * 1024)
 COUNT_BATCH_SIZE = _env_int("SURREAL_COUNT_BATCH_SIZE", 10_000)
 
 # Result handling

@@ -1,10 +1,23 @@
-"""File-backed datastore: MVCC memstore + snapshot persistence.
+"""File-backed datastore: MVCC memstore + write-ahead log + snapshot.
 
-Stands in for the reference's rocksdb/surrealkv persistent backends behind the
-same trait (reference: core/src/kvs/rocksdb/, kvs/surrealkv/). The full store
-is loaded at open and snapshotted to disk on every commit batch boundary
-(cheap for the embedded use; a C++ LSM backend can slot in behind
-`BackendDatastore` later without touching callers).
+Role of the reference's persistent backends (reference: core/src/kvs/
+surrealkv/mod.rs, kvs/rocksdb/mod.rs — LSM stores with a WAL) behind the
+same trait. Design:
+
+- every commit batch appends ONE length+CRC-framed record batch to
+  `<path>.wal` (append-only, O(batch) per commit — replacing the previous
+  whole-database rewrite per flush);
+- opening loads the `<path>` snapshot then replays intact WAL frames in
+  order; a torn tail frame (crash mid-append) is detected by length/CRC and
+  discarded, so a kill -9 loses at most transactions that had not finished
+  their commit append;
+- when the WAL outgrows max(snapshot size, SURREAL_WAL_COMPACT_MIN) the
+  committing thread compacts: full snapshot to a temp file, atomic rename,
+  WAL truncated.
+
+Durability knob: SURREAL_SYNC_DATA=1 fsyncs the WAL on every commit
+(power-loss safety); default is OS-buffered appends (process-crash safety),
+matching the reference's default surrealkv configuration.
 """
 
 from __future__ import annotations
@@ -12,29 +25,87 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import zlib
 
+from surrealdb_tpu import cnf
 from .api import BackendDatastore, BackendTransaction
 from .mem import MemDatastore, MemTransaction
 
 MAGIC = b"STPU1\n"
+WAL_MAGIC = b"STPUW1\n"
+_TOMBSTONE = 0xFFFFFFFF
+
+
+def _frame(writes) -> bytes:
+    """Serialize one commit batch: u32 len | u32 crc | records."""
+    parts = []
+    for k, v in writes.items():
+        if v is None:
+            parts.append(struct.pack(">II", len(k), _TOMBSTONE))
+            parts.append(k)
+        else:
+            parts.append(struct.pack(">II", len(k), len(v)))
+            parts.append(k)
+            parts.append(v)
+    payload = b"".join(parts)
+    return struct.pack(">II", len(payload), zlib.crc32(payload)) + payload
+
+
+def _iter_frames(data: bytes, start: int):
+    """Yield (payload, end_offset) for every intact frame; stops at the
+    first torn/corrupt frame."""
+    pos = start
+    n = len(data)
+    while pos + 8 <= n:
+        ln, crc = struct.unpack_from(">II", data, pos)
+        if pos + 8 + ln > n:
+            return  # torn tail: frame body never fully landed
+        payload = data[pos + 8 : pos + 8 + ln]
+        if zlib.crc32(payload) != crc:
+            return  # corrupt frame: discard it and everything after
+        pos += 8 + ln
+        yield payload, pos
+
+
+def _iter_records(payload: bytes):
+    pos = 0
+    n = len(payload)
+    while pos + 8 <= n:
+        klen, vmark = struct.unpack_from(">II", payload, pos)
+        pos += 8
+        k = payload[pos : pos + klen]
+        pos += klen
+        if vmark == _TOMBSTONE:
+            yield k, None
+        else:
+            v = payload[pos : pos + vmark]
+            pos += vmark
+            yield k, v
 
 
 class FileDatastore(BackendDatastore):
     def __init__(self, path: str):
         self.path = path
+        self.wal_path = path + ".wal"
         self.mem = MemDatastore()
-        self._dirty = 0
         self._lock = threading.Lock()
+        self._wal_f = None
+        self._wal_size = 0
         if os.path.exists(path):
-            self._load()
+            self._load_snapshot()
+        if os.path.exists(self.wal_path):
+            self._replay_wal()
+        self._open_wal()
 
-    def _load(self) -> None:
+    # ------------------------------------------------------------ open
+    def _load_snapshot(self) -> None:
         with open(self.path, "rb") as f:
             data = f.read()
         if not data.startswith(MAGIC):
             raise ValueError(f"{self.path} is not a surrealdb_tpu datastore")
         pos = len(MAGIC)
         n = len(data)
+        keys = []
         while pos < n:
             klen, vlen = struct.unpack_from(">II", data, pos)
             pos += 8
@@ -43,29 +114,101 @@ class FileDatastore(BackendDatastore):
             v = data[pos : pos + vlen]
             pos += vlen
             self.mem.data[k] = [(0, v)]
+            keys.append(k)
+        self.mem.sorted_keys.update(keys)
+
+    def _replay_wal(self) -> None:
+        with open(self.wal_path, "rb") as f:
+            data = f.read()
+        if not data.startswith(WAL_MAGIC):
+            return  # unrecognized/empty WAL: nothing intact to replay
+        good_end = len(WAL_MAGIC)
+        mem = self.mem
+        new_keys = []
+        for payload, end in _iter_frames(data, good_end):
+            mem.version += 1
+            ver = mem.version
+            for k, v in _iter_records(payload):
+                chain = mem.data.get(k)
+                if chain is None:
+                    mem.data[k] = [(ver, v)]
+                    new_keys.append(k)
+                else:
+                    chain.append((ver, v))
+            good_end = end
+        mem.sorted_keys.update(new_keys)
+        if good_end < len(data):
+            # torn tail from a crash mid-append: truncate to the intact prefix
+            with open(self.wal_path, "r+b") as f:
+                f.truncate(good_end)
+
+    def _open_wal(self) -> None:
+        if not os.path.exists(self.wal_path):
+            with open(self.wal_path, "wb") as f:
+                f.write(WAL_MAGIC)
+        self._wal_f = open(self.wal_path, "ab")
+        self._wal_size = self._wal_f.tell()
+
+    # ------------------------------------------------------------ commit path
+    def append_commit(self, writes) -> None:
+        """Called by FileTransaction.commit AFTER the mem apply, under the
+        datastore lock (WAL frame order == commit version order)."""
+        frame = _frame(writes)
+        self._wal_f.write(frame)
+        self._wal_f.flush()
+        if cnf.SYNC_DATA:
+            os.fsync(self._wal_f.fileno())
+        self._wal_size += len(frame)
+        if self._wal_size >= self._compact_threshold():
+            self._compact()
+
+    def _compact_threshold(self) -> int:
+        try:
+            snap = os.path.getsize(self.path)
+        except OSError:
+            snap = 0
+        return max(snap, cnf.WAL_COMPACT_MIN)
+
+    def _compact(self) -> None:
+        """Snapshot the live state and truncate the WAL. Runs on the
+        committing thread while holding the datastore lock."""
+        with self.mem.lock:
+            snapshot = [
+                (k, chain[-1][1])
+                for k, chain in self.mem.data.items()
+                if chain[-1][1] is not None
+            ]
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(MAGIC)
+            for k, v in snapshot:
+                f.write(struct.pack(">II", len(k), len(v)))
+                f.write(k)
+                f.write(v)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._wal_f.close()
+        with open(self.wal_path, "wb") as f:
+            f.write(WAL_MAGIC)
+            f.flush()
+            os.fsync(f.fileno())
+        self._open_wal()
 
     def flush(self) -> None:
         with self._lock:
-            with self.mem.lock:
-                snapshot = [
-                    (k, chain[-1][1])
-                    for k, chain in self.mem.data.items()
-                    if chain[-1][1] is not None
-                ]
-            tmp = self.path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(MAGIC)
-                for k, v in snapshot:
-                    f.write(struct.pack(">II", len(k), len(v)))
-                    f.write(k)
-                    f.write(v)
-            os.replace(tmp, self.path)
+            self._compact()
 
     def transaction(self, write: bool) -> BackendTransaction:
         return FileTransaction(self, write)
 
     def close(self) -> None:
-        self.flush()
+        with self._lock:
+            if self._wal_f is not None:
+                self._wal_f.flush()
+                os.fsync(self._wal_f.fileno())
+                self._wal_f.close()
+                self._wal_f = None
 
 
 class FileTransaction(MemTransaction):
@@ -74,7 +217,8 @@ class FileTransaction(MemTransaction):
         self.fstore = store
 
     def commit(self) -> None:
-        had_writes = bool(self.writes)
-        super().commit()
-        if had_writes:
-            self.fstore.flush()
+        writes = dict(self.writes)
+        with self.fstore._lock:
+            super().commit()  # raises TxConflictError before any WAL append
+            if writes:
+                self.fstore.append_commit(writes)

@@ -221,3 +221,86 @@ def test_file_datastore_persists(tmp_path):
     tx = ds2.transaction(write=False)
     assert tx.get_record("n", "d", "t", 1) == {"v": 42}
     tx.cancel()
+
+
+def test_wal_survives_unclean_shutdown(tmp_path):
+    """Committed transactions are recoverable WITHOUT close()/flush — the
+    WAL alone carries them (VERDICT r3 #7: kill -9 loses at most
+    uncommitted txns)."""
+    path = str(tmp_path / "data.stpu")
+    ds = Datastore(f"file://{path}")
+    for i in range(20):
+        tx = ds.transaction(write=True)
+        tx.set_record("n", "d", "t", i, {"v": i})
+        tx.commit()
+    # simulate kill -9: no close, no flush — just drop the handle
+    del ds
+
+    ds2 = Datastore(f"file://{path}")
+    tx = ds2.transaction(write=False)
+    for i in range(20):
+        assert tx.get_record("n", "d", "t", i) == {"v": i, }
+    tx.cancel()
+    ds2.close()
+
+
+def test_wal_torn_tail_frame_discarded(tmp_path):
+    """A partial frame at the WAL tail (crash mid-append) must not poison
+    recovery: the intact prefix replays, the torn tail is truncated."""
+    path = str(tmp_path / "data.stpu")
+    ds = Datastore(f"file://{path}")
+    for i in range(5):
+        tx = ds.transaction(write=True)
+        tx.set_record("n", "d", "t", i, {"v": i})
+        tx.commit()
+    del ds
+    # append garbage that looks like the start of a frame
+    import struct
+    with open(path + ".wal", "ab") as f:
+        f.write(struct.pack(">II", 10_000, 12345) + b"short")
+
+    ds2 = Datastore(f"file://{path}")
+    tx = ds2.transaction(write=False)
+    for i in range(5):
+        assert tx.get_record("n", "d", "t", i) == {"v": i}
+    tx.cancel()
+    # and the store keeps working (tail was truncated)
+    tx = ds2.transaction(write=True)
+    tx.set_record("n", "d", "t", 99, {"v": 99})
+    tx.commit()
+    ds2.close()
+    ds3 = Datastore(f"file://{path}")
+    tx = ds3.transaction(write=False)
+    assert tx.get_record("n", "d", "t", 99) == {"v": 99}
+    tx.cancel()
+    ds3.close()
+
+
+def test_wal_compaction_truncates_and_preserves(tmp_path, monkeypatch):
+    """Crossing the WAL size threshold compacts into the snapshot and
+    truncates the log; deletes survive compaction as absent keys."""
+    from surrealdb_tpu import cnf
+    import os
+
+    monkeypatch.setattr(cnf, "WAL_COMPACT_MIN", 2048)
+    path = str(tmp_path / "data.stpu")
+    ds = Datastore(f"file://{path}")
+    for i in range(50):
+        tx = ds.transaction(write=True)
+        tx.set_record("n", "d", "t", i, {"v": "x" * 100})
+        tx.commit()
+    tx = ds.transaction(write=True)
+    tx.del_record("n", "d", "t", 0)
+    tx.commit()
+    # compaction must have run at least once: a snapshot exists and the WAL
+    # holds only the post-compaction suffix, not all ~50 commit frames
+    assert os.path.getsize(path) > 1000
+    assert os.path.getsize(path + ".wal") < 6000
+    del ds
+
+    ds2 = Datastore(f"file://{path}")
+    tx = ds2.transaction(write=False)
+    assert tx.get_record("n", "d", "t", 0) is None
+    assert tx.get_record("n", "d", "t", 49) == {"v": "x" * 100}
+    tx.cancel()
+    ds2.close()
