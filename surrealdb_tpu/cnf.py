@@ -37,6 +37,10 @@ EXPORT_BATCH_SIZE = _env_int("SURREAL_EXPORT_BATCH_SIZE", 1000)
 INDEXING_BATCH_SIZE = _env_int("SURREAL_INDEXING_BATCH_SIZE", 250)
 # row count past which INSERT INTO t $rows takes the bulk write path
 BULK_INSERT_MIN = _env_int("SURREAL_BULK_INSERT_MIN", 64)
+# embedded scripting limits (reference SCRIPTING_MAX_* cnf/mod.rs:56-61 —
+# memory/stack caps; here an op budget + call-depth cap play that role)
+SCRIPTING_MAX_OPS = _env_int("SURREAL_SCRIPTING_MAX_OPS", 2_000_000)
+SCRIPTING_MAX_STACK_DEPTH = _env_int("SURREAL_SCRIPTING_MAX_STACK_DEPTH", 128)
 # file backend: fsync the WAL on every commit (power-loss durability)
 SYNC_DATA = _env_int("SURREAL_SYNC_DATA", 0) != 0
 # file backend: WAL size that triggers snapshot compaction

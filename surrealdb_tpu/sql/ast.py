@@ -604,6 +604,31 @@ class FunctionCall(Expr):
         return f"{self.name}(" + ", ".join(repr(a) for a in self.args) + ")"
 
 
+class ScriptCall(Expr):
+    """`function(args) { js }` — embedded script block (reference:
+    core/src/sql/function.rs:31 Function::Script; executed with `this` =
+    current document and `arguments` = computed args, fnc/script/main.rs)."""
+
+    __slots__ = ("src", "args")
+
+    def __init__(self, src: str, args: List[Expr]):
+        self.src = src
+        self.args = args
+
+    def compute(self, ctx):
+        from surrealdb_tpu.fnc.script import run_script
+
+        args = [a.compute(ctx) for a in self.args]
+        doc = ctx.doc.current if ctx.doc is not None else None
+        return run_script(ctx, self.src, args, doc)
+
+    def writeable(self):
+        return any(a.writeable() for a in self.args)
+
+    def __repr__(self):
+        return f"function({', '.join(repr(a) for a in self.args)}) {{{self.src}}}"
+
+
 class CustomFunctionCall(Expr):
     """fn::name(args) — DEFINE FUNCTION lookup."""
 

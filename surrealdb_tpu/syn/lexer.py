@@ -94,6 +94,11 @@ class Lexer:
                 m = _IDENT_RE.match(self.text, self.pos)
                 self.pos = m.end()
                 self.tokens.append(Token("IDENT", m.group(), start))
+                if m.group() == "function":
+                    # `function(<sql args>) { <js> }` — capture the raw JS
+                    # body as one SCRIPT token (reference: syn lexes JS
+                    # compound tokens for sql::Script)
+                    self._maybe_lex_script()
             elif c == "`":
                 # backtick-quoted identifier
                 end = self.text.find("`", self.pos + 1)
@@ -150,6 +155,107 @@ class Lexer:
                 self.pos = end + 2
                 continue
             return
+
+    # ------------------------------------------------------------------ script
+    def _maybe_lex_script(self) -> None:
+        """After an IDENT `function`: if the source reads `( args ) {`, lex
+        the SurrealQL arg list via a sub-lexer and capture the JS block as
+        one SCRIPT token; otherwise leave the stream untouched."""
+        ws = _WS_RE.match(self.text, self.pos)
+        p = ws.end() if ws else self.pos
+        if p >= self.n or self.text[p] != "(":
+            return
+        depth, j = 0, p
+        while j < self.n:
+            ch = self.text[j]
+            if ch in "\"'":
+                j = self._skip_quoted(j)
+                continue
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            j += 1
+        if j >= self.n:
+            return
+        ws2 = _WS_RE.match(self.text, j + 1)
+        q = ws2.end() if ws2 else j + 1
+        if q >= self.n or self.text[q] != "{":
+            return
+        self.tokens.append(Token("OP", "(", p))
+        sub = Lexer(self.text[p + 1 : j])
+        for t in sub.lex():
+            if t.kind == "EOF":
+                break
+            self.tokens.append(Token(t.kind, t.value, p + 1 + t.pos))
+        self.tokens.append(Token("OP", ")", j))
+        end = self._scan_js_block(q)
+        self.tokens.append(Token("SCRIPT", self.text[q + 1 : end], q))
+        self.pos = end + 1
+
+    def _skip_quoted(self, i: int) -> int:
+        """Index just past a quoted SQL string starting at i."""
+        quote = self.text[i]
+        j = i + 1
+        while j < self.n:
+            if self.text[j] == "\\":
+                j += 2
+                continue
+            if self.text[j] == quote:
+                return j + 1
+            j += 1
+        raise self.error("unterminated string", i)
+
+    def _scan_js_block(self, open_pos: int) -> int:
+        """Index of the `}` matching the `{` at open_pos, respecting JS
+        strings, template literals, and comments."""
+        depth = 0
+        j = open_pos
+        while j < self.n:
+            ch = self.text[j]
+            if ch in "\"'":
+                j = self._skip_quoted(j)
+                continue
+            if ch == "`":
+                j += 1
+                while j < self.n and self.text[j] != "`":
+                    if self.text[j] == "\\":
+                        j += 2
+                        continue
+                    # ${ expr } inside a template nests normal JS braces
+                    if self.text.startswith("${", j):
+                        d2 = 1
+                        j += 2
+                        while j < self.n and d2:
+                            if self.text[j] == "{":
+                                d2 += 1
+                            elif self.text[j] == "}":
+                                d2 -= 1
+                            j += 1
+                        continue
+                    j += 1
+                j += 1
+                continue
+            if self.text.startswith("//", j):
+                nl = self.text.find("\n", j)
+                j = self.n if nl < 0 else nl + 1
+                continue
+            if self.text.startswith("/*", j):
+                e = self.text.find("*/", j + 2)
+                if e < 0:
+                    raise self.error("unterminated comment in script", j)
+                j = e + 2
+                continue
+            if ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                if depth == 0:
+                    return j
+            j += 1
+        raise self.error("unterminated script block", open_pos)
 
     # ------------------------------------------------------------------ num
     def _lex_number_or_duration(self) -> None:

@@ -1987,6 +1987,28 @@ class Parser:
             if full.lower() in A.Constant._VALUES:
                 return A.Constant(full.lower())
             raise self.error(f"unknown constant {full}")
+        # embedded script block: function(args) { js }  (the lexer emits a
+        # SCRIPT token right after the closing paren in exactly this shape)
+        if name == "function" and self.is_op("("):
+            j = self.i + 1
+            depth = 1
+            while j < len(self.toks) and depth:
+                t = self.toks[j]
+                if t.kind == "OP" and t.value == "(":
+                    depth += 1
+                elif t.kind == "OP" and t.value == ")":
+                    depth -= 1
+                j += 1
+            if j < len(self.toks) and self.toks[j].kind == "SCRIPT":
+                self.next()  # (
+                args = []
+                while not self.is_op(")"):
+                    args.append(self.parse_expr())
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+                src_tok = self.next()
+                return A.ScriptCall(src_tok.value, args)
         # plain function call: count(), rand(), type::of...
         if self.is_op("("):
             self.next()

@@ -1,0 +1,152 @@
+"""Embedded scripting: `function() { … }` blocks (reference:
+core/src/fnc/script/main.rs — this=doc, arguments=args, resource limits
+cnf/mod.rs:56-61; capability gate dbs/capabilities.rs Scripting)."""
+
+import pytest
+
+from surrealdb_tpu.kvs.ds import Datastore
+
+
+@pytest.fixture()
+def sds():
+    ds = Datastore("memory")
+    ds.capabilities = ds.capabilities.with_scripting(True)
+    return ds
+
+
+def run1(ds, sql, vars=None):
+    out = ds.execute(sql, vars=vars)
+    assert out[-1]["status"] == "OK", out[-1]
+    return out[-1]["result"]
+
+
+def test_scripting_denied_by_default(ds):
+    out = ds.execute("RETURN function() { return 1; };")
+    assert out[-1]["status"] == "ERR"
+    assert "not allowed" in out[-1]["result"]
+
+
+def test_basic_return_value(sds):
+    assert run1(sds, "RETURN function() { return 1 + 2; };") == 3
+
+
+def test_arguments_passed_from_surrealql(sds):
+    out = run1(
+        sds,
+        "RETURN function($a, 10) { return arguments[0] + arguments[1]; };",
+        vars={"a": 32},
+    )
+    assert out == 42
+
+
+def test_this_is_current_document(sds):
+    run1(sds, "CREATE p:1 SET a = 4, b = 5;")
+    out = run1(sds, "SELECT VALUE function() { return this.a * this.b; } FROM p:1;")
+    assert out == [20]
+
+
+def test_this_record_id_marshals(sds):
+    run1(sds, "CREATE p:7;")
+    out = run1(sds, "SELECT VALUE function() { return this.id.tb; } FROM p:7;")
+    assert out == ["p"]
+
+
+def test_closures_arrows_and_methods(sds):
+    assert run1(
+        sds, "RETURN function() { const f = a => b => a + b; return f(2)(3); };"
+    ) == 5
+    assert run1(
+        sds, "RETURN function() { return [1,2,3].map(v => v * 10).filter(v => v > 10); };"
+    ) == [20, 30]
+    assert run1(
+        sds, "RETURN function() { return [3,1,2].sort((a,b) => a-b).join('-'); };"
+    ) == "1-2-3"
+    assert run1(
+        sds,
+        "RETURN function() { return [1,2,3,4].reduce((acc, v) => acc + v, 0); };",
+    ) == 10
+
+
+def test_stdlib_surface(sds):
+    assert run1(sds, "RETURN function() { return Math.max(3, 7, 2); };") == 7
+    assert run1(
+        sds, "RETURN function() { return JSON.parse('{\"k\": [1,2]}').k.length; };"
+    ) == 2
+    assert run1(
+        sds, "RETURN function() { return JSON.stringify({a: 1, b: [true, null]}); };"
+    ) == '{"a":1,"b":[true,null]}'
+    assert run1(sds, "RETURN function() { return Object.keys({x: 1, y: 2}); };") == ["x", "y"]
+    assert run1(sds, "RETURN function() { return 'AbC'.toLowerCase(); };") == "abc"
+    assert run1(sds, "RETURN function() { return (3.14159).toFixed(2); };") == "3.14"
+    assert run1(sds, "RETURN function() { return `v=${1 + 1}`; };") == "v=2"
+
+
+def test_control_flow_and_recursion(sds):
+    assert run1(
+        sds,
+        "RETURN function() { let s = 0; for (let i = 0; i <= 10; i++) { if (i % 2) continue; s += i; } return s; };",
+    ) == 30
+    assert run1(
+        sds,
+        "RETURN function() { function fib(n) { return n < 2 ? n : fib(n-1) + fib(n-2); } return fib(12); };",
+    ) == 144
+    assert run1(
+        sds,
+        "RETURN function() { let out = []; for (const k in {a: 1, b: 2}) out.push(k); return out; };",
+    ) == ["a", "b"]
+
+
+def test_try_catch_and_thrown_errors(sds):
+    assert run1(
+        sds,
+        "RETURN function() { try { throw new Error('boom'); } catch (e) { return e.message; } };",
+    ) == "boom"
+    out = sds.execute("RETURN function() { throw new TypeError('nope'); };")
+    assert out[-1]["status"] == "ERR"
+    assert "nope" in out[-1]["result"]
+
+
+def test_operation_limit_enforced(sds):
+    out = sds.execute("RETURN function() { while (true) {} };")
+    assert out[-1]["status"] == "ERR"
+    assert "limit" in out[-1]["result"]
+
+
+def test_stack_depth_limit_enforced(sds):
+    out = sds.execute("RETURN function() { function f() { return f(); } return f(); };")
+    assert out[-1]["status"] == "ERR"
+
+
+def test_limit_not_catchable_in_script(sds):
+    """Resource exhaustion must not be swallowed by a script's own
+    try/catch (the reference's interrupt handler behaves the same)."""
+    out = sds.execute(
+        "RETURN function() { try { while (true) {} } catch (e) { return 'caught'; } };"
+    )
+    assert out[-1]["status"] == "ERR"
+    assert "limit" in out[-1]["result"]
+
+
+def test_script_inside_set_clause(sds):
+    run1(sds, "CREATE t:1 SET scores = function() { return [1,2,3].map(v => v * 2); };")
+    out = run1(sds, "SELECT VALUE scores FROM t:1;")
+    assert out == [[2, 4, 6]]
+
+
+def test_marshalling_roundtrip(sds):
+    out = run1(
+        sds,
+        "RETURN function($v) { let o = arguments[0]; o.extra = true; return o; };",
+        vars={"v": {"n": 1, "arr": [1, "two", None], "nested": {"x": 1.5}}},
+    )
+    assert out["n"] == 1
+    assert out["arr"][1] == "two"
+    assert out["nested"]["x"] == 1.5
+    assert out["extra"] is True
+
+
+def test_number_marshalling_integers_stay_ints(sds):
+    out = run1(sds, "RETURN function() { return 2 + 3; };")
+    assert isinstance(out, int) and out == 5
+    out = run1(sds, "RETURN function() { return 1 / 2; };")
+    assert out == 0.5
