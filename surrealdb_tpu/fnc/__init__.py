@@ -77,13 +77,33 @@ def _method_namespaces(value) -> List[str]:
         return ["geo"]
     if isinstance(value, (int, float)):
         return ["math"]
+    if isinstance(value, bytes):
+        return ["bytes"]
     return []
 
 
 def run_method(ctx, method: str, receiver: Any, args: List[Any]) -> Any:
+    """Idiom method dispatch `value.method(args)` (reference fnc/mod.rs
+    per-type method tables, e.g. `"is_array" => type::is::array`,
+    `"similarity_jaro" => string::similarity::jaro`): an underscore method
+    name addresses a NESTED namespace, so candidates try both the flat and
+    the `_`→`::` expanded spellings, plus `to_x` → `type::x` casts."""
     m = method.lower()
-    candidates = [f"{ns}::{m}" for ns in _method_namespaces(receiver)]
-    candidates += [f"type::{m}", m]
+    nss = _method_namespaces(receiver)
+    # progressive `_`→`::` variants: `similarity_jaro_winkler` must reach
+    # string::similarity::jaro_winkler (split once) while `is_leap_year`
+    # reaches time::is::leap_year (split once) and `vector_distance_knn`
+    # reaches vector::distance::knn (bare, split twice)
+    variants = [m]
+    parts = m.split("_")
+    for k in range(1, len(parts)):
+        variants.append("::".join(parts[:k]) + "::" + "_".join(parts[k:]))
+    candidates = [f"{ns}::{v}" for ns in nss for v in variants]
+    candidates += [v for v in variants[1:]]  # bare nested (vector::add)
+    candidates += [f"type::{v}" for v in variants]
+    if m.startswith("to_"):
+        candidates += [f"type::{m[3:]}"]
+    candidates += [m]
     caps = ctx.capabilities() if hasattr(ctx, "capabilities") else None
     for key in candidates:
         fn = REGISTRY.get(key)

@@ -11,6 +11,10 @@ from . import register
 
 
 def _num(v, name):
+    import decimal as _dec
+
+    if isinstance(v, _dec.Decimal):
+        return v
     if isinstance(v, bool) or not isinstance(v, (int, float)):
         raise InvalidArgumentsError(name, "Argument 1 was the wrong type. Expected a number.")
     return v
@@ -19,7 +23,13 @@ def _num(v, name):
 def _nums(a, name):
     if not isinstance(a, list):
         raise InvalidArgumentsError(name, "Argument 1 was the wrong type. Expected an array of numbers.")
-    return [v for v in a if isinstance(v, (int, float)) and not isinstance(v, bool)]
+    import decimal as _dec
+
+    return [
+        v
+        for v in a
+        if isinstance(v, (int, float, _dec.Decimal)) and not isinstance(v, bool)
+    ]
 
 
 def _simple(name, fn):
@@ -61,6 +71,10 @@ def floor(ctx, v):
 @register("math::round")
 def round_(ctx, v):
     v = _num(v, "math::round")
+    import decimal as _dec
+
+    if isinstance(v, _dec.Decimal):
+        return int(v.quantize(_dec.Decimal(1), rounding=_dec.ROUND_HALF_UP))
     # round-half-away-from-zero (reference behavior)
     return int(math.floor(v + 0.5)) if v >= 0 else int(math.ceil(v - 0.5))
 

@@ -131,7 +131,9 @@ def is_datetime(ctx, v):
 
 @register("type::is::decimal")
 def is_decimal(ctx, v):
-    return isinstance(v, float)
+    import decimal as _dec
+
+    return isinstance(v, _dec.Decimal)
 
 
 @register("type::is::duration")

@@ -16,6 +16,7 @@ ids and index entries (numbers, strings, uuids, arrays, objects, things, ...).
 
 from __future__ import annotations
 
+import decimal as _decimal
 import math
 import struct
 import uuid as _uuid
@@ -120,6 +121,9 @@ def enc_value_key(v: Any) -> bytes:
         return bytes([T_NULL])
     if isinstance(v, bool):
         return bytes([T_TRUE if v else T_FALSE])
+    if isinstance(v, _decimal.Decimal):
+        # decimals ride the shared numeric ordering (f64 precision in keys)
+        v = int(v) if v == int(v) and -(2**63) <= v < 2**63 else float(v)
     if isinstance(v, (int, float)):
         # Ints and floats share one numeric ordering and one representation:
         # f64 ordering bytes + clamped i64 tie-break, so 1 and 1.0 (equal in

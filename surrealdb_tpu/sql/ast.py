@@ -12,6 +12,7 @@ nodes live in sql/statements.py.
 
 from __future__ import annotations
 
+import decimal as _dec
 import math
 import re as _re
 from typing import Any, List, Optional, Tuple
@@ -283,7 +284,7 @@ class UnaryOp(Expr):
     def compute(self, ctx):
         v = self.expr.compute(ctx)
         if self.op == "-":
-            if isinstance(v, bool) or not isinstance(v, (int, float)):
+            if isinstance(v, bool) or not isinstance(v, (int, float, _dec.Decimal)):
                 raise TypeError_(f"Can not negate {format_value(v)}")
             return -v
         if self.op == "+":
@@ -302,11 +303,28 @@ class UnaryOp(Expr):
 
 
 def _numeric(v, op: str):
-    if isinstance(v, bool) or not isinstance(v, (int, float)):
+    if isinstance(v, bool) or not isinstance(v, (int, float, _dec.Decimal)):
         raise TypeError_(
             f"Cannot perform arithmetic '{op}' on {format_value(v)}"
         )
     return v
+
+
+def _num_pair(l, r, op: str):
+    """Numeric operand pair with decimal promotion: mixing a decimal with a
+    float promotes the float (reference Number arithmetic, sql/number.rs —
+    decimal wins); int/Decimal interoperate natively."""
+    ln, rn = _numeric(l, op), _numeric(r, op)
+    if isinstance(ln, _dec.Decimal) and isinstance(rn, float):
+        rn = _dec.Decimal(repr(rn))
+    elif isinstance(rn, _dec.Decimal) and isinstance(ln, float):
+        ln = _dec.Decimal(repr(ln))
+    return ln, rn
+
+
+def _sum2(l, r, op: str):
+    ln, rn = _num_pair(l, r, op)
+    return ln + rn
 
 
 def _fuzzy_match(a: str, b: str) -> bool:
@@ -446,7 +464,7 @@ def apply_operator(op: str, l, r, ctx=None):
             return list(l) + list(r)
         if isinstance(l, (list, tuple)):
             return list(l) + [r]
-        return _numeric(l, op) + _numeric(r, op)
+        return _sum2(l, r, op)
     if op == "-":
         if isinstance(l, (Datetime, Duration)) and isinstance(r, (Datetime, Duration)):
             try:
@@ -457,26 +475,34 @@ def apply_operator(op: str, l, r, ctx=None):
                 )
         if isinstance(l, (list, tuple)):
             return [x for x in l if not value_eq(x, r)]
-        return _numeric(l, op) - _numeric(r, op)
+        ln, rn = _num_pair(l, r, op)
+        return ln - rn
     if op in ("*", "×"):
-        return _numeric(l, op) * _numeric(r, op)
+        ln, rn = _num_pair(l, r, op)
+        return ln * rn
     if op in ("/", "÷"):
-        ln, rn = _numeric(l, op), _numeric(r, op)
+        ln, rn = _num_pair(l, r, op)
         if rn == 0:
-            if isinstance(ln, int) and isinstance(rn, int):
-                raise TypeError_("Cannot divide by zero")
-            return math.nan if ln == 0 else math.copysign(math.inf, ln)
+            if isinstance(ln, float) or isinstance(rn, float):
+                return math.nan if ln == 0 else math.copysign(math.inf, ln)
+            raise TypeError_("Cannot divide by zero")
         if isinstance(ln, int) and isinstance(rn, int):
             q = ln // rn
             return q if q * rn == ln else ln / rn
         return ln / rn
     if op == "%":
-        ln, rn = _numeric(l, op), _numeric(r, op)
+        ln, rn = _num_pair(l, r, op)
         if rn == 0:
             raise TypeError_("Cannot divide by zero")
+        if isinstance(ln, _dec.Decimal) or isinstance(rn, _dec.Decimal):
+            return _dec.Decimal(ln) % _dec.Decimal(rn)
         return math.fmod(ln, rn) if isinstance(ln, float) or isinstance(rn, float) else ln - rn * int(ln / rn)
     if op == "**":
-        return _numeric(l, op) ** _numeric(r, op)
+        ln, rn = _num_pair(l, r, op)
+        try:
+            return ln**rn
+        except _dec.InvalidOperation:
+            raise TypeError_("Cannot raise to this power as a decimal")
     if op in ("IN", "INSIDE", "∈"):
         return _contains(r, l)
     if op in ("NOT IN", "NOTINSIDE", "∉"):

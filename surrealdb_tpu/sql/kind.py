@@ -137,10 +137,29 @@ def coerce(kind: Kind, v: Any, strict: bool = True) -> Any:
             except ValueError:
                 raise _err(v, "float")
         raise _err(v, "float")
-    if name in ("number", "decimal"):
+    if name == "decimal":
+        import decimal as _dec
+
         if isinstance(v, bool):
             raise _err(v, name)
-        if isinstance(v, (int, float)):
+        if isinstance(v, _dec.Decimal):
+            return v
+        if isinstance(v, int):
+            return _dec.Decimal(v)
+        if isinstance(v, float):
+            return _dec.Decimal(repr(v))
+        if not strict and isinstance(v, str):
+            try:
+                return _dec.Decimal(v)
+            except _dec.InvalidOperation:
+                raise _err(v, "decimal")
+        raise _err(v, "decimal")
+    if name == "number":
+        import decimal as _dec
+
+        if isinstance(v, bool):
+            raise _err(v, name)
+        if isinstance(v, (int, float, _dec.Decimal)):
             return v
         if not strict and isinstance(v, str):
             try:

@@ -250,6 +250,10 @@ def get_path(ctx, value, parts: List[Part]):
     if isinstance(value, Thing) and not isinstance(p, (POptional,)):
         if isinstance(p, PGraph):
             return _graph_part(ctx, [value], p, rest)
+        if isinstance(p, PMethod):
+            # record methods dispatch on the POINTER, not the fetched doc
+            # (reference record-type method table: exists/id/tb/table)
+            return _method_call(ctx, value, p, rest)
         value = _fetch_record(ctx, value)
 
     if isinstance(p, PStart):

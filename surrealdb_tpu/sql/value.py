@@ -13,6 +13,7 @@ Uuid < Array < Object < Geometry < Bytes < Thing.
 
 from __future__ import annotations
 
+import decimal as _decimal
 import math
 import random
 import string as _string
@@ -505,7 +506,7 @@ def type_ordinal(v) -> int:
         return _ORDINAL["null"]
     if isinstance(v, bool):
         return _ORDINAL["bool"]
-    if isinstance(v, (int, float)):
+    if isinstance(v, (int, float, _decimal.Decimal)):
         return _ORDINAL["number"]
     if isinstance(v, Table):
         return _ORDINAL["table"]
@@ -685,6 +686,8 @@ def format_value(v: Any, pretty: bool = False, _ind: int = 0) -> str:
         if v == int(v) and abs(v) < 1e15:
             return f"{int(v)}f"
         return repr(v) + "f"
+    if isinstance(v, _decimal.Decimal):
+        return format(v, "f") + "dec"
     if isinstance(v, int):
         return str(v)
     if isinstance(v, Table):
@@ -714,6 +717,10 @@ def to_json_value(v: Any) -> Any:
         return v
     if isinstance(v, float):
         return v
+    if isinstance(v, _decimal.Decimal):
+        # decimals render as JSON numbers (reference serde impl); exact
+        # values survive in the storage/wire ext codecs, not json
+        return int(v) if v == int(v) else float(v)
     if isinstance(v, (list, tuple)):
         return [to_json_value(x) for x in v]
     if isinstance(v, dict):

@@ -294,8 +294,12 @@ class Lexer:
             self.tokens.append(Token("DURATION", Duration.parse(total_text), start))
             return
         suffix = m.group(1)
-        if suffix == "f" or suffix == "dec":
-            self.tokens.append(Token("NUMBER", float(raw[: -len(suffix)]), start))
+        if suffix == "dec":
+            from decimal import Decimal
+
+            self.tokens.append(Token("NUMBER", Decimal(raw[:-3]), start))
+        elif suffix == "f":
+            self.tokens.append(Token("NUMBER", float(raw[:-1]), start))
         elif "." in raw or "e" in raw or "E" in raw:
             self.tokens.append(Token("NUMBER", float(raw), start))
         else:

@@ -1637,27 +1637,36 @@ class Parser:
         rhs = self.parse_expr(45)
         return A.MatchesOp(lhs, rhs, ref)
 
+    def _literal_methods(self, lit: A.Expr) -> A.Expr:
+        """Allow method calls directly on literals (`'abc'.len()`,
+        `5.is_int()`, `1w.days()` — reference idiom method dispatch)."""
+        if self.is_op(".") and self.peek(1).kind == "IDENT" and self.is_op("(", 2):
+            parts: List[P.Part] = [P.PStart(lit)]
+            self._idiom_tail(parts, graph=False)
+            return P.Idiom(parts)
+        return lit
+
     def _parse_prefix(self) -> A.Expr:
         t = self.peek()
         if t.kind == "NUMBER":
             self.next()
-            return A.Literal(t.value)
+            return self._literal_methods(A.Literal(t.value))
         if t.kind == "STRING":
             self.next()
             # record-id strings: "person:1" auto-parse? (reference keeps string)
-            return A.Literal(t.value)
+            return self._literal_methods(A.Literal(t.value))
         if t.kind == "DURATION":
             self.next()
-            return A.Literal(t.value)
+            return self._literal_methods(A.Literal(t.value))
         if t.kind == "DATETIME":
             self.next()
-            return A.Literal(t.value)
+            return self._literal_methods(A.Literal(t.value))
         if t.kind == "UUID":
             self.next()
-            return A.Literal(t.value)
+            return self._literal_methods(A.Literal(t.value))
         if t.kind == "BYTES":
             self.next()
-            return A.Literal(t.value)
+            return self._literal_methods(A.Literal(t.value))
         if t.kind == "PARAM":
             self.next()
             parts: List[P.Part] = [P.PStart(A.Param(t.value))]

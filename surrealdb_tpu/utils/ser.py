@@ -7,6 +7,7 @@ value kinds. This is the storage codec, not a wire format.
 
 from __future__ import annotations
 
+import decimal as _decimal
 import uuid as _uuid
 from typing import Any
 
@@ -35,6 +36,7 @@ EXT_UUID = 5
 EXT_GEOMETRY = 6
 EXT_RANGE = 7
 EXT_TABLE = 8
+EXT_DECIMAL = 9
 EXT_PYOBJ = 32  # AST nodes inside catalog definitions (Kind, Expr, ...)
 
 
@@ -54,6 +56,8 @@ def _default(v: Any, packer=None):
         return msgpack.ExtType(EXT_DURATION, msgpack.packb(v.nanos))
     if isinstance(v, Datetime):
         return msgpack.ExtType(EXT_DATETIME, msgpack.packb(v.nanos))
+    if isinstance(v, _decimal.Decimal):
+        return msgpack.ExtType(EXT_DECIMAL, str(v).encode())
     if isinstance(v, Uuid):
         return msgpack.ExtType(EXT_UUID, v.value.bytes)
     if isinstance(v, _uuid.UUID):
@@ -94,6 +98,8 @@ def _ext_hook(code: int, data: bytes, recurse=None):
         return Duration(msgpack.unpackb(data))
     if code == EXT_DATETIME:
         return Datetime(msgpack.unpackb(data))
+    if code == EXT_DECIMAL:
+        return _decimal.Decimal(data.decode())
     if code == EXT_UUID:
         return Uuid(_uuid.UUID(bytes=data))
     if code == EXT_GEOMETRY:

@@ -1,0 +1,81 @@
+"""Decimal Number support (reference: core/src/sql/number.rs — the Number
+enum's third variant; `1.5dec` literals, <decimal> casts, exact arithmetic,
+promotion rules decimal-beats-float)."""
+
+from decimal import Decimal
+
+import pytest
+
+from surrealdb_tpu.kvs.ds import Datastore
+
+
+def v(ds, sql, vars=None):
+    out = ds.execute(sql, vars=vars)
+    assert out[-1]["status"] == "OK", out[-1]
+    return out[-1]["result"]
+
+
+def test_decimal_literal_and_exact_arithmetic(ds):
+    assert v(ds, "RETURN 0.1dec + 0.2dec;") == Decimal("0.3")  # no float error
+    assert v(ds, "RETURN 1.1dec * 3;") == Decimal("3.3")
+    assert v(ds, "RETURN 10dec / 4;") == Decimal("2.5")
+    assert v(ds, "RETURN 7dec % 3;") == Decimal("1")
+    assert v(ds, "RETURN 2dec ** 10;") == Decimal("1024")
+    assert v(ds, "RETURN -1.5dec;") == Decimal("-1.5")
+
+
+def test_float_promotes_to_decimal(ds):
+    out = v(ds, "RETURN 1.5dec + 0.25f;")
+    assert isinstance(out, Decimal) and out == Decimal("1.75")
+
+
+def test_decimal_cast_and_type_checks(ds):
+    assert v(ds, "RETURN <decimal> '1.25';") == Decimal("1.25")
+    assert v(ds, "RETURN <decimal> 2;") == Decimal(2)
+    assert v(ds, "RETURN type::is::decimal(1.5dec);") is True
+    assert v(ds, "RETURN type::is::decimal(1.5f);") is False
+    assert v(ds, "RETURN 1.5dec.is_decimal();") is True
+
+
+def test_decimal_comparisons_and_ordering(ds):
+    assert v(ds, "RETURN 1.5dec = 1.5f;") is True
+    assert v(ds, "RETURN 2.5dec > 2;") is True
+    assert v(ds, "RETURN [2.5dec, 1dec, 2f].sort();") == [Decimal("1"), 2.0, Decimal("2.5")]
+
+
+def test_decimal_storage_roundtrip(ds):
+    v(ds, "CREATE t:1 SET d = 3.14dec;")
+    out = v(ds, "SELECT VALUE d FROM t:1;")
+    assert out == [Decimal("3.14")] and isinstance(out[0], Decimal)
+
+
+def test_decimal_field_kind(ds):
+    v(ds, "DEFINE FIELD price ON product TYPE decimal;")
+    v(ds, "CREATE product:1 SET price = 9.99;")
+    out = v(ds, "SELECT VALUE price FROM product:1;")
+    assert out == [Decimal("9.99")] and isinstance(out[0], Decimal)
+
+
+def test_decimal_division_by_zero_errors(ds):
+    out = ds.execute("RETURN 1dec / 0;")
+    assert out[-1]["status"] == "ERR"
+
+
+def test_decimal_math_functions(ds):
+    assert v(ds, "RETURN math::round(2.5dec);") == 3
+    assert v(ds, "RETURN math::abs(-2.5dec);") == Decimal("2.5")
+    assert v(ds, "RETURN math::sum([1.1dec, 2.2dec]);") == Decimal("3.3")
+
+
+def test_decimal_in_index_key(ds):
+    v(ds, "DEFINE INDEX p ON t FIELDS price;")
+    v(ds, "CREATE t:1 SET price = 1.5dec; CREATE t:2 SET price = 2.5dec;")
+    out = v(ds, "SELECT VALUE id FROM t WHERE price = 1.5dec;")
+    assert [x.id for x in out] == [1]
+
+
+def test_decimal_json_rendering(ds):
+    from surrealdb_tpu.sql.value import to_json_value
+
+    assert to_json_value(Decimal("1.5")) == 1.5
+    assert to_json_value(Decimal("2")) == 2

@@ -1,0 +1,76 @@
+"""Idiom method dispatch parity with the reference's per-type method
+tables (reference: core/src/fnc/mod.rs per-type `dispatch!` arms, e.g.
+`"is_array" => type::is::array`, `"vector_distance_knn" =>
+vector::distance::knn). The METHODS fixture below was extracted from the
+reference source; every name must resolve to a registered builtin through
+fnc.run_method's candidate expansion."""
+
+import numpy as np
+import pytest
+
+from surrealdb_tpu import fnc
+from surrealdb_tpu.sql.value import Datetime, Duration, Geometry, Thing
+
+# per-type method tables extracted from the reference fnc/mod.rs
+METHODS = {'array': ['add', 'all', 'any', 'append', 'at', 'boolean_and', 'boolean_not', 'boolean_or', 'boolean_xor', 'clump', 'combine', 'complement', 'concat', 'difference', 'distinct', 'every', 'fill', 'filter', 'filter_index', 'find', 'find_index', 'first', 'flatten', 'fold', 'group', 'includes', 'index_of', 'insert', 'intersect', 'is_empty', 'join', 'last', 'len', 'logical_and', 'logical_or', 'logical_xor', 'map', 'matches', 'max', 'min', 'pop', 'prepend', 'push', 'reduce', 'remove', 'reverse', 'shuffle', 'slice', 'some', 'sort', 'sort_asc', 'sort_desc', 'swap', 'transpose', 'union', 'vector_add', 'vector_angle', 'vector_cross', 'vector_distance_chebyshev', 'vector_distance_euclidean', 'vector_distance_hamming', 'vector_distance_knn', 'vector_distance_mahalanobis', 'vector_distance_manhattan', 'vector_distance_minkowski', 'vector_divide', 'vector_dot', 'vector_magnitude', 'vector_multiply', 'vector_normalize', 'vector_project', 'vector_scale', 'vector_similarity_cosine', 'vector_similarity_jaccard', 'vector_similarity_pearson', 'vector_similarity_spearman', 'vector_subtract', 'windows'], 'bytes': ['len'], 'duration': ['days', 'hours', 'micros', 'millis', 'mins', 'nanos', 'secs', 'weeks', 'years'], 'geometry': ['area', 'bearing', 'centroid', 'distance', 'hash_decode', 'hash_encode', 'is_valid'], 'record': ['exists', 'id', 'table', 'tb'], 'object': ['entries', 'keys', 'len', 'values'], 'number': ['abs', 'acos', 'acot', 'asin', 'atan', 'ceil', 'cos', 'cot', 'deg2rad', 'floor', 'ln', 'log', 'log10', 'log2', 'rad2deg', 'round', 'sign', 'sin', 'tan'], 'string': ['concat', 'contains', 'distance_damerau_levenshtein', 'distance_hamming', 'distance_levenshtein', 'distance_normalized_damerau_levenshtein', 'distance_normalized_levenshtein', 'ends_with', 'html_encode', 'html_sanitize', 'is_alpha', 'is_alphanum', 'is_ascii', 'is_datetime', 'is_domain', 'is_email', 'is_hexadecimal', 'is_ip', 'is_ipv4', 'is_ipv6', 'is_latitude', 'is_longitude', 'is_numeric', 'is_record', 'is_semver', 'is_ulid', 'is_url', 'is_uuid', 'join', 'len', 'lowercase', 'matches', 'repeat', 'replace', 'reverse', 'semver_compare', 'semver_inc_major', 'semver_inc_minor', 'semver_inc_patch', 'semver_major', 'semver_minor', 'semver_patch', 'semver_set_major', 'semver_set_minor', 'semver_set_patch', 'similarity_fuzzy', 'similarity_jaro', 'similarity_jaro_winkler', 'similarity_smithwaterman', 'similarity_sorensen_dice', 'slice', 'slug', 'split', 'starts_with', 'trim', 'uppercase', 'words'], 'datetime': ['ceil', 'day', 'floor', 'format', 'group', 'hour', 'is_leap_year', 'micros', 'millis', 'minute', 'month', 'nano', 'round', 'second', 'unix', 'wday', 'week', 'yday', 'year']}
+
+
+SAMPLES = {
+    "array": [1, 2, 3],
+    "string": "hello world",
+    "object": {"a": 1},
+    "record": Thing("t", 1),
+    "duration": Duration(90 * 10**9),
+    "datetime": Datetime(1700000000 * 10**9),
+    "number": 3,
+    "bytes": b"xy",
+    "geometry": None,  # resolution checked against the geo namespace
+}
+
+
+def _candidates(m, nss):
+    variants = [m]
+    parts = m.split("_")
+    for k in range(1, len(parts)):
+        variants.append("::".join(parts[:k]) + "::" + "_".join(parts[k:]))
+    out = [f"{ns}::{v}" for ns in nss for v in variants]
+    out += list(variants[1:])
+    out += [f"type::{v}" for v in variants]
+    if m.startswith("to_"):
+        out.append(f"type::{m[3:]}")
+    out.append(m)
+    return out
+
+
+@pytest.mark.parametrize("typ", sorted(METHODS))
+def test_all_reference_methods_resolve(typ):
+    recv = SAMPLES.get(typ)
+    nss = fnc._method_namespaces(recv) if recv is not None else ["geo"]
+    unresolved = [
+        m for m in METHODS[typ]
+        if not any(c in fnc.REGISTRY for c in _candidates(m, nss))
+    ]
+    assert unresolved == [], f"{typ}: {unresolved}"
+
+
+def test_method_execution_samples(ds):
+    """End-to-end method calls through SurrealQL for one method per type."""
+    def v(sql, vars=None):
+        out = ds.execute(sql, vars=vars)
+        assert out[-1]["status"] == "OK", out[-1]
+        return out[-1]["result"]
+
+    assert v("RETURN [1,2,3].len();") == 3
+    assert v("RETURN [1,2,2].distinct();") == [1, 2]
+    assert v("RETURN [3,4].vector_add([1,1]);") == [4, 5]
+    assert v("RETURN [0,1].vector_distance_euclidean([0,0]);") == 1
+    assert v("RETURN 'HeLLo'.lowercase();") == "hello"
+    assert v("RETURN 'kitten'.distance_levenshtein('sitting');") == 3
+    assert round(v("RETURN 'martha'.similarity_jaro_winkler('marhta');"), 3) == 0.961
+    assert v("RETURN 'abc'.is_alpha();") is True
+    assert v("RETURN { a: 1 }.keys();") == ["a"]
+    assert v("RETURN 5.is_int();") is True
+    assert v("RETURN '42'.to_int();") == 42
+    assert v("RETURN 1w2d.days();") == 9
+    assert v("RETURN d'2024-02-29T00:00:00Z'.is_leap_year();") is True
+    assert v("RETURN t:1.id();") == 1
