@@ -89,6 +89,10 @@ class SurrealHandler(BaseHTTPRequestHandler):
             body = json.dumps(to_json_value(payload)).encode()
         elif content_type == "application/msgpack":
             body = pack(payload)
+        elif content_type == "application/cbor":
+            from surrealdb_tpu.rpc import cbor as _cbor
+
+            body = _cbor.encode(payload)
         else:
             body = payload if isinstance(payload, bytes) else str(payload).encode()
         self.send_response(code)
@@ -430,7 +434,14 @@ class SurrealHandler(BaseHTTPRequestHandler):
         ct = (self.headers.get("Content-Type") or "application/json").split(";")[0]
         body = self._body()
         try:
-            req = wire_unpack(body) if ct == "application/msgpack" else json.loads(body)
+            if ct == "application/msgpack":
+                req = wire_unpack(body)
+            elif ct == "application/cbor":
+                from surrealdb_tpu.rpc import cbor as _cbor
+
+                req = _cbor.decode(body)
+            else:
+                req = json.loads(body)
         except Exception:
             return self._send(400, {"error": "invalid request body"})
         try:
@@ -452,17 +463,35 @@ class SurrealHandler(BaseHTTPRequestHandler):
             resp = {"id": rid, "error": {"code": -32000, "message": str(e)}}
         return self._send(200, resp, ct)
 
+    def _ws_encode(self, payload) -> bytes:
+        if getattr(self, "_ws_proto", None) == "cbor":
+            from surrealdb_tpu.rpc import cbor as _cbor
+
+            return _cbor.encode(payload)
+        return pack(payload)
+
     # ------------------------------------------------------------ websocket
     def _ws_upgrade(self):
         key = self.headers.get("Sec-WebSocket-Key")
         if not key:
             return self._send(400, {"error": "bad websocket request"})
+        # format negotiation via subprotocol (reference rpc/format/mod.rs:
+        # json | cbor | msgpack; binary frames use the negotiated codec)
+        offered = [
+            p.strip()
+            for p in (self.headers.get("Sec-WebSocket-Protocol") or "").split(",")
+            if p.strip()
+        ]
+        proto = next((p for p in offered if p in ("json", "cbor", "msgpack")), None)
         self.send_response(101, "Switching Protocols")
         self.send_header("Upgrade", "websocket")
         self.send_header("Connection", "Upgrade")
         self.send_header("Sec-WebSocket-Accept", wsproto.accept_key(key))
+        if proto:
+            self.send_header("Sec-WebSocket-Protocol", proto)
         self.end_headers()
         self.wfile.flush()
+        self._ws_proto = proto
 
         sock = self.connection
         sess = Session.anonymous()
@@ -492,7 +521,9 @@ class SurrealHandler(BaseHTTPRequestHandler):
                             continue
                         note = {"result": n.to_value()}
                         if fmt["binary"]:
-                            frame = wsproto.encode_frame(wsproto.OP_BINARY, pack(note))
+                            frame = wsproto.encode_frame(
+                                wsproto.OP_BINARY, self._ws_encode(note)
+                            )
                         else:
                             frame = wsproto.encode_frame(
                                 wsproto.OP_TEXT, json.dumps(to_json_value(note)).encode()
@@ -526,7 +557,14 @@ class SurrealHandler(BaseHTTPRequestHandler):
                     continue
                 fmt["binary"] = op == wsproto.OP_BINARY
                 try:
-                    req = wire_unpack(payload) if op == wsproto.OP_BINARY else json.loads(payload)
+                    if op != wsproto.OP_BINARY:
+                        req = json.loads(payload)
+                    elif getattr(self, "_ws_proto", None) == "cbor":
+                        from surrealdb_tpu.rpc import cbor as _cbor
+
+                        req = _cbor.decode(payload)
+                    else:
+                        req = wire_unpack(payload)
                 except Exception:
                     continue
                 rid = req.get("id")
@@ -543,7 +581,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 except SurrealError as e:
                     resp = {"id": rid, "error": {"code": -32000, "message": str(e)}}
                 if op == wsproto.OP_BINARY:
-                    frame = wsproto.encode_frame(wsproto.OP_BINARY, pack(resp))
+                    frame = wsproto.encode_frame(wsproto.OP_BINARY, self._ws_encode(resp))
                 else:
                     frame = wsproto.encode_frame(
                         wsproto.OP_TEXT, json.dumps(to_json_value(resp)).encode()

@@ -29,6 +29,11 @@ class HttpEngine:
         self.tls = u.scheme == "https"
         self.headers: Dict[str, str] = {}
         self._session_params: List[Any] = []
+        # wire format: msgpack (default) | cbor | json (reference SDKs
+        # negotiate per-connection, core/src/rpc/format/mod.rs)
+        self.format = opts.get("format", "msgpack")
+        if self.format not in ("msgpack", "cbor", "json"):
+            raise SurrealError(f"unknown wire format {self.format!r}")
 
     def rpc(self, method: str, params: List[Any]) -> Any:
         # HTTP is stateless: replay use/auth state as headers
@@ -53,16 +58,43 @@ class HttpEngine:
         cls = http.client.HTTPSConnection if self.tls else http.client.HTTPConnection
         return cls(self.host, self.port, timeout=timeout)
 
+    def _encode(self, body: Any) -> bytes:
+        if self.format == "cbor":
+            from surrealdb_tpu.rpc import cbor as _cbor
+
+            return _cbor.encode(body)
+        if self.format == "json":
+            import json as _json
+
+            from surrealdb_tpu.sql.value import to_json_value
+
+            return _json.dumps(to_json_value(body)).encode()
+        return pack(body)
+
+    def _decode(self, data: bytes) -> Any:
+        if self.format == "cbor":
+            from surrealdb_tpu.rpc import cbor as _cbor
+
+            return _cbor.decode(data)
+        if self.format == "json":
+            import json as _json
+
+            return _json.loads(data)
+        return unpack(data)
+
     def _post(self, path: str, body: Any) -> Any:
         conn = self._conn()
         try:
-            headers = {"Content-Type": "application/msgpack", **self.headers}
-            conn.request("POST", path, pack(body), headers)
+            headers = {
+                "Content-Type": f"application/{self.format}",
+                **self.headers,
+            }
+            conn.request("POST", path, self._encode(body), headers)
             r = conn.getresponse()
             data = r.read()
             if r.status == 401:
                 raise SurrealError("Authentication failed")
-            return unpack(data)
+            return self._decode(data)
         finally:
             conn.close()
 

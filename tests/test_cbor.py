@@ -1,0 +1,170 @@
+"""CBOR wire format (reference: core/src/rpc/format/cbor/convert.rs tag
+scheme; negotiation core/src/rpc/format/mod.rs json|cbor|msgpack)."""
+
+import uuid as _uuid
+from decimal import Decimal
+
+import pytest
+
+from surrealdb_tpu.rpc import cbor
+from surrealdb_tpu.sql.value import (
+    NONE,
+    Datetime,
+    Duration,
+    Geometry,
+    Null,
+    Range,
+    Table,
+    Thing,
+    Uuid,
+    is_none,
+    is_null,
+)
+
+
+def _rt(v):
+    return cbor.decode(cbor.encode(v))
+
+
+def test_roundtrip_scalars():
+    assert is_none(_rt(NONE))
+    assert is_null(_rt(Null))
+    assert _rt(True) is True and _rt(False) is False
+    assert _rt(0) == 0 and _rt(-7) == -7 and _rt(2**40) == 2**40
+    assert _rt(1.5) == 1.5
+    assert _rt("ünïcode") == "ünïcode"
+    assert _rt(b"\x00\x01") == b"\x00\x01"
+
+
+def test_roundtrip_containers():
+    assert _rt([1, "two", [3.5, None]]) == [1, "two", [3.5, Null]]
+    assert _rt({"a": 1, "b": {"c": [True]}}) == {"a": 1, "b": {"c": [True]}}
+
+
+def test_roundtrip_surreal_types():
+    t = _rt(Thing("person", 1))
+    assert isinstance(t, Thing) and t.tb == "person" and t.id == 1
+    t = _rt(Thing("p", "a:b c"))
+    assert t.id == "a:b c"
+    d = _rt(Duration(90 * 10**9 + 5))
+    assert isinstance(d, Duration) and d.nanos == 90 * 10**9 + 5
+    assert _rt(Duration(0)).nanos == 0
+    dt = _rt(Datetime(1700000000 * 10**9 + 123))
+    assert isinstance(dt, Datetime) and dt.nanos == 1700000000 * 10**9 + 123
+    u = Uuid(_uuid.uuid4())
+    assert _rt(u).value == u.value
+    tb = _rt(Table("person"))
+    assert isinstance(tb, Table) and str(tb) == "person"
+    dec = _rt(Decimal("3.14"))
+    assert isinstance(dec, Decimal) and dec == Decimal("3.14")
+
+
+def test_roundtrip_range_and_geometry():
+    r = _rt(Range(1, 10, True, False))
+    assert isinstance(r, Range) and (r.beg, r.end, r.beg_incl, r.end_incl) == (1, 10, True, False)
+    g = _rt(Geometry("Point", [1.0, 2.0]))
+    assert isinstance(g, Geometry) and g.kind == "Point" and g.coords == [1.0, 2.0]
+    g = _rt(Geometry("Polygon", [[[0.0, 0.0], [1.0, 0.0], [0.0, 1.0], [0.0, 0.0]]]))
+    assert g.kind == "Polygon"
+
+
+def test_decode_reference_spellings():
+    """Decode-side aliases SDKs may send: text record ids (tag 8), string
+    uuids (tag 9), string durations (tag 13), RFC3339 datetimes (tag 0)."""
+    # tag 8 + text
+    raw = bytes([0xC8]) + cbor.encode("person:42")
+    t = cbor.decode(raw)
+    assert isinstance(t, Thing) and t.id == 42
+    # tag 9 + text uuid
+    u = _uuid.uuid4()
+    raw = bytes([0xC9]) + cbor.encode(str(u))
+    assert cbor.decode(raw).value == u
+    # tag 13 + "1h30m"
+    raw = bytes([0xCD]) + cbor.encode("1h30m")
+    assert cbor.decode(raw).nanos == 5400 * 10**9
+    # tag 0 + RFC3339
+    raw = bytes([0xC0]) + cbor.encode("2024-01-01T00:00:00Z")
+    assert isinstance(cbor.decode(raw), Datetime)
+
+
+def test_indefinite_lengths_decode():
+    # indefinite array [1, 2] and indefinite text "ab"
+    assert cbor.decode(b"\x9f\x01\x02\xff") == [1, 2]
+    assert cbor.decode(b"\x7f\x61a\x61b\xff") == "ab"
+    assert cbor.decode(b"\xbf\x61a\x01\xff") == {"a": 1}
+
+
+# ------------------------------------------------------------------ wire
+@pytest.fixture()
+def server():
+    from surrealdb_tpu.net.server import serve
+
+    srv = serve("memory", port=0, auth_enabled=False).start_background()
+    yield srv
+    srv.shutdown()
+
+
+def test_http_rpc_cbor_negotiation(server):
+    import http.client
+
+    conn = http.client.HTTPConnection(server.host, server.port)
+    body = cbor.encode({"id": 1, "method": "query", "params": ["CREATE t:1 SET d = 2.5dec RETURN AFTER;"]})
+    conn.request(
+        "POST", "/rpc", body,
+        {"Content-Type": "application/cbor", "surreal-ns": "test", "surreal-db": "test"},
+    )
+    r = conn.getresponse()
+    assert r.status == 200
+    assert r.getheader("Content-Type") == "application/cbor"
+    out = cbor.decode(r.read())
+    row = out["result"][0]["result"][0]
+    assert isinstance(row["id"], Thing) and row["id"].id == 1
+    assert isinstance(row["d"], Decimal) and row["d"] == Decimal("2.5")
+    conn.close()
+
+
+def test_sdk_http_cbor_format(server):
+    from surrealdb_tpu.sdk import Surreal
+
+    db = Surreal(f"http://{server.host}:{server.port}", format="cbor")
+    db.use("test", "test")
+    db.query("CREATE t:9 SET v = 7;")
+    out = db.query("SELECT VALUE v FROM t:9;")
+    assert out[-1]["result"] == [7]
+
+
+def test_ws_cbor_subprotocol(server):
+    """A WS client negotiating the cbor subprotocol sends/receives cbor
+    binary frames."""
+    import socket
+
+    from surrealdb_tpu.net import ws as wsproto
+
+    sock = socket.create_connection((server.host, server.port), timeout=10)
+    key = "dGhlIHNhbXBsZSBub25jZQ=="
+    sock.sendall(
+        (
+            f"GET /rpc HTTP/1.1\r\nHost: {server.host}\r\nUpgrade: websocket\r\n"
+            f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Protocol: cbor\r\nSec-WebSocket-Version: 13\r\n\r\n"
+        ).encode()
+    )
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        resp += sock.recv(4096)
+    head = resp.split(b"\r\n\r\n")[0].decode()
+    assert "101" in head.splitlines()[0]
+    assert "Sec-WebSocket-Protocol: cbor" in head
+
+    use = cbor.encode({"id": 1, "method": "use", "params": ["test", "test"]})
+    sock.sendall(wsproto.encode_frame(wsproto.OP_BINARY, use, mask=True))
+    op, payload = wsproto.read_frame(sock)
+    assert op == wsproto.OP_BINARY and cbor.decode(payload)["id"] == 1
+
+    req = cbor.encode({"id": 2, "method": "query", "params": ["RETURN 1.5dec + 1dec;"]})
+    sock.sendall(wsproto.encode_frame(wsproto.OP_BINARY, req, mask=True))
+    op, payload = wsproto.read_frame(sock)
+    assert op == wsproto.OP_BINARY
+    out = cbor.decode(payload)
+    assert out["result"][0]["result"] == Decimal("2.5")
+    sock.close()
