@@ -237,7 +237,11 @@ def ingest_docs(ds, s, rng):
 def bench_graph_3hop(ds, s, rng):
     chain = "->knows->person->knows->person->knows->person"
     seeds = rng.integers(0, NP_NODES, size=5).tolist()
-    # calibrate edges traversed per seed = hop1 + hop2 + hop3 path counts
+    # calibrate edges traversed per seed = hop1 + hop2 + hop3 path counts.
+    # Calibration runs in CPU mode: the counts are identical and the device
+    # path would compile a distinct fused-chain shape per (seed, hops) pair
+    # (~15 XLA compiles) just to produce constants.
+    cpu_mode(True)
     edges_per_seed = {}
     for seed in seeds:
         tot = 0
@@ -246,6 +250,7 @@ def bench_graph_3hop(ds, s, rng):
             out = run(ds, s, f"SELECT count({c}) AS c FROM person:{seed}")
             tot += out[-1]["result"][0]["c"]
         edges_per_seed[seed] = tot
+    cpu_mode(False)
     queries = [(f"SELECT count({chain}) AS c FROM person:{seed}", None) for seed in seeds]
     qps, p50, _ = timed_queries(ds, s, queries)
     edges_total = sum(edges_per_seed.values())

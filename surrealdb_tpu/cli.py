@@ -203,9 +203,12 @@ def _ml(args) -> int:
 
     if args.ml_cmd == "import":
         db = _connect(args)
-        with open(args.file) as f:
-            spec = json.load(f)
-        entry = db.import_model(spec)
+        with open(args.file, "rb") as f:
+            raw = f.read()
+        if args.file.endswith(".surml") or raw[:1] not in (b"{", b"["):
+            entry = db.import_surml(raw)
+        else:
+            entry = db.import_model(json.loads(raw))
         print(f"model ml::{entry['name']}<{entry['version']}> stored", file=sys.stderr)
         return 0
     if args.ml_cmd == "export":

@@ -71,8 +71,9 @@ def import_model(ds, session, name: str, version: str, spec: dict) -> dict:
             "comment": None,
         }
         entry["blob"] = digest
-        entry["in_dim"] = int(spec["layers"][0]["w"].shape[0])
-        entry["out_dim"] = int(spec["layers"][-1]["w"].shape[1])
+        probe = CompiledModel(spec)
+        entry["in_dim"] = int(probe.in_dim)
+        entry["out_dim"] = int(probe.out_dim)
         txn.put_ml(ns, db, name, version, entry)
         txn.commit()
     except BaseException:
@@ -81,6 +82,31 @@ def import_model(ds, session, name: str, version: str, spec: dict) -> dict:
         raise
     invalidate(ds, ns, db, name, version)
     return entry
+
+
+def import_surml(ds, session, raw: bytes, name: str = "", version: str = "") -> dict:
+    """Import a surrealml `.surml` file (reference tests/*.surml fixtures):
+    parse the container, validate the embedded ONNX graph, persist. Name and
+    version default to the header's."""
+    from .surml import parse_surml
+
+    meta = parse_surml(raw)
+    spec = {
+        "format": "onnx",
+        "onnx": meta["onnx"],
+        "keys": meta["keys"],
+        "normalisers": meta["normalisers"],
+        "output": meta["output"],
+        "header": {
+            "name": meta["name"],
+            "version": meta["version"],
+            "description": meta["description"],
+            "engine": meta["engine"],
+        },
+    }
+    return import_model(
+        ds, session, name or meta["name"], version or meta["version"], spec
+    )
 
 
 def export_model(ds, session, name: str, version: str) -> dict:
@@ -95,6 +121,16 @@ def export_model(ds, session, name: str, version: str) -> dict:
     finally:
         txn.cancel()
     spec = spec_from_bytes(raw)
+    if spec["format"] == "onnx":
+        import base64
+
+        return {
+            "name": name,
+            "version": version,
+            "format": "onnx",
+            "keys": spec.get("keys") or [],
+            "onnx_base64": base64.b64encode(spec["onnx"]).decode(),
+        }
     return {
         "name": name,
         "version": version,
@@ -182,7 +218,27 @@ def run_model(ctx, name: str, version: str, args):
     check_model_permission(ctx, ns, db, name, version)
     if len(args) != 1:
         raise SurrealError("ml:: calls take exactly one argument")
-    mat, batched = _rows_from_arg(args[0], cm.in_dim)
+    arg = args[0]
+    # surml buffered compute: an object argument against an onnx spec with
+    # column keys maps through `keys` order with per-column normalisers and
+    # denormalises the output (reference surrealml buffered_compute)
+    keys = cm.spec.get("keys") if cm.spec.get("format") == "onnx" else None
+    if keys and isinstance(arg, dict):
+        from .surml import denormalise, normalise
+
+        norms = cm.spec.get("normalisers") or {}
+        row = []
+        for k in keys:
+            if k not in arg:
+                raise SurrealError(f"ml:: input object is missing key {k!r}")
+            row.append(normalise(float(arg[k]), norms.get(k)))
+        out = cm.forward(np.asarray([row], dtype=np.float32))
+        oname_norm = cm.spec.get("output")
+        onorm = oname_norm[1] if oname_norm else None
+        if cm.out_dim == 1:
+            return denormalise(float(out[0, 0]), onorm)
+        return [denormalise(float(x), onorm) for x in out[0]]
+    mat, batched = _rows_from_arg(arg, cm.in_dim)
     out = cm.forward(mat)
     if cm.out_dim == 1:
         vals = [float(v) for v in out[:, 0]]

@@ -33,6 +33,8 @@ _ACTS = ("relu", "tanh", "sigmoid", "softmax", None)
 def validate_spec(spec: dict) -> dict:
     """Normalize + sanity-check a model spec; returns the canonical dict."""
     fmt = spec.get("format")
+    if fmt == "onnx":
+        return _validate_onnx_spec(spec)
     if fmt not in ("linear", "mlp"):
         raise SurrealError(f"Unsupported model format {fmt!r}")
     layers = spec.get("layers") or []
@@ -60,8 +62,40 @@ def validate_spec(spec: dict) -> dict:
     return {"format": fmt, "layers": canon}
 
 
+def _validate_onnx_spec(spec: dict) -> dict:
+    """ONNX-backed spec (from a .surml import): parse once to verify the
+    graph and every operator is supported."""
+    from .onnx_mini import OnnxGraph
+
+    raw = spec.get("onnx")
+    if not isinstance(raw, bytes) or not raw:
+        raise SurrealError("onnx spec has no model bytes")
+    graph = OnnxGraph(raw)
+    graph.build_forward(np)(np.zeros((1, graph.in_dim), np.float32))  # op check
+    out = {
+        "format": "onnx",
+        "onnx": raw,
+        "keys": list(spec.get("keys") or []),
+        "normalisers": dict(spec.get("normalisers") or {}),
+        "output": spec.get("output"),
+        "header": dict(spec.get("header") or {}),
+    }
+    return out
+
+
 # ------------------------------------------------------------ serialization
 def spec_to_bytes(spec: dict) -> bytes:
+    if spec["format"] == "onnx":
+        return pack(
+            {
+                "format": "onnx",
+                "onnx": spec["onnx"],
+                "keys": spec.get("keys") or [],
+                "normalisers": spec.get("normalisers") or {},
+                "output": list(spec["output"]) if spec.get("output") else None,
+                "header": spec.get("header") or {},
+            }
+        )
     out = {"format": spec["format"], "layers": []}
     for layer in spec["layers"]:
         out["layers"].append(
@@ -77,6 +111,16 @@ def spec_to_bytes(spec: dict) -> bytes:
 
 def spec_from_bytes(raw: bytes) -> dict:
     d = unpack(raw)
+    if d.get("format") == "onnx":
+        out = dict(d)
+        if out.get("output"):
+            o = out["output"]
+            norm = o[1]
+            out["output"] = (o[0], (norm[0], list(norm[1])) if norm else None)
+        out["normalisers"] = {
+            k: (v[0], list(v[1])) for k, v in (out.get("normalisers") or {}).items()
+        }
+        return out
     layers = []
     for layer in d["layers"]:
         sh = tuple(layer["w_shape"])
@@ -113,20 +157,39 @@ class CompiledModel:
 
     def __init__(self, spec: dict):
         self.spec = spec
-        self.in_dim = spec["layers"][0]["w"].shape[0]
-        self.out_dim = spec["layers"][-1]["w"].shape[1]
+        self._graph = None
+        if spec["format"] == "onnx":
+            from .onnx_mini import OnnxGraph
+
+            self._graph = OnnxGraph(spec["onnx"])
+            self.in_dim = self._graph.in_dim
+            probe = self._graph.build_forward(np)(
+                np.zeros((1, self.in_dim), np.float32)
+            )
+            self.out_dim = int(probe.shape[1])
+        else:
+            self.in_dim = spec["layers"][0]["w"].shape[0]
+            self.out_dim = spec["layers"][-1]["w"].shape[1]
         self._jitted = None
         # forward invocations (each = one dispatch); the batched SELECT path
         # asserts one dispatch per table scan against this counter
         self.dispatches = 0
 
     def forward_host(self, x: np.ndarray) -> np.ndarray:
+        if self._graph is not None:
+            return np.asarray(self._graph.build_forward(np)(x.astype(np.float32)))
         h = x.astype(np.float32)
         for layer in self.spec["layers"]:
             h = _np_act(h @ layer["w"] + layer["b"], layer["activation"])
         return h
 
     def _device_fn(self):
+        if self._jitted is None and self._graph is not None:
+            import jax
+            import jax.numpy as jnp
+
+            self._jitted = jax.jit(self._graph.build_forward(jnp))
+            return self._jitted
         if self._jitted is None:
             import jax
             import jax.numpy as jnp

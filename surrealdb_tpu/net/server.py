@@ -372,8 +372,22 @@ class SurrealHandler(BaseHTTPRequestHandler):
             sess = self._system_session()
         except SurrealError as e:
             return self._send(401, {"error": str(e)})
+        body = self._body()
+        ct = (self.headers.get("Content-Type") or "").split(";")[0]
+        if ct == "application/octet-stream" or (body[:1] not in (b"{", b"[")):
+            # binary .surml upload (reference src/net/ml.rs import route)
+            from surrealdb_tpu.ml.exec import import_surml
+
+            try:
+                entry = import_surml(self.ds, sess, body)
+            except SurrealError as e:
+                return self._send(400, {"error": str(e)})
+            return self._send(
+                200,
+                {"name": entry["name"], "version": entry["version"], "blob": entry["blob"]},
+            )
         try:
-            spec = json.loads(self._body())
+            spec = json.loads(body)
         except json.JSONDecodeError:
             return self._send(400, {"error": "invalid JSON model spec"})
         from surrealdb_tpu.ml.exec import import_model

@@ -128,6 +128,10 @@ class Surreal:
     def import_(self, text: str) -> None:
         self._engine.import_(text)
 
+    def import_surml(self, raw: bytes) -> dict:
+        """Import a surrealml `.surml` model file."""
+        return self._engine.import_surml(raw)
+
     def import_model(self, spec: dict) -> dict:
         """Store an ML model (spec dict with weights) for ml:: calls."""
         return self._engine.import_model(spec)

@@ -58,6 +58,11 @@ class LocalEngine:
             self.ds, self.session, spec.get("name", ""), spec.get("version", ""), spec
         )
 
+    def import_surml(self, raw: bytes) -> dict:
+        from surrealdb_tpu.ml.exec import import_surml
+
+        return import_surml(self.ds, self.session, raw)
+
     def export_model(self, name: str, version: str) -> dict:
         from surrealdb_tpu.ml.exec import export_model
 

@@ -117,6 +117,21 @@ class HttpEngine:
         finally:
             conn.close()
 
+    def import_surml(self, raw: bytes) -> dict:
+        import json as _json
+
+        conn = self._conn(timeout=120)
+        try:
+            hdrs = {**self.headers, "Content-Type": "application/octet-stream"}
+            conn.request("POST", "/ml/import", raw, hdrs)
+            resp = conn.getresponse()
+            out = _json.loads(resp.read())
+            if resp.status != 200:
+                raise SurrealError(out.get("error", "model import failed"))
+            return out
+        finally:
+            conn.close()
+
     def import_model(self, spec: dict) -> dict:
         import json as _json
 
@@ -229,6 +244,21 @@ class WsEngine:
 
     def import_(self, text: str) -> None:
         raise SurrealError("import over WebSocket is not supported; use HTTP")
+
+    def import_surml(self, raw: bytes) -> dict:
+        import json as _json
+
+        conn = self._conn(timeout=120)
+        try:
+            hdrs = {**self.headers, "Content-Type": "application/octet-stream"}
+            conn.request("POST", "/ml/import", raw, hdrs)
+            resp = conn.getresponse()
+            out = _json.loads(resp.read())
+            if resp.status != 200:
+                raise SurrealError(out.get("error", "model import failed"))
+            return out
+        finally:
+            conn.close()
 
     def import_model(self, spec: dict) -> dict:
         raise SurrealError("model import over WebSocket is not supported; use HTTP")

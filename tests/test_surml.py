@@ -1,0 +1,172 @@
+"""`.surml` model file compatibility (reference: surrealml-core container +
+ONNX graph; fixtures /root/reference/tests/*.surml; core/src/sql/model.rs).
+Fixture-based tests skip when the reference checkout is absent."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from surrealdb_tpu.ml.onnx_mini import OnnxGraph
+from surrealdb_tpu.ml.surml import denormalise, normalise, parse_surml
+
+FIXTURE = "/root/reference/tests/linear_test.surml"
+needs_fixture = pytest.mark.skipif(
+    not os.path.exists(FIXTURE), reason="reference fixture not present"
+)
+
+
+def _mini_onnx_linear(w, b):
+    """Hand-assemble a tiny ONNX ModelProto: y = x @ w + b (protobuf wire)."""
+
+    def tag(field, wire):
+        return bytes([(field << 3) | wire])
+
+    def ld(field, payload):
+        out = tag(field, 2)
+        n = len(payload)
+        enc = b""
+        while True:
+            c = n & 0x7F
+            n >>= 7
+            enc += bytes([c | (0x80 if n else 0)])
+            if not n:
+                return out + enc + payload
+
+    def varint(field, v):
+        out = tag(field, 0)
+        enc = b""
+        while True:
+            c = v & 0x7F
+            v >>= 7
+            enc += bytes([c | (0x80 if v else 0)])
+            if not v:
+                return out + enc
+
+    def tensor(name, arr):
+        t = b""
+        for d in arr.shape:
+            t += varint(1, d)
+        t += varint(2, 1)  # float32
+        t += ld(8, name.encode())
+        t += ld(9, arr.astype("<f4").tobytes())
+        return t
+
+    w = np.asarray(w, np.float32)
+    b = np.asarray(b, np.float32)
+    node1 = ld(1, b"x") + ld(1, b"w") + ld(2, b"mm") + ld(4, b"MatMul")
+    node2 = ld(1, b"mm") + ld(1, b"b") + ld(2, b"y") + ld(4, b"Add")
+    vi_in = ld(1, b"x")
+    vi_out = ld(1, b"y")
+    graph = (
+        ld(1, node1) + ld(1, node2)
+        + ld(5, tensor("w", w)) + ld(5, tensor("b", b))
+        + ld(11, vi_in) + ld(12, vi_out)
+    )
+    return varint(1, 7) + ld(7, graph)
+
+
+def test_onnx_mini_forward_matches_numpy():
+    w = [[1.0, -1.0], [0.5, 2.0]]
+    b = [0.25, -0.25]
+    raw = _mini_onnx_linear(w, b)
+    g = OnnxGraph(raw)
+    x = np.array([[3.0, 4.0], [0.0, 1.0]], np.float32)
+    out = g.build_forward(np)(x)
+    np.testing.assert_allclose(out, x @ np.asarray(w, np.float32) + b, atol=1e-6)
+
+
+def test_onnx_mini_jax_forward():
+    import jax
+    import jax.numpy as jnp
+
+    raw = _mini_onnx_linear([[2.0], [3.0]], [1.0])
+    g = OnnxGraph(raw)
+    fwd = jax.jit(g.build_forward(jnp))
+    out = np.asarray(fwd(jnp.asarray([[1.0, 1.0]], jnp.float32)))
+    np.testing.assert_allclose(out, [[6.0]], atol=1e-6)
+
+
+def test_normalisers_roundtrip():
+    assert normalise(2120.0, ("z_score", [2120.0, 718.0529])) == 0.0
+    assert denormalise(0.0, ("z_score", [367000.0, 105550.94])) == 367000.0
+    assert normalise(5.0, ("linear_scaling", [0.0, 10.0])) == 0.5
+    assert denormalise(0.5, ("linear_scaling", [0.0, 10.0])) == 5.0
+
+
+@needs_fixture
+def test_parse_reference_fixture():
+    meta = parse_surml(open(FIXTURE, "rb").read())
+    assert meta["name"] == "Prediction"
+    assert meta["version"] == "0.0.1"
+    assert meta["keys"] == ["squarefoot", "num_floors"]
+    assert meta["normalisers"]["squarefoot"][0] == "z_score"
+    assert meta["output"][0] == "house_price"
+    g = OnnxGraph(meta["onnx"])
+    assert g.in_dim == 2
+    out = g.build_forward(np)(np.zeros((1, 2), np.float32))
+    assert out.shape == (1, 1)
+
+
+@needs_fixture
+def test_surml_import_and_compute(ds):
+    from surrealdb_tpu.ml.exec import import_surml
+    from surrealdb_tpu.dbs.session import Session
+
+    s = Session.owner()
+    entry = import_surml(ds, s, open(FIXTURE, "rb").read())
+    assert (entry["name"], entry["version"]) == ("Prediction", "0.0.1")
+    assert (entry["in_dim"], entry["out_dim"]) == (2, 1)
+
+    out = ds.execute("RETURN ml::Prediction<0.0.1>([1.0, 2.0]);")
+    assert out[-1]["status"] == "OK"
+    assert isinstance(out[-1]["result"], float)
+
+    # buffered compute: object keyed by column names, normalised in, output
+    # denormalised (surrealml buffered_compute semantics)
+    out = ds.execute(
+        "RETURN ml::Prediction<0.0.1>({squarefoot: 2120.0, num_floors: 2.0});"
+    )
+    assert out[-1]["status"] == "OK"
+    # at the normaliser means the model sees zeros: output = bias denormalised
+    meta = parse_surml(open(FIXTURE, "rb").read())
+    g = OnnxGraph(meta["onnx"])
+    bias_out = float(g.build_forward(np)(np.zeros((1, 2), np.float32))[0, 0])
+    expect = denormalise(bias_out, meta["output"][1])
+    assert abs(out[-1]["result"] - expect) < 1e-3
+
+
+@needs_fixture
+def test_surml_http_import(ds):
+    from surrealdb_tpu.net.server import serve
+
+    srv = serve("memory", port=0, auth_enabled=False).start_background()
+    try:
+        import http.client
+        import json
+
+        conn = http.client.HTTPConnection(srv.host, srv.port)
+        conn.request(
+            "POST", "/ml/import", open(FIXTURE, "rb").read(),
+            {
+                "Content-Type": "application/octet-stream",
+                "surreal-ns": "test", "surreal-db": "test",
+            },
+        )
+        r = conn.getresponse()
+        out = json.loads(r.read())
+        assert r.status == 200, out
+        assert out["name"] == "Prediction"
+        conn.close()
+    finally:
+        srv.shutdown()
+
+
+def test_surml_rejects_garbage():
+    from surrealdb_tpu.err import SurrealError
+
+    with pytest.raises(SurrealError):
+        parse_surml(b"xy")
+    with pytest.raises(SurrealError):
+        parse_surml(struct.pack(">I", 10_000) + b"short")
