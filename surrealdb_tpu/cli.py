@@ -31,6 +31,8 @@ def main(argv=None) -> int:
     p_start.add_argument("--user", "-u")
     p_start.add_argument("--pass", "-p", dest="password")
     p_start.add_argument("--unauthenticated", action="store_true")
+    p_start.add_argument("--profile", action="store_true",
+                         help="record timed spans around statements and kernel dispatches")
     # capability flags (reference: surreal start --allow-*/--deny-*)
     p_start.add_argument("--allow-all", "-A", dest="allow_all", action="store_const", const="all", default=None)
     p_start.add_argument("--deny-all", dest="deny_all", action="store_const", const="all", default=None)
@@ -120,6 +122,13 @@ def _start(args) -> int:
     from surrealdb_tpu.dbs.session import Session
 
     from surrealdb_tpu.dbs.capabilities import from_env_and_args
+
+    import os as _os
+
+    if getattr(args, "profile", False) or _os.environ.get("SURREAL_PROFILE") == "1":
+        from surrealdb_tpu import telemetry
+
+        telemetry.enable(True)
 
     host, _, port = args.bind.partition(":")
     srv = serve(

@@ -134,7 +134,10 @@ class DispatchQueue:
             self.batched += len(batch) - 1
         t0 = _time.perf_counter()
         try:
-            res = batch[0].runner([r.payload for r in batch])
+            from surrealdb_tpu import telemetry
+
+            with telemetry.span("dispatch_launch", batch=str(len(batch))):
+                res = batch[0].runner([r.payload for r in batch])
         except BaseException as e:  # propagate to every waiter
             self._fail(batch, e)
             return None
@@ -148,7 +151,10 @@ class DispatchQueue:
         def collect() -> None:
             t1 = _time.perf_counter()
             try:
-                results = res()
+                from surrealdb_tpu import telemetry
+
+                with telemetry.span("dispatch_collect"):
+                    results = res()
             except BaseException as e:
                 self._fail(batch, e)
                 return

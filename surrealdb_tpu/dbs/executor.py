@@ -186,8 +186,11 @@ class Executor:
         if own_txn:
             self._open(writeable)
         try:
+            from surrealdb_tpu import telemetry
+
             try:
-                result = stm.compute(ctx)
+                with telemetry.span("statement", kind=type(stm).__name__):
+                    result = stm.compute(ctx)
             except ReturnError as r:
                 result = r.value
             if own_txn:

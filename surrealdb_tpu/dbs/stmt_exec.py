@@ -308,6 +308,12 @@ def live_compute(ctx, stm) -> Any:
     txn.set(keys.live_query(ns, db, tb, live_id.encode()), pack_lq(lq))
     txn.invalidate_tb_lives(ns, db, tb)
     ds = ctx.ds()
+    # node-scoped pointer so surviving nodes can archive this LQ if this
+    # node dies (reference key::node::lq; kvs/node.py remove_archived)
+    txn.set(
+        keys.node_lq(ds.node_id.bytes, live_id.encode()),
+        pack({"ns": ns, "db": db, "tb": tb}),
+    )
     ds.enable_notifications()
     ds.notifications.subscribe(live_id)
     return Uuid(_uuid.UUID(live_id))
@@ -347,6 +353,8 @@ def kill_compute(ctx, stm) -> Any:
             txn.invalidate_tb_lives(ns, db, tb_def["name"])
             found = True
     ds = ctx.ds()
+    if found:
+        txn.delete(keys.node_lq(ds.node_id.bytes, live_id.encode()))
     if ds.notifications is not None:
         from .notification import Notification
 

@@ -60,6 +60,16 @@ def node_prefix() -> bytes:
     return b"/!nd"
 
 
+def node_lq(uuid_bytes: bytes, lq: bytes) -> bytes:
+    """Node-scoped live-query pointer (reference key::node::lq) — lets a
+    surviving node find and archive a dead node's live queries."""
+    return b"/!nl" + uuid_bytes + lq
+
+
+def node_lq_prefix(uuid_bytes: bytes = b"") -> bytes:
+    return b"/!nl" + uuid_bytes
+
+
 def root_user(user: str) -> bytes:
     return b"/!us" + enc_str(user)
 

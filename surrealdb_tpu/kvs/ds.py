@@ -134,12 +134,25 @@ class Datastore:
 
     # ------------------------------------------------------------ maintenance
     def tick(self) -> int:
-        """One maintenance pass (reference kvs/ds.rs tick): changefeed GC.
-        Called periodically by the server loop; embedded users may call it
-        directly. Returns the number of change entries collected."""
+        """One maintenance pass (reference kvs/ds.rs tick + the SDK's
+        background tasks engine/tasks.rs:45-51): refresh this node's
+        heartbeat, archive stale nodes, clean up dead nodes' live queries,
+        then changefeed GC. Called periodically by the server loop;
+        embedded users may call it directly. Returns the number of change
+        entries collected."""
         from surrealdb_tpu.cf.gc import gc_all
+        from surrealdb_tpu.kvs import node as _node
 
+        _node.heartbeat(self)
+        _node.expire_nodes(self)
+        _node.remove_archived(self)
         return gc_all(self)
+
+    def bootstrap(self) -> None:
+        """Startup membership protocol (reference ds.rs:623)."""
+        from surrealdb_tpu.kvs import node as _node
+
+        _node.bootstrap(self)
 
     def close(self) -> None:
         self.backend.close()

@@ -154,6 +154,15 @@ class SurrealHandler(BaseHTTPRequestHandler):
     @_capped
     def do_GET(self):
         path = urlparse(self.path).path
+        from surrealdb_tpu import telemetry
+
+        telemetry.inc("http_requests", method="GET", route=path.split("/")[1] or "root")
+        if path == "/metrics":
+            from surrealdb_tpu import telemetry
+
+            return self._send(
+                200, telemetry.render_prometheus().encode(), "text/plain"
+            )
         if path == "/health":
             if not self._route_allowed("health"):
                 return
@@ -198,6 +207,13 @@ class SurrealHandler(BaseHTTPRequestHandler):
 
     @_capped
     def do_POST(self):
+        from surrealdb_tpu import telemetry
+
+        telemetry.inc(
+            "http_requests",
+            method="POST",
+            route=urlparse(self.path).path.split("/")[1] or "root",
+        )
         path = urlparse(self.path).path
         if path == "/sql":
             if not self._route_allowed("sql"):
@@ -619,7 +635,14 @@ class Server:
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
-        # periodic maintenance (changefeed GC — reference engine/tasks.rs)
+        # node membership bootstrap (reference ds.rs:623): register this
+        # node and archive dead nodes' live queries
+        try:
+            ds.bootstrap()
+        except Exception:  # noqa: BLE001 — single-node boot must not die
+            pass
+        # periodic maintenance (heartbeat + membership + changefeed GC —
+        # reference engine/tasks.rs)
         self._tick_stop = threading.Event()
 
         def tick_loop():

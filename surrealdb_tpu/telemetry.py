@@ -1,0 +1,115 @@
+"""Telemetry: timed spans + prometheus-style metrics.
+
+Role of the reference's telemetry stack (reference: src/telemetry/mod.rs:
+43-99 — OTEL traces + HTTP/WS request metrics, RPC spans). This
+environment has no OTLP collector, so the equivalent surface is:
+
+- a process-global metrics registry (counters + duration histograms)
+  rendered in prometheus text format at GET /metrics;
+- span recording around statement execution and device dispatches,
+  enabled by `--profile` / SURREAL_PROFILE=1 (spans cost nothing when
+  disabled), drained via `snapshot()` or INFO-style inspection.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Deque, Dict, List, Optional, Tuple
+
+_lock = threading.Lock()
+_enabled = False
+_spans: Deque[Tuple[str, float, float]] = deque(maxlen=4096)  # (name, start, dur_s)
+_counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+_durations: Dict[str, List[float]] = {}  # name -> [count, total_s, max_s]
+
+
+def enable(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def inc(name: str, by: float = 1.0, **labels: str) -> None:
+    key = (name, tuple(sorted(labels.items())))
+    with _lock:
+        _counters[key] = _counters.get(key, 0.0) + by
+
+
+def observe(name: str, seconds: float) -> None:
+    with _lock:
+        d = _durations.get(name)
+        if d is None:
+            _durations[name] = [1.0, seconds, seconds]
+        else:
+            d[0] += 1
+            d[1] += seconds
+            d[2] = max(d[2], seconds)
+
+
+@contextmanager
+def span(name: str, **labels: str):
+    """Timed span: always feeds the duration metrics; records the individual
+    span only while profiling is enabled (reference #[instrument] spans)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dur = time.perf_counter() - t0
+        observe(name, dur)
+        if _enabled:
+            with _lock:
+                _spans.append((name, t0, dur))
+
+
+def snapshot() -> dict:
+    """Current metrics + (when profiling) recent spans."""
+    with _lock:
+        return {
+            "counters": {
+                name + (str(dict(labels)) if labels else ""): v
+                for (name, labels), v in _counters.items()
+            },
+            "durations": {
+                name: {"count": int(d[0]), "total_s": round(d[1], 6), "max_s": round(d[2], 6)}
+                for name, d in _durations.items()
+            },
+            "spans": [
+                {"name": n, "start": s, "dur_ms": round(dur * 1e3, 3)}
+                for n, s, dur in list(_spans)
+            ]
+            if _enabled
+            else [],
+        }
+
+
+def reset() -> None:
+    with _lock:
+        _counters.clear()
+        _durations.clear()
+        _spans.clear()
+
+
+def render_prometheus() -> str:
+    """Prometheus text exposition of counters + duration summaries
+    (reference telemetry/metrics/http/, ws/)."""
+    lines: List[str] = []
+    with _lock:
+        for (name, labels), v in sorted(_counters.items()):
+            lab = (
+                "{" + ",".join(f'{k}="{val}"' for k, val in labels) + "}"
+                if labels
+                else ""
+            )
+            lines.append(f"surreal_{name}_total{lab} {v:g}")
+        for name, d in sorted(_durations.items()):
+            base = f"surreal_{name}_duration_seconds"
+            lines.append(f"{base}_count {int(d[0])}")
+            lines.append(f"{base}_sum {d[1]:.6f}")
+            lines.append(f"{base}_max {d[2]:.6f}")
+    return "\n".join(lines) + "\n"

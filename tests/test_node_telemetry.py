@@ -1,0 +1,136 @@
+"""Node membership / failure detection (reference: core/src/kvs/node.rs,
+ds.rs:623-668) and telemetry metrics/spans (reference: src/telemetry/)."""
+
+import uuid as _uuid
+
+import pytest
+
+from surrealdb_tpu import telemetry
+from surrealdb_tpu.kvs import node as node_mod
+from surrealdb_tpu.kvs.ds import Datastore
+
+
+class FakeClock:
+    def __init__(self, t0: int = 10**18):
+        self.t = t0
+
+    def now_nanos(self) -> int:
+        return self.t
+
+
+def test_bootstrap_registers_node():
+    ds = Datastore("memory")
+    ds.bootstrap()
+    nodes = node_mod.list_nodes(ds)
+    assert [n["id"] for n in nodes] == [str(ds.node_id)]
+    assert nodes[0]["gc"] is False
+
+
+def test_stale_node_expires_and_lqs_archived():
+    """Two nodes share the keyspace; node B dies (stops heartbeating) and
+    its live query is cleaned up by node A's tick."""
+    clock = FakeClock()
+    a = Datastore("memory", clock=clock)
+    b = Datastore("memory", clock=clock)
+    b.backend = a.backend  # share storage: a two-node 'cluster'
+    a.bootstrap()
+    b.bootstrap()
+
+    # node B registers a live query
+    from surrealdb_tpu.dbs.session import Session
+
+    s = Session.owner()
+    s.rt = True
+    b.enable_notifications()
+    out = b.execute("LIVE SELECT * FROM t;", s)
+    assert out[-1]["status"] == "OK"
+    # its registration is visible through the shared keyspace
+    txn = a.transaction(False)
+    lives = txn.all_tb_lives("test", "test", "t")
+    txn.cancel()
+    assert len(lives) == 1
+
+    # B misses heartbeats; A ticks past the expiry window
+    clock.t += node_mod.DEFAULT_EXPIRY_NANOS + 1
+    node_mod.heartbeat(a)
+    archived = node_mod.expire_nodes(a)
+    assert archived == [str(b.node_id)]
+    cleaned = node_mod.remove_archived(a)
+    assert cleaned == 1
+
+    txn = a.transaction(False)
+    txn.invalidate_tb_lives("test", "test", "t")
+    lives = txn.all_tb_lives("test", "test", "t")
+    txn.cancel()
+    assert lives == []
+    # B's node record is gone; A survives
+    assert [n["id"] for n in node_mod.list_nodes(a)] == [str(a.node_id)]
+
+
+def test_tick_runs_membership(ds):
+    ds.bootstrap()
+    ds.tick()  # heartbeat + expire + cleanup + cf GC — must not raise
+    nodes = node_mod.list_nodes(ds)
+    assert len(nodes) == 1
+
+
+def test_kill_removes_node_pointer(ds):
+    from surrealdb_tpu import key as keys
+    from surrealdb_tpu.dbs.session import Session
+
+    s = Session.owner()
+    s.rt = True
+    ds.enable_notifications()
+    out = ds.execute("LIVE SELECT * FROM t;", s)
+    live_id = str(out[-1]["result"].value)
+    txn = ds.transaction(False)
+    assert txn.exists(keys.node_lq(ds.node_id.bytes, live_id.encode()))
+    txn.cancel()
+    ds.execute(f"KILL '{live_id}';", s)
+    txn = ds.transaction(False)
+    assert not txn.exists(keys.node_lq(ds.node_id.bytes, live_id.encode()))
+    txn.cancel()
+
+
+# ------------------------------------------------------------------ telemetry
+def test_metrics_record_statements(ds):
+    telemetry.reset()
+    ds.execute("CREATE t:1; SELECT * FROM t;")
+    snap = telemetry.snapshot()
+    assert any(k.startswith("statement") for k in snap["durations"])
+    text = telemetry.render_prometheus()
+    assert "surreal_statement_duration_seconds_count" in text
+
+
+def test_spans_only_when_profiling(ds):
+    telemetry.reset()
+    telemetry.enable(False)
+    ds.execute("CREATE t:1;")
+    assert telemetry.snapshot()["spans"] == []
+    telemetry.enable(True)
+    try:
+        ds.execute("CREATE t:2;")
+        spans = telemetry.snapshot()["spans"]
+        assert any(s["name"] == "statement" for s in spans)
+    finally:
+        telemetry.enable(False)
+
+
+def test_metrics_endpoint():
+    from surrealdb_tpu.net.server import serve
+
+    srv = serve("memory", port=0, auth_enabled=False).start_background()
+    try:
+        import http.client
+
+        conn = http.client.HTTPConnection(srv.host, srv.port)
+        conn.request("GET", "/health")
+        conn.getresponse().read()
+        conn.request("GET", "/metrics")
+        r = conn.getresponse()
+        body = r.read().decode()
+        assert r.status == 200
+        assert 'surreal_http_requests_total{method="GET",route="health"}' in body
+        conn.close()
+    finally:
+        srv.shutdown()
