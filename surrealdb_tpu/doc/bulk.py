@@ -134,6 +134,26 @@ def _insert_table_batch(ctx, plan: _TablePlan, batch, relation, ignore, out_kind
     txn = ctx.txn()
     ns, db = ctx.ns_db()
     tb = plan.tb
+    # Edge batches re-reference the same endpoint Things E/N times; memoize
+    # their msgpack ext encoding so the record serializer packs each endpoint
+    # once per batch instead of once per edge (a nested packb call per Thing).
+    _ext_memo: Dict[Tuple[str, Any], Any] = {}
+
+    def _thing_ext(t: Thing):
+        import msgpack
+
+        from surrealdb_tpu.utils.ser import EXT_THING
+
+        try:
+            hit = _ext_memo.get((t.tb, t.id))
+        except TypeError:  # unhashable id — pack directly
+            return msgpack.ExtType(EXT_THING, pack({"tb": t.tb, "id": t.id}))
+        if hit is None:
+            hit = _ext_memo[(t.tb, t.id)] = msgpack.ExtType(
+                EXT_THING, pack({"tb": t.tb, "id": t.id})
+            )
+        return hit
+
     kv_ix = [ix for ix in plan.indexes if ix["index"]["type"] in ("idx", "uniq")]
     vec_ix = [ix for ix in plan.indexes if ix["index"]["type"] in ("mtree", "hnsw")]
     ft_ix = [ix for ix in plan.indexes if ix["index"]["type"] == "search"]
@@ -166,7 +186,13 @@ def _insert_table_batch(ctx, plan: _TablePlan, batch, relation, ignore, out_kind
             current["id"] = rid
 
         sp = txn.savepoint() if (kv_ix and ignore) else None
-        txn.set(kb, pack(current))
+        if relation:
+            shadow = dict(current)
+            shadow["in"] = _thing_ext(current["in"])
+            shadow["out"] = _thing_ext(current["out"])
+            txn.set(kb, pack(shadow))
+        else:
+            txn.set(kb, pack(current))
         if relation:
             edge_writer.write(rid, current["in"], current["out"])
         try:

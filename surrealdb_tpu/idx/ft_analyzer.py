@@ -203,6 +203,33 @@ class Analyzer:
                 self.filters.append(_f_snowball(args[0] if args else "english"))
             # mapper (lemma files) accepted but inert until file loading lands
 
+        # Offset-free fast mode: a blank tokenizer with only case filters is
+        # exactly str.split() over the case-folded text. Bulk ingest (which
+        # never needs highlight offsets) rides this; any other pipeline
+        # falls back to the full analyzer.
+        names = [f["name"].lower() for f in (d.get("filters") or [])]
+        if self.tokenizers == ["blank"] and all(
+            n in ("lowercase", "uppercase") for n in names
+        ):
+            if "lowercase" in names:
+                self._fast = "lower"
+            elif "uppercase" in names:
+                self._fast = "upper"
+            else:
+                self._fast = "plain"
+        else:
+            self._fast = None
+
+    def terms_fast(self, text: str) -> List[str]:
+        """Term list without offsets — cheap path for bulk indexing."""
+        if self._fast == "lower":
+            return text.lower().split()
+        if self._fast == "upper":
+            return text.upper().split()
+        if self._fast == "plain":
+            return text.split()
+        return self.terms(text)
+
     def analyze(self, text: str) -> List[Token]:
         toks = _tok_blank(text)
         if "punct" in self.tokenizers:

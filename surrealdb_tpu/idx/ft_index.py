@@ -186,21 +186,46 @@ class FtIndex:
         metadata are merged in memory across the batch and written once per
         distinct term / once per batch, instead of the per-(term, doc)
         read-modify-write the single-document path pays."""
+        from collections import Counter
+
         st = self._stats(ctx)
         txn = ctx.txn()
         az = self.analyzer(ctx)
         ns, db = ctx.ns_db()
         term_cache: Dict[str, Optional[dict]] = {}
+        tid_enc: Dict[str, bytes] = {}  # term -> enc_u64(term id), batch-local
         touched: set = set()
         base = self._k(ctx, b"")
+        pbase = base + b"p"
+        hl = self.highlights
+        tset = txn.set
+        ft_delta = txn.ft_delta
 
         for rid, vals in batch:
-            tokens = self._tokens_of(az, vals)
-            if tokens is None:
-                continue
-            did = self._doc_id(ctx, rid, st, create=True)
-            tfs = _tf(tokens)
-            for term, (count, offs) in tfs.items():
+            if hl:
+                tokens = self._tokens_of(az, vals)
+                if tokens is None:
+                    continue
+                tfs_full = _tf(tokens)
+                tf_counts: Dict[str, int] = {t: c for t, (c, _) in tfs_full.items()}
+                length = len(tokens)
+            else:
+                # offset-free fast path: bulk inserts never highlight, so
+                # the analyzer can skip span tracking entirely
+                terms = self._terms_of_fast(az, vals)
+                if terms is None:
+                    continue
+                tfs_full = None
+                tf_counts = Counter(terms)
+                length = len(terms)
+            # records on this path are verified-new (the bulk inserter checked
+            # existence), so the doc-id mapping cannot exist: allocate blind
+            did = st["nd"]
+            st["nd"] += 1
+            did_enc = enc_u64(did)
+            tset(base + b"d" + enc_value_key(rid), pack(did))
+            tset(base + b"r" + did_enc, pack(rid))
+            for term, count in tf_counts.items():
                 meta = term_cache.get(term)
                 if meta is None and term not in term_cache:
                     meta = self._term(ctx, term)
@@ -211,18 +236,17 @@ class FtIndex:
                     term_cache[term] = meta
                 meta["df"] += 1
                 touched.add(term)
-                txn.set(
-                    base + b"p" + enc_u64(meta["id"]) + enc_u64(did),
-                    pack_posting(count, offs if self.highlights else None),
+                te = tid_enc.get(term)
+                if te is None:
+                    te = tid_enc[term] = enc_u64(meta["id"])
+                tset(
+                    pbase + te + did_enc,
+                    pack_posting(count, tfs_full[term][1] if tfs_full else None),
                 )
-            length = len(tokens)
-            txn.set(self._k(ctx, b"l" + enc_u64(did)), pack(length))
+            tset(base + b"l" + did_enc, pack(length))
             st["tl"] += length
             st["dc"] += 1
-            txn.ft_delta(
-                ns, db, self.tb, self.name, rid, None,
-                {t: c for t, (c, _) in tfs.items()}, length,
-            )
+            ft_delta(ns, db, self.tb, self.name, rid, None, dict(tf_counts), length)
 
         for term in touched:
             self._put_term(ctx, term, term_cache[term])
@@ -239,6 +263,20 @@ class FtIndex:
                 if isinstance(item, str):
                     found = True
                     out.extend(az.analyze(item))
+        return out if found else None
+
+    def _terms_of_fast(self, az: Analyzer, vals) -> Optional[list]:
+        """Offset-free twin of _tokens_of (term strings only)."""
+        if vals is None:
+            return None
+        out: List[str] = []
+        found = False
+        for v in vals:
+            items = v if isinstance(v, list) else [v]
+            for item in items:
+                if isinstance(item, str):
+                    found = True
+                    out.extend(az.terms_fast(item))
         return out if found else None
 
     # ------------------------------------------------------------ search

@@ -55,8 +55,9 @@ def dec_bytes(buf: bytes, pos: int) -> Tuple[bytes, int]:
     raise ValueError("unterminated string in key")
 
 
-def enc_u64(v: int) -> bytes:
-    return struct.pack(">Q", v)
+# direct C-level bound method: enc_u64 is the hottest key helper (once per
+# posting/tree-node id); a Python wrapper frame would double its cost
+enc_u64 = struct.Struct(">Q").pack
 
 
 def dec_u64(buf: bytes, pos: int) -> Tuple[int, int]:
@@ -110,11 +111,34 @@ T_THING = 0x60
 ARRAY_END = 0x01  # sorts before any tag so shorter arrays order first
 
 
+_M64 = (1 << 64) - 1
+_SIGN = 1 << 63
+_pack_dd = struct.Struct(">d").pack
+_unpack_q = struct.Struct(">Q").unpack
+_pack_num = struct.Struct(">BQQ").pack
+
+
+def _enc_int_key(v: int) -> bytes:
+    """Hot path: int ids dominate record keys during bulk ingest."""
+    bits = _unpack_q(_pack_dd(float(v)))[0]
+    bits = (~bits & _M64) if bits & _SIGN else (bits | _SIGN)
+    return _pack_num(T_NUMBER, bits, (v ^ _SIGN) & _M64)
+
+
 def enc_value_key(v: Any) -> bytes:
     """Order-preserving encoding of a Value for use inside keys."""
+    t = type(v)
+    if t is int:  # bool has type bool, not int, under an exact type check
+        if not (-_SIGN <= v < _SIGN):
+            raise ValueError("integer key component out of i64 range")
+        return _enc_int_key(v)
+    if t is str:
+        return bytes([T_STRAND]) + enc_str(v)
     # Imported lazily to avoid a cycle (sql.value imports nothing from here).
     from surrealdb_tpu.sql.value import Thing, Duration, Datetime, Uuid, NONE, Null
 
+    if t is Thing:
+        return bytes([T_THING]) + enc_str(v.tb) + enc_value_key(v.id)
     if v is NONE or isinstance(v, type(NONE)):
         return bytes([T_NONE])
     if v is None or v is Null or isinstance(v, type(Null)):

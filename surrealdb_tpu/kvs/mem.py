@@ -107,13 +107,16 @@ class MemTransaction(BackendTransaction):
         store = self.store
         with store.lock:
             # first-committer-wins: conflict iff any written key changed
-            # after our snapshot
+            # after our snapshot. Nothing at all committed since our snapshot
+            # (store.version unchanged) ⇒ no key can have — skip the scan;
+            # bulk ingest commits hundreds of thousands of keys per txn.
             data = store.data
-            for key in self.writes:
-                chain = data.get(key)
-                if chain is not None and chain[-1][0] > self.snapshot:
-                    self._finish()
-                    raise TxConflictError()
+            if store.version != self.snapshot:
+                for key in self.writes:
+                    chain = data.get(key)
+                    if chain is not None and chain[-1][0] > self.snapshot:
+                        self._finish()
+                        raise TxConflictError()
             if self.writes:
                 store.version += 1
                 ver = store.version

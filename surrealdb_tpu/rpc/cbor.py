@@ -167,6 +167,9 @@ def _enc(v: Any, out: bytearray) -> None:
         for item in v:
             _enc(item, out)
         return
+    if type(v).__name__ == "ndarray":  # packed vector -> plain CBOR array
+        _enc(v.tolist(), out)
+        return
     if isinstance(v, dict):
         out += _head(5, len(v))
         for k, item in v.items():

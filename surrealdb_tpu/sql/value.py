@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import decimal as _decimal
 import math
+import os as _os
 import random
 import string as _string
 import uuid as _uuid
@@ -87,11 +88,14 @@ def is_nullish(v) -> bool:
 
 # ----------------------------------------------------------------- Thing (record id)
 _ID_CHARS = _string.ascii_lowercase + _string.digits
+# byte -> id-char translation table; one urandom + translate per id is ~10x
+# cheaper than 20 random.choices draws (hot in bulk RELATE ingest)
+_ID_TABLE = bytes(ord(_ID_CHARS[b % 36]) for b in range(256))
 
 
 def generate_record_id() -> str:
     """20-char random id, same shape the reference generates for `CREATE tb`."""
-    return "".join(random.choices(_ID_CHARS, k=20))
+    return _os.urandom(20).translate(_ID_TABLE).decode("ascii")
 
 
 class Thing:
@@ -697,6 +701,8 @@ def format_value(v: Any, pretty: bool = False, _ind: int = 0) -> str:
     if isinstance(v, (list, tuple)):
         inner = ", ".join(format_value(x, pretty, _ind + 1) for x in v)
         return f"[{inner}]"
+    if type(v).__name__ == "ndarray":  # packed vector formats like its array
+        return format_value(v.tolist(), pretty, _ind)
     if isinstance(v, dict):
         items = ", ".join(
             f"{escape_ident(k)}: {format_value(x, pretty, _ind + 1)}" for k, x in v.items()
@@ -723,6 +729,8 @@ def to_json_value(v: Any) -> Any:
         return int(v) if v == int(v) else float(v)
     if isinstance(v, (list, tuple)):
         return [to_json_value(x) for x in v]
+    if type(v).__name__ == "ndarray":  # packed vector -> plain JSON array
+        return v.tolist()
     if isinstance(v, dict):
         return {k: to_json_value(x) for k, x in v.items()}
     if isinstance(v, Thing):

@@ -37,7 +37,32 @@ EXT_GEOMETRY = 6
 EXT_RANGE = 7
 EXT_TABLE = 8
 EXT_DECIMAL = 9
+EXT_VEC = 10  # packed numeric vector (numpy 1-D), reference trees/vector.rs:23
 EXT_PYOBJ = 32  # AST nodes inside catalog definitions (Kind, Expr, ...)
+
+# packed-vector dtype whitelist: order is the wire code
+_VEC_DTYPES = ("f4", "f8", "i8", "i4", "i2")
+
+
+def _pack_vec(v) -> msgpack.ExtType:
+    import numpy as np
+
+    if v.ndim != 1:
+        raise TypeError("only 1-D numeric arrays are storable as packed vectors")
+    code = v.dtype.str[1:]  # e.g. '<f4' -> 'f4'
+    if code not in _VEC_DTYPES:
+        v = np.asarray(v, dtype=np.float32)
+        code = "f4"
+    return msgpack.ExtType(
+        EXT_VEC, bytes([_VEC_DTYPES.index(code)]) + np.ascontiguousarray(v).tobytes()
+    )
+
+
+def _unpack_vec(data: bytes):
+    import numpy as np
+
+    dt = np.dtype(_VEC_DTYPES[data[0]])
+    return np.frombuffer(data[1:], dtype=dt)
 
 
 def _default(v: Any, packer=None):
@@ -73,6 +98,8 @@ def _default(v: Any, packer=None):
         return msgpack.ExtType(EXT_TABLE, str(v).encode())
     if isinstance(v, tuple):
         return list(v)
+    if type(v).__name__ == "ndarray" and type(v).__module__ == "numpy":
+        return _pack_vec(v)
     # catalog definitions embed AST nodes (field kinds, VALUE/ASSERT exprs,
     # view selects); these are engine-internal values, pickled as-is
     mod = type(v).__module__
@@ -110,6 +137,8 @@ def _ext_hook(code: int, data: bytes, recurse=None):
         return Range(d["b"], d["e"], d["bi"], d["ei"])
     if code == EXT_TABLE:
         return Table(data.decode())
+    if code == EXT_VEC:
+        return _unpack_vec(data)
     if code == EXT_PYOBJ:
         import pickle
 
@@ -131,7 +160,9 @@ def _wire_default(v: Any):
     # Network-facing encode: never pickle engine internals onto the wire —
     # at any nesting depth. Anything the storage codec would pickle is
     # degraded to its SurrealQL string form so msgpack clients always
-    # receive decodable frames.
+    # receive decodable frames. Packed vectors degrade to plain arrays.
+    if type(v).__name__ == "ndarray" and type(v).__module__ == "numpy":
+        return v.tolist()
     out = _default(v, packer=wire_pack)
     if isinstance(out, msgpack.ExtType) and out.code == EXT_PYOBJ:
         return repr(v)
