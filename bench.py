@@ -111,9 +111,11 @@ def measure_rtt() -> float:
 
 
 def vec_rows(vecs, ids, flag_every=0):
+    # embeddings stay numpy end-to-end (packed-vector values, ser.py EXT_VEC):
+    # no tolist()/asarray round trip per row
     rows = []
     for j, i in enumerate(ids):
-        r = {"id": int(i), "emb": vecs[j].tolist()}
+        r = {"id": int(i), "emb": vecs[j]}
         if flag_every:
             r["flag"] = bool(i % flag_every == 0)
         rows.append(r)
@@ -301,6 +303,34 @@ def _knn_ground_truth(corpus, queries, k):
     return np.take_along_axis(best_i, order, axis=1)
 
 
+def kick_ann_warmup(ds, s, corpus):
+    """Fire one kNN query in a background thread: builds the device mirror
+    and kicks background IVF training, overlapping both with the remaining
+    ingest + configs so no timed section pays the training cliff."""
+    import threading
+
+    sql = "SELECT id FROM item WHERE emb <|10,64|> $q"
+
+    def warm():
+        try:
+            run(ds, s, sql, {"q": corpus[0].tolist()})
+        except Exception as e:  # noqa: BLE001
+            log(f"ann warmup failed: {e}")
+
+    t = threading.Thread(target=warm, daemon=True)
+    t.start()
+    return t
+
+
+def wait_ann_ready(ds, timeout=600):
+    mirror = ds.index_stores.get("bench", "bench", "item", "iemb")
+    if mirror is None:
+        return None
+    if not mirror.wait_ivf(timeout):
+        log("knn: WARNING — IVF training did not finish; exact path serves")
+    return mirror
+
+
 def bench_knn(ds, s, corpus, rng):
     from surrealdb_tpu import cnf
 
@@ -311,11 +341,8 @@ def bench_knn(ds, s, corpus, rng):
     sql = f"SELECT id FROM item WHERE emb <|{k},64|> $q"
     queries = [(sql, {"q": qs[i].tolist()}) for i in range(nq)]
 
-    log("knn: warmup (mirror build + background IVF training)")
-    run(ds, s, sql, queries[0][1])  # builds mirror, kicks IVF training
-    mirror = ds.index_stores.get("bench", "bench", "item", "iemb")
-    if mirror is not None and not mirror.wait_ivf(600):
-        log("knn: WARNING — IVF training did not finish; timing exact path")
+    log("knn: waiting for IVF (trained during ingest)")
+    mirror = wait_ann_ready(ds)
     log("knn: IVF timed pass")
     ivf_qps, ivf_p50, results = timed_queries(ds, s, queries, warmup=1)
 
@@ -363,20 +390,59 @@ def bench_knn(ds, s, corpus, rng):
     exact_qps, exact_p50, _ = timed_queries(ds, s, queries[:8], warmup=1)
     cnf.TPU_ANN_MIN_ROWS = saved
 
-    log("knn: cpu baseline (exact host)")
+    # -- honest CPU baselines -------------------------------------------
+    # (a) CPU-ANN: the engine's ivf-host strategy (same IVF, probe + exact
+    #     rerank in numpy) — the sublinear competitor the 10x claim is
+    #     judged against; measured sequentially AND with the same
+    #     concurrency as the device pass.
+    # (b) CPU exact full scan: reported for reference only.
+    log("knn: cpu-ANN baseline (ivf-host)")
     cpu_mode(True)
+    cpu_ann_qps, cpu_ann_p50, cres = timed_queries(ds, s, queries[:8], warmup=1)
+
+    cerrors = []
+    cbarrier = threading.Barrier(nthreads + 1)
+
+    def cpu_client(i):
+        cbarrier.wait()
+        try:
+            run(ds, s, sql, {"q": cqs[i * rounds].tolist()})
+        except Exception as e:  # noqa: BLE001
+            cerrors.append(e)
+
+    cthreads = [threading.Thread(target=cpu_client, args=(i,)) for i in range(nthreads)]
+    for t in cthreads:
+        t.start()
+    cbarrier.wait()
     t0 = time.perf_counter()
-    for sql_, v in queries[:3]:
-        run(ds, s, sql_, v)
-    cpu_qps = 3 / (time.perf_counter() - t0)
+    for t in cthreads:
+        t.join()
+    cpu_ann_conc_qps = (nthreads - len(cerrors)) / (time.perf_counter() - t0)
+
+    log("knn: cpu exact full scan (reference point)")
+    saved_min = cnf.TPU_ANN_MIN_ROWS
+    cnf.TPU_ANN_MIN_ROWS = 1 << 62  # hide IVF: force the exact host scan
+    t0 = time.perf_counter()
+    run(ds, s, sql, queries[0][1])
+    cpu_exact_qps = 1 / (time.perf_counter() - t0)
+    cnf.TPU_ANN_MIN_ROWS = saved_min
     cpu_mode(False)
 
+    # CPU-ANN recall over the same queries (it probes the same lists, so
+    # this also validates the baseline is doing comparable work)
+    chits = 0
+    for i, res in enumerate(cres):
+        got = {int(str(r["id"]).split(":")[1]) for r in res}
+        chits += len(got & set(gt[i].tolist()))
+    cpu_ann_recall = chits / (len(cres) * k)
+
+    vsb = conc_qps / cpu_ann_conc_qps if cpu_ann_conc_qps else None
     emit(
         {
             "metric": f"knn_qps_recall{int(recall * 100)}_{NI}x{D}",
             "value": round(conc_qps, 2),
             "unit": "qps",
-            "vs_baseline": round(conc_qps / cpu_qps, 2) if cpu_qps else None,
+            "vs_baseline": round(vsb, 2) if vsb else None,
             "recall_at_10": round(recall, 4),
             "single_stream_qps": round(ivf_qps, 2),
             "p50_ms": round(ivf_p50, 1),
@@ -386,10 +452,14 @@ def bench_knn(ds, s, corpus, rng):
             ),
             "exact_device_qps": round(exact_qps, 2),
             "exact_device_p50_ms": round(exact_p50, 1),
-            "cpu_qps": round(cpu_qps, 3),
+            "cpu_ann_qps": round(cpu_ann_qps, 2),
+            "cpu_ann_conc_qps": round(cpu_ann_conc_qps, 2),
+            "cpu_ann_p50_ms": round(cpu_ann_p50, 1),
+            "cpu_ann_recall_at_10": round(cpu_ann_recall, 4),
+            "cpu_exact_qps": round(cpu_exact_qps, 3),
         }
     )
-    return (conc_qps / cpu_qps if cpu_qps else None), conc_qps, recall
+    return vsb, conc_qps, recall
 
 
 def bench_bm25(ds, s, rng):
@@ -513,27 +583,20 @@ def main() -> None:
 
     ratios = []
     knn_qps, knn_recall = None, None
+    state = {"corpus": None}
 
-    corpus = None
-    if CONFIGS & {"2", "4", "5"}:
-        corpus = gen_corpus(NI, D)
-        ingest_items(ds, s, corpus)
-    if "4" in CONFIGS:
-        ingest_hybrid_edges(ds, s, rng)
-    if "1" in CONFIGS:
-        ingest_person_graph(ds, s, rng)
-    if "3" in CONFIGS:
-        ingest_docs(ds, s, rng)
+    # Schedule: least-measured configs first, each config's ingest lazily
+    # right before it, and IVF training overlapped with ingest/configs that
+    # do not need it (kicked right after the item corpus lands).
+    def need_corpus():
+        if state["corpus"] is None:
+            state["corpus"] = gen_corpus(NI, D)
+            ingest_items(ds, s, state["corpus"])
+            kick_ann_warmup(ds, s, state["corpus"])
+        return state["corpus"]
 
-    for cfg, fn in (
-        ("2", lambda: bench_knn(ds, s, corpus, rng)),
-        ("1", lambda: bench_graph_3hop(ds, s, rng)),
-        ("3", lambda: bench_bm25(ds, s, rng)),
-        ("4", lambda: bench_hybrid(ds, s, corpus, rng)),
-        ("5", lambda: bench_ml_scan(ds, s, rng)),
-    ):
-        if cfg not in CONFIGS:
-            continue
+    def run_cfg(cfg, fn):
+        nonlocal knn_qps, knn_recall
         log(f"config {cfg} start")
         try:
             r = fn()
@@ -547,6 +610,23 @@ def main() -> None:
             traceback.print_exc(file=sys.stderr)
             emit({"metric": f"config{cfg}", "value": None, "unit": "error", "vs_baseline": None, "error": str(e)[:200]})
         log(f"config {cfg} done")
+
+    if "3" in CONFIGS:
+        ingest_docs(ds, s, rng)
+        run_cfg("3", lambda: bench_bm25(ds, s, rng))
+    if CONFIGS & {"2", "4", "5"}:
+        need_corpus()
+    if "5" in CONFIGS:
+        run_cfg("5", lambda: bench_ml_scan(ds, s, rng))
+    if "4" in CONFIGS:
+        ingest_hybrid_edges(ds, s, rng)
+        wait_ann_ready(ds)
+        run_cfg("4", lambda: bench_hybrid(ds, s, state["corpus"], rng))
+    if "2" in CONFIGS:
+        run_cfg("2", lambda: bench_knn(ds, s, state["corpus"], rng))
+    if "1" in CONFIGS:
+        ingest_person_graph(ds, s, rng)
+        run_cfg("1", lambda: bench_graph_3hop(ds, s, rng))
 
     geo = math.exp(sum(math.log(r) for r in ratios) / len(ratios)) if ratios else None
     emit(

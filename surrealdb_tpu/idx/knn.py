@@ -277,10 +277,21 @@ class VectorMirror:
         so it gets the same care)."""
         with self._lock:
             if self._host_cache is None or self._host_cache[0] != self.gen:
-                live = np.nonzero(self.alive[: self.n_slots])[0]
-                data = np.ascontiguousarray(self.data[live], dtype=np.float32)
-                norms = (data.astype(np.float64) ** 2).sum(axis=1).astype(np.float32)
-                rids = [self.rids[i] for i in live.tolist()]
+                n = self.n_slots
+                live = np.nonzero(self.alive[:n])[0]
+                if live.size == n:
+                    # fully-live slot space (the common bulk-ingest case):
+                    # serve the mirror array itself — a fancy-index here
+                    # would copy the whole corpus (GBs) for nothing
+                    data = np.ascontiguousarray(self.data[:n], dtype=np.float32)
+                    rids = list(self.rids[:n])
+                else:
+                    data = np.ascontiguousarray(self.data[live], dtype=np.float32)
+                    rids = [self.rids[i] for i in live.tolist()]
+                # f64 accumulation without materializing an f64 corpus copy
+                norms = np.einsum(
+                    "ij,ij->i", data, data, dtype=np.float64
+                ).astype(np.float32)
                 self._host_cache = (self.gen, data, norms, rids)
             return self._host_cache[1:]
 
@@ -653,12 +664,36 @@ class KnnPlan(_KnnExecutorMixin):
 
             dists, slots = ds.dispatch.submit(key, q, runner)
         else:
-            self.strategy = "exact-host"
-            data, norms, rids = mirror.host_search_view()
-            dists, li = D.knn_search_host(
-                q[None, :], data, metric, k, x_sq_norms=norms
-            )
-            dists, slots = dists[0], np.asarray(li)[0]
+            # CPU serving path: an already-trained quantizer serves ANN on
+            # host too (probe + exact rerank, idx/ivf.py search_host) — the
+            # same sublinear contract as the device path, and the honest
+            # CPU-ANN baseline for the bench. Never trains here (training
+            # needs the device matrix); exact scan otherwise.
+            ivf = mirror.ivf
+            if (
+                ivf is not None
+                and not ivf.needs_retrain()
+                and metric in ("euclidean", "cosine")
+                and n >= cnf.TPU_ANN_MIN_ROWS
+                and self.k * 4 <= n
+            ):
+                from surrealdb_tpu.idx.ivf import default_nprobe
+
+                self.strategy = "ivf-host"
+                ef = self.ef or self.ix["index"].get("efc")
+                data, alive, rids = mirror.host_view()
+                dists, li = ivf.search_host(
+                    q[None, :], data, metric, k,
+                    default_nprobe(ivf.nlists, ef),
+                )
+                dists, slots = dists[0], li[0]
+            else:
+                self.strategy = "exact-host"
+                data, norms, rids = mirror.host_search_view()
+                dists, li = D.knn_search_host(
+                    q[None, :], data, metric, k, x_sq_norms=norms
+                )
+                dists, slots = dists[0], np.asarray(li)[0]
         for d, s in zip(np.asarray(dists), np.asarray(slots)):
             if not np.isfinite(d) or s < 0 or s >= len(rids):
                 continue
