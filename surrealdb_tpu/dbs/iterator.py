@@ -215,7 +215,16 @@ class Iterator:
         self.stm = stm
         self.verb = verb
         self.entries: List[Any] = []
-        self.results: List[Any] = []
+        # SELECT results spill to disk past EXTERNAL_SORTING_BUFFER_LIMIT
+        # (reference dbs/result.rs:15 Memory|File, dbs/store/file.rs:18);
+        # mutating verbs keep plain lists (their outputs are the mutated
+        # rows the caller asked back for)
+        if verb == "select":
+            from surrealdb_tpu.dbs.store import ResultStore
+
+            self.results: Any = ResultStore()
+        else:
+            self.results = []
         self.cancel_on_limit: Optional[int] = None
         self.mutated = 0  # records actually processed (incl. RETURN NONE)
         # grouped SELECTs collect raw docs; projection happens per group
@@ -278,6 +287,8 @@ class Iterator:
         rows = self.results
         if verb == "select":
             rows = self._postprocess(rows)
+        elif not isinstance(rows, list):
+            rows = rows.to_list()
         return rows
 
     def _iterate_parallel(self) -> None:

@@ -81,7 +81,11 @@ def try_bulk_insert(ctx, stm, rows: List[dict], into_tb: Optional[str]):
     # eligibility per table — checked BEFORE any mutation so fallback is clean
     plans = {}
     for tb in by_tb:
-        if txn.all_tb_lives(ns, db, tb) or txn.all_tb_events(ns, db, tb):
+        if (
+            txn.all_tb_lives(ns, db, tb)
+            or txn.all_tb_events(ns, db, tb)
+            or txn.all_tb_views(ns, db, tb)  # views need per-row maintenance
+        ):
             return None
         plans[tb] = _TablePlan(ctx, tb)
 

@@ -414,6 +414,9 @@ def _emit_mutation(ctx, rid: Thing, before, after, action: str) -> None:
 
     (reference: doc/lives.rs, doc/event.rs, doc/changefeeds.rs, doc/table.rs)
     """
+    from .views import apply_view_mutations
+
+    apply_view_mutations(ctx, rid, before, after, action)
     process_table_lives(ctx, rid, before, after, action)
     process_table_events(ctx, rid, before, after, action)
     process_changefeeds(ctx, rid, before, after, action)
