@@ -574,17 +574,59 @@ class Iterator:
         return out
 
     # -------------------------------------------------------------- postprocess
-    def _postprocess(self, rows: List[Any]) -> List[Any]:
+    def _postprocess(self, rows: Any) -> List[Any]:
+        from surrealdb_tpu.dbs.store import ResultStore
+
         ctx, stm = self.ctx, self.stm
-        if self.defer_projection:
-            rows = self._batched_projection(rows)
-        if self.grouping:
-            rows = aggregate_groups(ctx, stm, rows)
-        if stm.split:
-            rows = apply_split(ctx, rows, stm.split)
-        if stm.order and not self.order_pushed:
-            rows = apply_order(ctx, rows, stm.order)
-        rows = apply_start_limit(ctx, rows, stm.start, stm.limit)
+        store = rows if isinstance(rows, ResultStore) else None
+        if store is not None and not (
+            store.spilled
+            and stm.order
+            and not self.order_pushed
+            and not any(o.rand for o in stm.order)
+            and not self.defer_projection
+            and not self.grouping
+            and not stm.split
+        ):
+            # no spill (common case) or a shape the external sort can't
+            # stream — materialize and run the standard pipeline
+            rows = store.to_list()
+            store.cleanup()
+            store = None
+        if store is not None:
+            # external merge sort over the spilled result set (reference
+            # dbs/store/file.rs:18): runs merge lazily; START+LIMIT slice
+            # without materializing the full ordered set
+            import itertools
+
+            def keyfunc(row, _order=stm.order):
+                out = []
+                for o in _order:
+                    v = get_path(ctx, row, o.idiom.parts) if isinstance(row, dict) else row
+                    k = sort_key(v)
+                    out.append(k if o.asc else _RevKey(k))
+                return tuple(out)
+
+            start = int(stm.start.compute(ctx)) if stm.start is not None else 0
+            limit = (
+                int(stm.limit.compute(ctx)) if stm.limit is not None else None
+            )
+            it = store.sorted_iter(keyfunc)
+            if limit is not None:
+                rows = list(itertools.islice(it, start, start + limit))
+            else:
+                rows = list(itertools.islice(it, start, None)) if start else list(it)
+            store.cleanup()
+        else:
+            if self.defer_projection:
+                rows = self._batched_projection(rows)
+            if self.grouping:
+                rows = aggregate_groups(ctx, stm, rows)
+            if stm.split:
+                rows = apply_split(ctx, rows, stm.split)
+            if stm.order and not self.order_pushed:
+                rows = apply_order(ctx, rows, stm.order)
+            rows = apply_start_limit(ctx, rows, stm.start, stm.limit)
         if stm.omit:
             for row in rows:
                 for om in stm.omit:
@@ -663,6 +705,22 @@ def field_display_name(expr) -> str:
     if isinstance(expr, Idiom):
         return repr(expr)
     return repr(expr)
+
+
+class _RevKey:
+    """Inverts comparison for DESC components of a composite external-sort
+    key (heapq.merge needs ONE ascending keyfunc across all runs)."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return self.v == other.v
 
 
 # ------------------------------------------------------------------ split/order/limit

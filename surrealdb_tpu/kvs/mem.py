@@ -165,32 +165,58 @@ class MemTransaction(BackendTransaction):
         self.writes[key] = None
 
     # -- range ops ---------------------------------------------------------
+    _RANGE_CHUNK = 4096
+
     def _merged_range(self, beg: bytes, end: bytes):
-        """Iterate live (key, value) pairs in [beg, end) merging local writes."""
+        """Iterate live (key, value) pairs in [beg, end) merging local writes.
+
+        Committed keys are pulled from the SortedList in fixed chunks rather
+        than materialized whole: `batch()` walks multi-million-key ranges
+        (mirror builds, exports) by repeated scans with an advancing cursor,
+        and materializing the full remaining range per scan made that
+        quadratic — ~10^9 list appends over a 12M-posting range. Chunked
+        irange keeps every scan O(limit).
+        """
+        from itertools import islice
+
         store = self.store
-        with store.lock:
-            committed_keys = list(store.sorted_keys.irange(beg, end, inclusive=(True, False)))
         local = sorted(k for k in self.writes if beg <= k < end)
-        ci = li = 0
-        while ci < len(committed_keys) or li < len(local):
-            if li >= len(local) or (
-                ci < len(committed_keys) and committed_keys[ci] < local[li]
-            ):
-                k = committed_keys[ci]
-                ci += 1
-                if k in self.writes:
-                    continue  # will come from local side
-                v = store._read_at(k, self.snapshot)
+        li = 0
+        n_local = len(local)
+        cursor = beg
+        exhausted = False
+        while not exhausted:
+            with store.lock:
+                committed = list(
+                    islice(
+                        store.sorted_keys.irange(cursor, end, inclusive=(True, False)),
+                        self._RANGE_CHUNK,
+                    )
+                )
+            if len(committed) < self._RANGE_CHUNK:
+                exhausted = True
+            for k in committed:
+                while li < n_local and local[li] < k:
+                    lk = local[li]
+                    li += 1
+                    v = self.writes[lk]
+                    if v is not None:
+                        yield lk, v
+                if li < n_local and local[li] == k:
+                    li += 1
+                    v = self.writes[k]
+                else:
+                    v = store._read_at(k, self.snapshot)
                 if v is not None:
                     yield k, v
-            else:
-                k = local[li]
-                li += 1
-                if ci < len(committed_keys) and committed_keys[ci] == k:
-                    ci += 1
-                v = self.writes[k]
-                if v is not None:
-                    yield k, v
+            if committed:
+                cursor = committed[-1] + b"\x00"
+        while li < n_local:
+            lk = local[li]
+            li += 1
+            v = self.writes[lk]
+            if v is not None:
+                yield lk, v
 
     def keys(self, beg: bytes, end: bytes, limit: int = -1) -> List[bytes]:
         self._check_open()

@@ -1,0 +1,99 @@
+"""External result sort: SELECT ... ORDER BY past the spill limit.
+
+Mirrors the reference's file-backed Results store (reference:
+core/src/dbs/result.rs:15, dbs/store/file.rs:18, cnf/mod.rs:69
+EXTERNAL_SORTING_BUFFER_LIMIT): big result sets spill to disk and ORDER BY
+runs as an external merge sort instead of materializing everything.
+"""
+
+import pytest
+
+from surrealdb_tpu import cnf
+from surrealdb_tpu.dbs.session import Session
+from surrealdb_tpu.dbs.store import ResultStore
+from surrealdb_tpu.kvs.ds import Datastore
+
+
+@pytest.fixture()
+def ds():
+    return Datastore("memory")
+
+
+@pytest.fixture()
+def s():
+    s = Session.owner()
+    s.ns, s.db = "t", "t"
+    return s
+
+
+def run(ds, s, sql, vars=None):
+    out = ds.execute(sql, s, vars=vars)
+    for r in out:
+        assert r["status"] == "OK", r
+    return out[-1]["result"]
+
+
+@pytest.fixture()
+def small_limit(monkeypatch):
+    monkeypatch.setattr(cnf, "EXTERNAL_SORTING_BUFFER_LIMIT", 100)
+    spills = {"n": 0}
+    orig = ResultStore._spill
+
+    def counting(self):
+        spills["n"] += 1
+        return orig(self)
+
+    monkeypatch.setattr(ResultStore, "_spill", counting)
+    return spills
+
+
+def test_order_by_spills_and_sorts(ds, s, small_limit):
+    run(ds, s, "DEFINE TABLE n SCHEMALESS")
+    # 2.5x the buffer limit, values deliberately shuffled
+    rows = [{"id": i, "v": (i * 7919) % 251} for i in range(250)]
+    run(ds, s, "INSERT INTO n $rows", {"rows": rows})
+
+    got = run(ds, s, "SELECT v FROM n ORDER BY v DESC LIMIT 10")
+    assert small_limit["n"] > 0, "result set never spilled"
+    expect = sorted((r["v"] for r in rows), reverse=True)[:10]
+    assert [r["v"] for r in got] == expect
+
+
+def test_order_by_spill_start_limit(ds, s, small_limit):
+    run(ds, s, "DEFINE TABLE n SCHEMALESS")
+    rows = [{"id": i, "v": (i * 31) % 997} for i in range(300)]
+    run(ds, s, "INSERT INTO n $rows", {"rows": rows})
+    got = run(ds, s, "SELECT v FROM n ORDER BY v ASC LIMIT 20 START 50")
+    assert small_limit["n"] > 0
+    expect = sorted(r["v"] for r in rows)[50:70]
+    assert [r["v"] for r in got] == expect
+
+
+def test_order_by_spill_multikey_mixed_direction(ds, s, small_limit):
+    run(ds, s, "DEFINE TABLE n SCHEMALESS")
+    rows = [{"id": i, "a": i % 3, "v": (i * 13) % 101} for i in range(250)]
+    run(ds, s, "INSERT INTO n $rows", {"rows": rows})
+    got = run(ds, s, "SELECT a, v FROM n ORDER BY a ASC, v DESC")
+    assert small_limit["n"] > 0
+    expect = sorted(((r["a"], r["v"]) for r in rows), key=lambda t: (t[0], -t[1]))
+    assert [(r["a"], r["v"]) for r in got] == expect
+    assert len(got) == 250
+
+
+def test_spill_without_order_roundtrips(ds, s, small_limit):
+    run(ds, s, "DEFINE TABLE n SCHEMALESS")
+    rows = [{"id": i, "v": i} for i in range(250)]
+    run(ds, s, "INSERT INTO n $rows", {"rows": rows})
+    got = run(ds, s, "SELECT v FROM n")
+    assert len(got) == 250
+    assert {r["v"] for r in got} == set(range(250))
+
+
+def test_store_unit_sorted_iter_ties():
+    st = ResultStore(limit=10)
+    st.extend({"k": i % 5, "i": i} for i in range(35))
+    assert st.spilled
+    out = list(st.sorted_iter(lambda r: r["k"]))
+    assert [r["k"] for r in out] == sorted(i % 5 for i in range(35))
+    assert len(out) == 35
+    st.cleanup()
