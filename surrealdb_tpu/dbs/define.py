@@ -307,6 +307,8 @@ def _def_access(ctx, a) -> Any:
         "jwt_issuer_key": a.get("jwt_issuer_key"),
         "token_duration": a.get("token_duration"),
         "session_duration": a.get("session_duration"),
+        "grant_duration": a.get("grant_duration"),
+        "bearer_subject": a.get("bearer_subject"),
         "comment": a.get("comment"),
     })
     return NONE

@@ -26,6 +26,10 @@ def signin(ds, session, creds: Dict[str, Any]) -> str:
     user = creds.get("user") or creds.get("username")
     pwd = creds.get("pass") or creds.get("password")
 
+    if ac and creds.get("key") and str(creds["key"]).startswith("surreal-bearer-"):
+        from .access import bearer_signin
+
+        return bearer_signin(ds, session, creds)
     if ac and ns and db:
         return _record_signin(ds, session, ns, db, ac, creds)
     if user is None or pwd is None:

@@ -154,6 +154,24 @@ def db_access_prefix(ns: str, db: str) -> bytes:
     return _db(ns, db) + b"!ac"
 
 
+def access_grant(level: tuple, ac: str, gr: str) -> bytes:
+    """Bearer/JWT grant storage (reference key::root/namespace/database::
+    access::gr — `…!gr{ac}{gr}` per level)."""
+    return _access_grant_base(level) + enc_str(ac) + enc_str(gr)
+
+
+def access_grant_prefix(level: tuple, ac: str) -> bytes:
+    return _access_grant_base(level) + enc_str(ac)
+
+
+def _access_grant_base(level: tuple) -> bytes:
+    if len(level) == 0:
+        return b"/!gr"
+    if len(level) == 1:
+        return _ns(level[0]) + b"!gr"
+    return _db(level[0], level[1]) + b"!gr"
+
+
 def function(ns: str, db: str, name: str) -> bytes:
     return _db(ns, db) + b"!fc" + enc_str(name)
 

@@ -526,6 +526,19 @@ class Transaction:
     def del_access(self, level: tuple, ac: str) -> None:
         self.tr.delete(self._access_key(level, ac))
 
+    # ------------------------------------------------------------ access grants
+    def get_grant(self, level: tuple, ac: str, gr: str) -> Optional[dict]:
+        return self.get_obj(keys.access_grant(level, ac, gr))
+
+    def put_grant(self, level: tuple, ac: str, gr: str, d: dict) -> None:
+        self.set_obj(keys.access_grant(level, ac, gr), d)
+
+    def all_grants(self, level: tuple, ac: str) -> List[dict]:
+        return self._scan_objs(keys.access_grant_prefix(level, ac))
+
+    def del_grant(self, level: tuple, ac: str, gr: str) -> None:
+        self.tr.delete(keys.access_grant(level, ac, gr))
+
     @staticmethod
     def _access_key(level: tuple, ac: str) -> bytes:
         if len(level) == 0:

@@ -796,6 +796,9 @@ class AccessStatement(Statement):
         self.op = op
         self.args = args
 
+    def writeable(self) -> bool:
+        return self.op in ("grant", "revoke", "purge")
+
     def compute(self, ctx):
         from surrealdb_tpu.iam.access import access_compute
 

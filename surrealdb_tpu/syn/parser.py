@@ -1170,8 +1170,14 @@ class Parser:
                     break
         elif self.eat_kw("BEARER"):
             args["access_type"] = "bearer"
+            args["bearer_subject"] = "user"
             if self.eat_kw("FOR"):
-                self.next()
+                if self.eat_kw("USER"):
+                    args["bearer_subject"] = "user"
+                elif self.eat_kw("RECORD"):
+                    args["bearer_subject"] = "record"
+                else:
+                    raise self.error("expected USER or RECORD")
         else:
             raise self.error("expected JWT, RECORD or BEARER")
         while True:
@@ -1179,6 +1185,11 @@ class Parser:
                 while self.eat_kw("FOR"):
                     if self.eat_kw("TOKEN"):
                         args["token_duration"] = self._duration().nanos
+                    elif self.eat_kw("GRANT"):
+                        if self.eat_kw("NONE"):
+                            args["grant_duration"] = None
+                        else:
+                            args["grant_duration"] = self._duration().nanos
                     elif self.eat_kw("SESSION"):
                         if self.eat_kw("NONE"):
                             args["session_duration"] = None
@@ -1344,14 +1355,39 @@ class Parser:
                     args["record"] = self.parse_expr()
             return S.AccessStatement(name, base, "grant", **args)
         if self.eat_kw("SHOW"):
-            return S.AccessStatement(name, base, "show")
+            args = {}
+            if self.eat_kw("GRANT"):
+                args["grant"] = self.ident("grant id")
+            elif self.eat_kw("WHERE"):
+                args["cond"] = self.parse_expr()
+            else:
+                self.eat_kw("ALL")
+            return S.AccessStatement(name, base, "show", **args)
         if self.eat_kw("REVOKE"):
             args = {}
             if self.eat_kw("GRANT"):
                 args["grant"] = self.ident("grant id")
+            elif self.eat_kw("WHERE"):
+                args["cond"] = self.parse_expr()
+            else:
+                self.eat_kw("ALL")
             return S.AccessStatement(name, base, "revoke", **args)
         if self.eat_kw("PURGE"):
-            return S.AccessStatement(name, base, "purge")
+            args = {"expired": False, "revoked": False}
+            while True:
+                if self.eat_kw("EXPIRED"):
+                    args["expired"] = True
+                elif self.eat_kw("REVOKED"):
+                    args["revoked"] = True
+                elif self.eat_op(","):
+                    continue
+                else:
+                    break
+            if not args["expired"] and not args["revoked"]:
+                args["expired"] = args["revoked"] = True
+            if self.eat_kw("FOR"):
+                args["grace"] = self._duration().nanos
+            return S.AccessStatement(name, base, "purge", **args)
         raise self.error("expected GRANT, SHOW, REVOKE or PURGE")
 
     # ------------------------------------------------------------- kinds

@@ -1,0 +1,119 @@
+"""ACCESS bearer-grant lifecycle (reference core/src/sql/statements/access.rs
++ iam/signin.rs validate/verify_grant_bearer): grant issue, show (redacted),
+signin with the bearer key, revoke, purge.
+"""
+
+import pytest
+
+from surrealdb_tpu.dbs.session import Session
+from surrealdb_tpu.iam.signin import signin
+from surrealdb_tpu.err import InvalidAuthError, SurrealError
+from surrealdb_tpu.kvs.ds import Datastore
+
+
+@pytest.fixture()
+def ds():
+    return Datastore("memory")
+
+
+@pytest.fixture()
+def s():
+    s = Session.owner()
+    s.ns, s.db = "t", "t"
+    return s
+
+
+def run(ds, s, sql, vars=None):
+    out = ds.execute(sql, s, vars=vars)
+    for r in out:
+        assert r["status"] == "OK", r
+    return out[-1]["result"]
+
+
+def setup_access(ds, s):
+    run(ds, s, "DEFINE USER app ON DATABASE PASSWORD 'pw' ROLES EDITOR")
+    run(ds, s, "DEFINE ACCESS api ON DATABASE TYPE BEARER FOR USER DURATION FOR GRANT 1h")
+
+
+def test_grant_show_signin_revoke(ds, s):
+    setup_access(ds, s)
+    gr = run(ds, s, "ACCESS api GRANT FOR USER app")
+    key = gr["grant"]["key"]
+    assert key.startswith("surreal-bearer-")
+    assert len(key) == len("surreal-bearer-") + 12 + 1 + 24
+    gid = gr["id"]
+
+    # SHOW redacts the key
+    shown = run(ds, s, "ACCESS api SHOW ALL")
+    assert len(shown) == 1
+    assert shown[0]["grant"]["key"] == "[REDACTED]"
+    assert shown[0]["subject"] == {"user": "app"}
+
+    # signin with the bearer key authenticates as the subject user
+    sess = Session()
+    sess.ns, sess.db = "t", "t"
+    token = signin(ds, sess, {"NS": "t", "DB": "t", "AC": "api", "key": key})
+    assert token
+    assert sess.auth is not None and sess.auth.user == "app"
+
+    # revoke, then auth fails (opaque error)
+    run(ds, s, f"ACCESS api REVOKE GRANT {gid}")
+    sess2 = Session()
+    with pytest.raises(InvalidAuthError):
+        signin(ds, sess2, {"NS": "t", "DB": "t", "AC": "api", "key": key})
+
+    # purge removes the revoked grant
+    purged = run(ds, s, "ACCESS api PURGE REVOKED")
+    assert [g["id"] for g in purged] == [gid]
+    assert run(ds, s, "ACCESS api SHOW ALL") == []
+
+
+def test_bad_key_rejected(ds, s):
+    setup_access(ds, s)
+    gr = run(ds, s, "ACCESS api GRANT FOR USER app")
+    key = gr["grant"]["key"]
+    # flip one char of the secret part
+    bad = key[:-1] + ("a" if key[-1] != "a" else "b")
+    sess = Session()
+    with pytest.raises(InvalidAuthError):
+        signin(ds, sess, {"NS": "t", "DB": "t", "AC": "api", "key": bad})
+    # truncated key
+    with pytest.raises(InvalidAuthError):
+        signin(ds, sess, {"NS": "t", "DB": "t", "AC": "api", "key": key[:-1]})
+
+
+def test_grant_requires_existing_user(ds, s):
+    run(ds, s, "DEFINE ACCESS api ON DATABASE TYPE BEARER FOR USER")
+    out = ds.execute("ACCESS api GRANT FOR USER ghost", s)
+    assert out[-1]["status"] == "ERR"
+
+
+def test_grant_for_record_subject(ds, s):
+    run(ds, s, "DEFINE ACCESS rec ON DATABASE TYPE BEARER FOR RECORD")
+    run(ds, s, "DEFINE TABLE person SCHEMALESS")
+    run(ds, s, "CREATE person:1 SET name = 'x'")
+    gr = run(ds, s, "ACCESS rec GRANT FOR RECORD person:1")
+    key = gr["grant"]["key"]
+    sess = Session()
+    token = signin(ds, sess, {"NS": "t", "DB": "t", "AC": "rec", "key": key})
+    assert token
+    assert sess.auth is not None and str(sess.auth.rid) == "person:1"
+
+
+def test_show_where_and_revoke_all(ds, s):
+    setup_access(ds, s)
+    run(ds, s, "ACCESS api GRANT FOR USER app")
+    run(ds, s, "ACCESS api GRANT FOR USER app")
+    shown = run(ds, s, "ACCESS api SHOW WHERE subject.user = 'app'")
+    assert len(shown) == 2
+    revoked = run(ds, s, "ACCESS api REVOKE ALL")
+    assert len(revoked) == 2
+    sess = Session()
+    for g in run(ds, s, "ACCESS api SHOW ALL"):
+        assert not isinstance(g["revocation"], type(None))
+
+
+def test_wrong_subject_type_rejected(ds, s):
+    setup_access(ds, s)  # FOR USER
+    out = ds.execute("ACCESS api GRANT FOR RECORD person:1", s)
+    assert out[-1]["status"] == "ERR"
