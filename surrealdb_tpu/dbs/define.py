@@ -307,7 +307,9 @@ def _def_access(ctx, a) -> Any:
         "jwt_issuer_key": a.get("jwt_issuer_key"),
         "token_duration": a.get("token_duration"),
         "session_duration": a.get("session_duration"),
-        "grant_duration": a.get("grant_duration"),
+        # unspecified -> 30d default (reference: access/DEFAULT_GRANT_DURATION);
+        # explicit `DURATION FOR GRANT NONE` stores None (never expires)
+        "grant_duration": a.get("grant_duration", 30 * 24 * 3600 * 1_000_000_000),
         "bearer_subject": a.get("bearer_subject"),
         "comment": a.get("comment"),
     })

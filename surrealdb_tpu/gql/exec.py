@@ -12,7 +12,10 @@ Supported: query operations (anonymous or named), variables, arguments
 `limit`, `start`, `order` (field name, or {field: ASC|DESC}), `filter`
 ({field: value} equality conjunction), field selections with aliases,
 nested selection sets on record links (resolved by fetching the linked
-record), and `__typename`. Mutations/subscriptions/fragments report a
+record), named fragments + spreads, inline fragments with type
+conditions, `@skip`/`@include` directives, `__typename`, and full
+introspection (`__schema`/`__type`, served from gql/introspection.py so
+GraphiQL and codegen clients work). Mutations/subscriptions report a
 clean unsupported error.
 """
 
@@ -78,9 +81,10 @@ class _Parser:
         return v
 
     # ---------------------------------------------------------- document
-    def document(self) -> dict:
-        """Returns the single executable operation."""
+    def document(self) -> Tuple[dict, Dict[str, dict]]:
+        """Returns (the single executable operation, fragment defs by name)."""
         ops = []
+        fragments: Dict[str, dict] = {}
         while self.peek()[0] != "eof":
             k, v = self.peek()
             if k == "punct" and v == "{":
@@ -103,14 +107,22 @@ class _Parser:
                         if self.eat("punct", "="):
                             default = self.value_node()
                         var_defs.append((vname, default))
+                self._directives()
                 ops.append({"type": "query", "name": name, "vars": var_defs, "sel": self.selection_set()})
             elif k == "name" and v == "fragment":
-                raise SurrealError("GraphQL fragments are not supported")
+                self.next()
+                fname = self.expect("name")
+                if fname == "on":
+                    raise SurrealError("GraphQL fragment may not be named 'on'")
+                self.expect("name", "on")
+                on = self.expect("name")
+                self._directives()
+                fragments[fname] = {"on": on, "sel": self.selection_set()}
             else:
                 raise SurrealError(f"GraphQL syntax error near {v!r}")
         if len(ops) != 1:
             raise SurrealError("Exactly one GraphQL operation is supported per request")
-        return ops[0]
+        return ops[0], fragments
 
     def _type_ref(self) -> None:
         if self.eat("punct", "["):
@@ -127,9 +139,34 @@ class _Parser:
             out.append(self.field())
         return out
 
+    def _directives(self) -> List[dict]:
+        """Parse `@name(args)` directives; only skip/include are honored."""
+        out = []
+        while self.eat("punct", "@"):
+            name = self.expect("name")
+            args: Dict[str, Any] = {}
+            if self.eat("punct", "("):
+                while not self.eat("punct", ")"):
+                    an = self.expect("name")
+                    self.expect("punct", ":")
+                    args[an] = self.value_node()
+            out.append({"name": name, "args": args})
+        return out
+
     def field(self) -> dict:
-        if self.peek() == ("punct", "..."):
-            raise SurrealError("GraphQL fragments are not supported")
+        if self.eat("punct", "..."):
+            # fragment spread or inline fragment
+            k, v = self.peek()
+            if k == "name" and v != "on":
+                name = self.next()[1]
+                dirs = self._directives()
+                return {"spread": name, "dirs": dirs}
+            on = None
+            if k == "name" and v == "on":
+                self.next()
+                on = self.expect("name")
+            dirs = self._directives()
+            return {"inline": on, "dirs": dirs, "sel": self.selection_set()}
         name = self.expect("name")
         alias = None
         if self.eat("punct", ":"):
@@ -140,10 +177,11 @@ class _Parser:
                 an = self.expect("name")
                 self.expect("punct", ":")
                 args[an] = self.value_node()
+        dirs = self._directives()
         sel = None
         if self.peek() == ("punct", "{"):
             sel = self.selection_set()
-        return {"name": name, "alias": alias or name, "args": args, "sel": sel}
+        return {"name": name, "alias": alias or name, "args": args, "sel": sel, "dirs": dirs}
 
     # ---------------------------------------------------------- values
     def value_node(self):
@@ -211,6 +249,69 @@ def _safe_ident(name: str, what: str) -> str:
     return name
 
 
+class _Ctx:
+    """Per-request execution context: engine handles + fragments + vars."""
+
+    __slots__ = ("ds", "session", "fragments", "variables", "_schema")
+
+    def __init__(self, ds, session, fragments, variables):
+        self.ds = ds
+        self.session = session
+        self.fragments = fragments
+        self.variables = variables
+        self._schema = None
+
+    def schema(self) -> dict:
+        if self._schema is None:
+            from .introspection import build_schema
+
+            self._schema = build_schema(self.ds, self.session)
+        return self._schema
+
+
+def _dirs_keep(dirs, variables) -> bool:
+    """Evaluate @skip/@include; unknown directives are ignored."""
+    for d in dirs or ():
+        if d["name"] in ("skip", "include"):
+            cond = _resolve(d["args"].get("if"), variables)
+            if d["name"] == "skip" and bool(cond):
+                return False
+            if d["name"] == "include" and not bool(cond):
+                return False
+    return True
+
+
+def _expand_sel(ctx: _Ctx, sel: List[dict], typename: Optional[str], _seen=()) -> List[dict]:
+    """Flatten fragment spreads / inline fragments into plain field nodes,
+    applying type conditions against `typename` and skip/include."""
+    out = []
+    for node in sel:
+        if "spread" in node:
+            if not _dirs_keep(node.get("dirs"), ctx.variables):
+                continue
+            name = node["spread"]
+            if name in _seen:
+                raise SurrealError(f"GraphQL fragment cycle through {name!r}")
+            frag = ctx.fragments.get(name)
+            if frag is None:
+                raise SurrealError(f"Unknown GraphQL fragment {name!r}")
+            if typename is not None and frag["on"] not in (typename, "Record"):
+                continue
+            out.extend(_expand_sel(ctx, frag["sel"], typename, _seen + (name,)))
+        elif "inline" in node:
+            if not _dirs_keep(node.get("dirs"), ctx.variables):
+                continue
+            on = node["inline"]
+            if on is not None and typename is not None and on not in (typename, "Record"):
+                continue
+            out.extend(_expand_sel(ctx, node["sel"], typename, _seen))
+        else:
+            if not _dirs_keep(node.get("dirs"), ctx.variables):
+                continue
+            out.append(node)
+    return out
+
+
 def run_graphql(ds, session, request: dict) -> dict:
     try:
         if not isinstance(request, dict):
@@ -218,22 +319,47 @@ def run_graphql(ds, session, request: dict) -> dict:
         vars_in = request.get("variables") or {}
         if not isinstance(vars_in, dict):
             raise SurrealError("GraphQL variables must be an object")
-        op = _Parser(str(request.get("query") or "")).document()
+        op, fragments = _Parser(str(request.get("query") or "")).document()
         variables = dict(vars_in)
         for vname, default in op["vars"]:
             if vname not in variables and default is not None:
                 variables[vname] = default
+        ctx = _Ctx(ds, session, fragments, variables)
         data = {}
-        for field in op["sel"]:
-            data[field["alias"]] = _root_field(ds, session, field, variables)
+        for field in _collect(ctx, op["sel"], "Query"):
+            data[field["alias"]] = _root_field(ctx, field)
         return {"data": data}
     except SurrealError as e:
         return {"errors": [{"message": str(e)}]}
 
 
-def _root_field(ds, session, field: dict, variables: Dict[str, Any]):
+def _strip_schema(v):
+    """Drop the builder's internal `_by_name` index before projection."""
+    if isinstance(v, dict):
+        return {k: x for k, x in v.items() if k != "_by_name"}
+    return v
+
+
+def _root_field(ctx: _Ctx, field: dict):
+    ds, session, variables = ctx.ds, ctx.session, ctx.variables
     if field["name"] == "__typename":
         return "Query"
+    if field["name"] == "__schema":
+        if field["sel"] is None:
+            raise SurrealError("GraphQL field '__schema' requires a selection set")
+        return _project(ctx, _strip_schema(ctx.schema()), field["sel"], depth=0)
+    if field["name"] == "__type":
+        from .introspection import type_by_name
+
+        name = _resolve(field["args"].get("name"), variables)
+        if not isinstance(name, str):
+            raise SurrealError("GraphQL __type requires a string `name` argument")
+        t = type_by_name(ctx.schema(), name)
+        if t is None:
+            return None
+        if field["sel"] is None:
+            raise SurrealError("GraphQL field '__type' requires a selection set")
+        return _project(ctx, t, field["sel"], depth=0)
     tb = _safe_ident(field["name"], "table")
     ns, db = session.ns, session.db
     if not ns or not db:
@@ -277,40 +403,69 @@ def _root_field(ds, session, field: dict, variables: Dict[str, Any]):
     sel = field["sel"]
     if sel is None:
         raise SurrealError(f"GraphQL field '{tb}' requires a selection set")
-    return [_project(ds, session, row, sel, depth=0) for row in rows]
+    return [_project(ctx, row, sel, depth=0) for row in rows]
 
 
 _MAX_LINK_DEPTH = 5
 
 
-def _project(ds, session, row, sel: List[dict], depth: int):
+def _typename_of(row) -> Optional[str]:
+    if isinstance(row, dict):
+        tn = row.get("__typename")
+        if isinstance(tn, str):
+            return tn
+        rid = row.get("id")
+        if isinstance(rid, Thing):
+            return rid.tb
+    return None
+
+
+def _collect(ctx: _Ctx, sel: List[dict], typename: Optional[str]) -> List[dict]:
+    """Spec CollectFields: expand fragments, then merge fields that share a
+    response key by concatenating their sub-selections (two fragments each
+    selecting part of the same field must both contribute)."""
+    merged: Dict[str, dict] = {}
+    order: List[dict] = []
+    for f in _expand_sel(ctx, sel, typename):
+        key = f["alias"]
+        prev = merged.get(key)
+        if prev is None:
+            f = dict(f)  # copy: merging must not mutate the parsed AST node
+            merged[key] = f
+            order.append(f)
+        elif prev["name"] == f["name"] and prev["sel"] is not None and f["sel"] is not None:
+            prev["sel"] = prev["sel"] + f["sel"]
+        # else: duplicate scalar selection — identical by spec, keep the first
+    return order
+
+
+def _project(ctx: _Ctx, row, sel: List[dict], depth: int):
     out = {}
-    for f in sel:
+    for f in _collect(ctx, sel, _typename_of(row)):
         if f["name"] == "__typename":
-            rid = row.get("id") if isinstance(row, dict) else None
-            out[f["alias"]] = rid.tb if isinstance(rid, Thing) else "Record"
+            out[f["alias"]] = _typename_of(row) or "Record"
             continue
         v = row.get(f["name"]) if isinstance(row, dict) else None
-        out[f["alias"]] = _render(ds, session, v, f["sel"], depth)
+        out[f["alias"]] = _render(ctx, v, f["sel"], depth)
     return out
 
 
-def _render(ds, session, v, sel, depth: int):
+def _render(ctx: _Ctx, v, sel, depth: int):
     if isinstance(v, list):
-        return [_render(ds, session, x, sel, depth) for x in v]
+        return [_render(ctx, x, sel, depth) for x in v]
     if isinstance(v, Thing):
         if sel is None:
             return str(v)
         if depth >= _MAX_LINK_DEPTH:
             raise SurrealError("GraphQL record-link nesting too deep")
-        out = ds.execute("SELECT * FROM $r;", session, vars={"r": v})
+        out = ctx.ds.execute("SELECT * FROM $r;", ctx.session, vars={"r": v})
         rows = out[-1]["result"] if out[-1]["status"] == "OK" else []
         if not rows:
             return None
-        return _project(ds, session, rows[0], sel, depth + 1)
+        return _project(ctx, rows[0], sel, depth + 1)
     if sel is not None:
         if isinstance(v, dict):
-            return _project(ds, session, v, sel, depth)
+            return _project(ctx, v, sel, depth)
         return None
     from surrealdb_tpu.sql.value import to_json_value
 

@@ -113,6 +113,24 @@ def access_compute(ctx, stm):
     raise SurrealError(f"ACCESS {op.upper()} is not supported")
 
 
+def _get_user(txn, level: tuple, user: str):
+    """User lookup at a (root|ns|db) level tuple."""
+    if len(level) == 0:
+        return txn.get_root_user(user)
+    if len(level) == 1:
+        return txn.get_ns_user(level[0], user)
+    return txn.get_db_user(level[0], level[1], user)
+
+
+def _grants_for(txn, level, ac_name: str, want):
+    """The grants a GRANT-id/ALL/WHERE form operates on: a point lookup
+    when a specific id was given, the full prefix scan otherwise."""
+    if want is not None:
+        gr = txn.get_grant(level, ac_name, want)
+        return [gr] if gr is not None else []
+    return txn.all_grants(level, ac_name)
+
+
 def _grant(ctx, txn, level, ac: dict, stm):
     if ac.get("access_type") != "bearer":
         raise SurrealError(
@@ -126,12 +144,7 @@ def _grant(ctx, txn, level, ac: dict, stm):
         if want_subject != "user":
             raise SurrealError("This access method expects record subjects")
         # the user must exist at this level (access.rs:335-348)
-        if len(level) == 0:
-            u = txn.get_root_user(user)
-        elif len(level) == 1:
-            u = txn.get_ns_user(level[0], user)
-        else:
-            u = txn.get_db_user(level[0], level[1], user)
+        u = _get_user(txn, level, user)
         if u is None:
             raise SurrealError(f"The user '{user}' does not exist")
         subject = {"user": user}
@@ -170,9 +183,7 @@ def _show(ctx, txn, level, ac: dict, stm):
     want = stm.args.get("grant")
     cond = stm.args.get("cond")
     out: List[Any] = []
-    for gr in txn.all_grants(level, ac["name"]):
-        if want is not None and gr["id"] != want:
-            continue
+    for gr in _grants_for(txn, level, ac["name"], want):
         pub = _grant_public(gr)
         if cond is not None:
             from surrealdb_tpu.sql.value import truthy
@@ -181,6 +192,8 @@ def _show(ctx, txn, level, ac: dict, stm):
                 if not truthy(cond.compute(c)):
                     continue
         out.append(pub)
+    if want is not None and not out:
+        raise SurrealError(f"The grant '{want}' does not exist")
     return out
 
 
@@ -189,9 +202,7 @@ def _revoke(ctx, txn, level, ac: dict, stm):
     cond = stm.args.get("cond")
     now = _now_ns()
     out: List[Any] = []
-    for gr in txn.all_grants(level, ac["name"]):
-        if want is not None and gr["id"] != want:
-            continue
+    for gr in _grants_for(txn, level, ac["name"], want):
         if gr.get("revocation"):
             if want is not None:
                 raise SurrealError(f"The grant '{gr['id']}' is already revoked")
@@ -272,24 +283,11 @@ def bearer_signin(ds, session, creds: Dict[str, Any]) -> str:
                   "exp": int(exp), "iss": "surrealdb-tpu"}
         return issue_token(claims, ac.get("jwt_key") or "", ac.get("jwt_alg", "HS512"))
     user = subject.get("user")
-    if len(level) == 0:
-        u_txn = ds.transaction(False)
-        try:
-            u = u_txn.get_root_user(user)
-        finally:
-            u_txn.cancel()
-    elif len(level) == 1:
-        u_txn = ds.transaction(False)
-        try:
-            u = u_txn.get_ns_user(ns, user)
-        finally:
-            u_txn.cancel()
-    else:
-        u_txn = ds.transaction(False)
-        try:
-            u = u_txn.get_db_user(ns, db, user)
-        finally:
-            u_txn.cancel()
+    u_txn = ds.transaction(False)
+    try:
+        u = _get_user(u_txn, level, user)
+    finally:
+        u_txn.cancel()
     if u is None:
         raise InvalidAuthError("There was a problem with authentication")
     session.ns = ns or session.ns

@@ -26,10 +26,20 @@ def signin(ds, session, creds: Dict[str, Any]) -> str:
     user = creds.get("user") or creds.get("username")
     pwd = creds.get("pass") or creds.get("password")
 
-    if ac and creds.get("key") and str(creds["key"]).startswith("surreal-bearer-"):
-        from .access import bearer_signin
+    if ac and creds.get("key") is not None:
+        # dispatch on the access method's TYPE, not the key's shape: a
+        # RECORD method whose SIGNIN reads $key must not be shadowed by a
+        # bearer-looking key (reference signin.rs matches on access kind)
+        level = (ns, db) if ns and db else ((ns,) if ns else ())
+        txn = ds.transaction(False)
+        try:
+            acd = txn.get_access(level, ac)
+        finally:
+            txn.cancel()
+        if acd is not None and acd.get("access_type") == "bearer":
+            from .access import bearer_signin
 
-        return bearer_signin(ds, session, creds)
+            return bearer_signin(ds, session, creds)
     if ac and ns and db:
         return _record_signin(ds, session, ns, db, ac, creds)
     if user is None or pwd is None:

@@ -804,8 +804,5 @@ class AccessStatement(Statement):
 
         return access_compute(ctx, self)
 
-    def writeable(self):
-        return True
-
     def __repr__(self):
         return f"ACCESS {self.name} {self.op.upper()}"

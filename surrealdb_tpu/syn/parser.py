@@ -1369,8 +1369,9 @@ class Parser:
                 args["grant"] = self.ident("grant id")
             elif self.eat_kw("WHERE"):
                 args["cond"] = self.parse_expr()
-            else:
-                self.eat_kw("ALL")
+            elif not self.eat_kw("ALL"):
+                # revoking everything is destructive: make it explicit
+                raise self.error("expected GRANT <id>, WHERE <cond> or ALL")
             return S.AccessStatement(name, base, "revoke", **args)
         if self.eat_kw("PURGE"):
             args = {"expired": False, "revoked": False}

@@ -108,12 +108,27 @@ def test_show_where_and_revoke_all(ds, s):
     assert len(shown) == 2
     revoked = run(ds, s, "ACCESS api REVOKE ALL")
     assert len(revoked) == 2
-    sess = Session()
+    from surrealdb_tpu.sql.value import Datetime
+
     for g in run(ds, s, "ACCESS api SHOW ALL"):
-        assert not isinstance(g["revocation"], type(None))
+        assert isinstance(g["revocation"], Datetime)
 
 
 def test_wrong_subject_type_rejected(ds, s):
     setup_access(ds, s)  # FOR USER
     out = ds.execute("ACCESS api GRANT FOR RECORD person:1", s)
+    assert out[-1]["status"] == "ERR"
+
+
+def test_bare_revoke_rejected(ds, s):
+    setup_access(ds, s)
+    from surrealdb_tpu.err import SurrealError
+
+    with pytest.raises(SurrealError, match="GRANT"):
+        ds.execute("ACCESS api REVOKE", s)
+
+
+def test_show_unknown_grant_errors(ds, s):
+    setup_access(ds, s)
+    out = ds.execute("ACCESS api SHOW GRANT nope12345", s)
     assert out[-1]["status"] == "ERR"

@@ -92,3 +92,155 @@ def test_http_route(gds, monkeypatch):
         assert r.status == 200 and len(out["data"]["person"]) == 2
     finally:
         srv.shutdown()
+
+
+def test_named_fragments(gds):
+    q = """
+    query {
+      person(filter: {name: "link"}) { ...core friend { ...core } }
+    }
+    fragment core on person { name age }
+    """
+    out = execute_graphql(gds, _sess(), {"query": q})
+    assert "errors" not in out, out
+    row = out["data"]["person"][0]
+    assert row["name"] == "link" and row["friend"]["name"] == "p1"
+
+
+def test_inline_fragment_and_directives(gds):
+    q = """
+    query Q($yes: Boolean, $no: Boolean) {
+      person(limit: 1) {
+        ... on person { name }
+        age @skip(if: $yes)
+        tags @include(if: $no)
+      }
+    }
+    """
+    out = execute_graphql(gds, _sess(), {"query": q, "variables": {"yes": True, "no": False}})
+    assert "errors" not in out, out
+    row = out["data"]["person"][0]
+    assert "name" in row and "age" not in row and "tags" not in row
+
+
+def test_fragment_type_condition_mismatch(gds):
+    q = """
+    { person(limit: 1) { ...other name } }
+    fragment other on animal { age }
+    """
+    out = execute_graphql(gds, _sess(), {"query": q})
+    assert "errors" not in out, out
+    assert out["data"]["person"][0] == {"name": "p0"}
+
+
+def test_fragment_cycle_rejected(gds):
+    q = """
+    { person(limit: 1) { ...a } }
+    fragment a on person { ...a }
+    """
+    out = execute_graphql(gds, _sess(), {"query": q})
+    assert "cycle" in out["errors"][0]["message"]
+
+
+def test_introspection_schema(gds):
+    gds.execute(
+        "DEFINE TABLE typed SCHEMAFULL; "
+        "DEFINE FIELD name ON typed TYPE string; "
+        "DEFINE FIELD n ON typed TYPE option<int>; "
+        "DEFINE FIELD friend ON typed TYPE record<person>; "
+        "DEFINE FIELD tags ON typed TYPE array<string>;"
+    )
+    q = """
+    { __schema {
+        queryType { name }
+        types { kind name fields { name type { kind name ofType { kind name ofType { kind name } } } } }
+        directives { name locations }
+    } }
+    """
+    out = execute_graphql(gds, _sess(), {"query": q})
+    assert "errors" not in out, out
+    sch = out["data"]["__schema"]
+    assert sch["queryType"]["name"] == "Query"
+    by_name = {t["name"]: t for t in sch["types"]}
+    # every table appears as an object type and a Query root field
+    assert "person" in by_name and "typed" in by_name
+    qf = {f["name"]: f for f in by_name["Query"]["fields"]}
+    assert "typed" in qf and qf["typed"]["type"]["kind"] == "NON_NULL"
+    # kind mapping
+    tf = {f["name"]: f for f in by_name["typed"]["fields"]}
+    assert tf["name"]["type"]["kind"] == "NON_NULL"
+    assert tf["name"]["type"]["ofType"]["name"] == "String"
+    assert tf["n"]["type"] == {"kind": "SCALAR", "name": "Int", "ofType": None}
+    assert tf["friend"]["type"]["ofType"]["name"] == "person"
+    assert tf["tags"]["type"]["ofType"]["kind"] == "LIST"
+    # meta types present
+    assert "__Schema" in by_name and "__Type" in by_name
+    assert {d["name"] for d in sch["directives"]} == {"include", "skip"}
+
+
+def test_introspection_type_lookup(gds):
+    q = '{ __type(name: "person") { kind name fields { name } } }'
+    out = execute_graphql(gds, _sess(), {"query": q})
+    assert "errors" not in out, out
+    t = out["data"]["__type"]
+    assert t["kind"] == "OBJECT" and t["name"] == "person"
+    assert {f["name"] for f in t["fields"]} >= {"id"}
+    out = execute_graphql(gds, _sess(), {"query": '{ __type(name: "nope") { name } }'})
+    assert out["data"]["__type"] is None
+
+
+def test_graphiql_style_introspection(gds):
+    """The fragment-heavy shape GraphiQL actually sends (abridged)."""
+    q = """
+    query IntrospectionQuery {
+      __schema {
+        queryType { name }
+        mutationType { name }
+        types { ...FullType }
+      }
+    }
+    fragment FullType on __Type {
+      kind name description
+      fields(includeDeprecated: true) {
+        name
+        args { ...InputValue }
+        type { ...TypeRef }
+        isDeprecated
+      }
+      enumValues(includeDeprecated: true) { name }
+      ofType { ...TypeRef }
+    }
+    fragment InputValue on __InputValue { name type { ...TypeRef } defaultValue }
+    fragment TypeRef on __Type {
+      kind name
+      ofType { kind name ofType { kind name ofType { kind name } } }
+    }
+    """
+    out = execute_graphql(gds, _sess(), {"query": q})
+    assert "errors" not in out, out
+    sch = out["data"]["__schema"]
+    assert sch["mutationType"] is None
+    kinds = {t["kind"] for t in sch["types"]}
+    assert {"SCALAR", "OBJECT", "ENUM"} <= kinds
+
+
+def test_fragment_selection_merge(gds):
+    q = """
+    { person(filter: {name: "link"}) { ...A ...B } }
+    fragment A on person { friend { name } }
+    fragment B on person { friend { age } }
+    """
+    out = execute_graphql(gds, _sess(), {"query": q})
+    assert "errors" not in out, out
+    assert out["data"]["person"][0]["friend"] == {"name": "p1", "age": 21}
+
+
+def test_root_fragment_merge_single_execution(gds):
+    q = """
+    { ...A ...B }
+    fragment A on Query { person(filter: {name: "link"}) { name } }
+    fragment B on Query { person(filter: {name: "link"}) { age } }
+    """
+    out = execute_graphql(gds, _sess(), {"query": q})
+    assert "errors" not in out, out
+    assert out["data"]["person"] == [{"name": "link", "age": 1}]
