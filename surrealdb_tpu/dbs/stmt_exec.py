@@ -88,6 +88,9 @@ def select_compute(ctx, stm) -> Any:
             and getattr(sources[0].plan, "provides_order", False)
         ):
             it.order_pushed = True
+            # single-source guarantee lets ranked plans fill their score
+            # lookup lazily (only yielded docs are ever probed)
+            sources[0].plan.order_pushed = True
         try:
             rows = it.output()
         except OrderPushdownBailout:

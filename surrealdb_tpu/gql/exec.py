@@ -433,9 +433,17 @@ def _collect(ctx: _Ctx, sel: List[dict], typename: Optional[str]) -> List[dict]:
             f = dict(f)  # copy: merging must not mutate the parsed AST node
             merged[key] = f
             order.append(f)
-        elif prev["name"] == f["name"] and prev["sel"] is not None and f["sel"] is not None:
-            prev["sel"] = prev["sel"] + f["sel"]
-        # else: duplicate scalar selection — identical by spec, keep the first
+        else:
+            # spec FieldsInSetCanMerge: same response key requires the same
+            # field and arguments — silently dropping one would return
+            # wrong data
+            if prev["name"] != f["name"] or prev.get("args") != f.get("args"):
+                raise SurrealError(
+                    f"GraphQL fields for key {key!r} cannot merge: "
+                    "same response key with different fields or arguments"
+                )
+            if prev["sel"] is not None and f["sel"] is not None:
+                prev["sel"] = prev["sel"] + f["sel"]
     return order
 
 

@@ -244,9 +244,15 @@ def _purge(ctx, txn, level, ac: dict, stm):
 
 
 # ------------------------------------------------------------------ signin
-def bearer_signin(ds, session, creds: Dict[str, Any]) -> str:
+def access_level(ns: Optional[str], db: Optional[str]) -> tuple:
+    """Level tuple from optional NS/DB credentials: () root, (ns,), (ns, db)."""
+    return (ns, db) if ns and db else ((ns,) if ns else ())
+
+
+def bearer_signin(ds, session, creds: Dict[str, Any], ac_def: Optional[dict] = None) -> str:
     """Authenticate a bearer key (reference iam/signin.rs:243-331).
-    Level comes from the provided NS/DB; the key's id locates the grant."""
+    Level comes from the provided NS/DB; the key's id locates the grant.
+    `ac_def` skips the access-method lookup when the caller already has it."""
     from surrealdb_tpu.dbs.session import Auth
     from surrealdb_tpu.iam.token import issue_token
 
@@ -257,10 +263,10 @@ def bearer_signin(ds, session, creds: Dict[str, Any]) -> str:
     kid = key[len(GRANT_BEARER_PREFIX) + 1 :][:GRANT_BEARER_ID_LENGTH]
     ns = creds.get("NS") or creds.get("ns")
     db = creds.get("DB") or creds.get("db")
-    level = (ns, db) if ns and db else ((ns,) if ns else ())
+    level = access_level(ns, db)
     txn = ds.transaction(False)
     try:
-        ac = txn.get_access(level, ac_name)
+        ac = ac_def if ac_def is not None else txn.get_access(level, ac_name)
         gr = txn.get_grant(level, ac_name, kid) if ac else None
     finally:
         txn.cancel()

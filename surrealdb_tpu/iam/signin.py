@@ -30,16 +30,15 @@ def signin(ds, session, creds: Dict[str, Any]) -> str:
         # dispatch on the access method's TYPE, not the key's shape: a
         # RECORD method whose SIGNIN reads $key must not be shadowed by a
         # bearer-looking key (reference signin.rs matches on access kind)
-        level = (ns, db) if ns and db else ((ns,) if ns else ())
+        from .access import access_level, bearer_signin
+
         txn = ds.transaction(False)
         try:
-            acd = txn.get_access(level, ac)
+            acd = txn.get_access(access_level(ns, db), ac)
         finally:
             txn.cancel()
         if acd is not None and acd.get("access_type") == "bearer":
-            from .access import bearer_signin
-
-            return bearer_signin(ds, session, creds)
+            return bearer_signin(ds, session, creds, ac_def=acd)
     if ac and ns and db:
         return _record_signin(ds, session, ns, db, ac, creds)
     if user is None or pwd is None:

@@ -14,6 +14,18 @@ Keyspace (under the index's state prefix `+{ix}!m`):
     l{did}                 doc length
     d{rid}                 rid -> doc id
     r{did}                 doc id -> rid
+    P{tid}{start}          packed posting chunk: did-offsets + tfs for one
+                           bulk batch (u32 arrays; see pack_plist)
+    L{start}               packed doc lengths for dids [start, start+n)
+    R{start}               packed rid list for dids [start, start+n)
+
+Bulk ingest writes ONE packed chunk per (term, batch) instead of one KV key
+per (term, doc): 1M docs x 12 terms collapses from 12M posting keys to
+(vocab x batches) chunk keys, which is what makes commit and the mirror
+build vectorizable. The per-doc `p`/`l`/`r` keys remain as an OVERLAY for
+single-document updates: an overlay entry overrides the packed chunks, and
+a tf<=0 posting / length 0 / rid None is a tombstone. Search and the device
+mirror merge base chunks + overlay.
 """
 
 from __future__ import annotations
@@ -45,6 +57,33 @@ def unpack_posting(raw: bytes) -> dict:
     if len(raw) == 4:
         return {"tf": struct.unpack("<I", raw)[0]}
     return unpack(raw)
+
+
+# ------------------------------------------------------------ chunk codecs
+def pack_plist(base: int, offs: np.ndarray, tfs: np.ndarray) -> bytes:
+    """One term's postings for one bulk batch: did = base + offset."""
+    return (
+        struct.pack("<Iq", len(offs), base)
+        + offs.astype("<u4", copy=False).tobytes()
+        + tfs.astype("<u4", copy=False).tobytes()
+    )
+
+
+def unpack_plist(raw: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (dids int64 ascending, tfs float32)."""
+    n, base = struct.unpack_from("<Iq", raw)
+    offs = np.frombuffer(raw, dtype="<u4", count=n, offset=12)
+    tfs = np.frombuffer(raw, dtype="<u4", count=n, offset=12 + 4 * n)
+    return base + offs.astype(np.int64), tfs.astype(np.float32)
+
+
+def pack_lens(lens: np.ndarray) -> bytes:
+    return struct.pack("<I", len(lens)) + lens.astype("<u4", copy=False).tobytes()
+
+
+def unpack_lens(raw: bytes) -> np.ndarray:
+    n = struct.unpack_from("<I", raw)[0]
+    return np.frombuffer(raw, dtype="<u4", count=n, offset=4).astype(np.float32)
 
 
 def _tf(tokens) -> Dict[str, Tuple[int, List[List[int]]]]:
@@ -100,9 +139,92 @@ class FtIndex:
         txn.set(self._k(ctx, b"r" + enc_u64(did)), pack(rid))
         return did
 
-    def _rid_of(self, ctx, did: int) -> Optional[Thing]:
-        raw = ctx.txn().get(self._k(ctx, b"r" + enc_u64(did)))
-        return unpack(raw) if raw else None
+    def _rid_resolver(self, ctx):
+        """did -> rid resolver for one search: packed R chunks loaded once
+        (bisect for the covering chunk) + per-did overlay point gets."""
+        import bisect as _bisect
+
+        txn = ctx.txn()
+        pre = self._k(ctx, b"R")
+        starts: List[int] = []
+        lists: List[list] = []
+        for chunk in txn.batch(pre, prefix_end(pre), 256):
+            for k, v in chunk:
+                start, _ = dec_u64(k, len(pre))
+                starts.append(start)
+                lists.append(unpack(v))
+        rpre = self._k(ctx, b"r")
+
+        def resolve(did: int) -> Optional[Thing]:
+            raw = txn.get(rpre + enc_u64(did))
+            if raw is not None:
+                return unpack(raw)  # may be a None tombstone
+            i = _bisect.bisect_right(starts, did) - 1
+            if i >= 0:
+                off = did - starts[i]
+                if 0 <= off < len(lists[i]):
+                    return lists[i][off]
+            return None
+
+        return resolve
+
+    # -------------------------------------------------- chunk+overlay reads
+    def _term_postings(self, ctx, tid: int) -> Tuple[np.ndarray, np.ndarray]:
+        """One term's live postings: packed chunks merged with the per-doc
+        overlay (overlay wins; tf<=0 entries are tombstones). Returns
+        (dids int64 ascending, tfs float32)."""
+        txn = ctx.txn()
+        parts_d, parts_t = [], []
+        pre = self._k(ctx, b"P" + enc_u64(tid))
+        for chunk in txn.batch(pre, prefix_end(pre), 1024):
+            for _k, v in chunk:
+                d, t = unpack_plist(v)
+                parts_d.append(d)
+                parts_t.append(t)
+        if parts_d:
+            dids = np.concatenate(parts_d)
+            tfs = np.concatenate(parts_t)
+        else:
+            dids = np.empty(0, np.int64)
+            tfs = np.empty(0, np.float32)
+        pre = self._k(ctx, b"p" + enc_u64(tid))
+        ov: Dict[int, int] = {}
+        for k, raw in txn.scan(pre, prefix_end(pre)):
+            did, _ = dec_u64(k, len(pre))
+            ov[did] = unpack_posting(raw)["tf"]
+        if ov:
+            ov_d = np.fromiter(ov.keys(), np.int64, count=len(ov))
+            ov_t = np.fromiter(ov.values(), np.float32, count=len(ov))
+            if dids.size:
+                keep = ~np.isin(dids, ov_d)
+                dids, tfs = dids[keep], tfs[keep]
+            live = ov_t > 0
+            dids = np.concatenate([dids, ov_d[live]])
+            tfs = np.concatenate([tfs, ov_t[live]])
+            order = np.argsort(dids, kind="stable")
+            dids, tfs = dids[order], tfs[order]
+        return dids, tfs
+
+    def _cand_lens(self, ctx, cand: np.ndarray) -> np.ndarray:
+        """Doc lengths for the (sorted) candidate dids: slice the covering
+        packed L chunks, then per-did overlay point gets."""
+        txn = ctx.txn()
+        out = np.zeros(len(cand), dtype=np.float32)
+        pre = self._k(ctx, b"L")
+        for chunk in txn.batch(pre, prefix_end(pre), 1024):
+            for k, v in chunk:
+                start, _ = dec_u64(k, len(pre))
+                lens = unpack_lens(v)
+                lo = np.searchsorted(cand, start)
+                hi = np.searchsorted(cand, start + len(lens))
+                if lo < hi:
+                    out[lo:hi] = lens[cand[lo:hi] - start]
+        lpre = self._k(ctx, b"l")
+        for i, did in enumerate(cand):
+            raw = txn.get(lpre + enc_u64(int(did)))
+            if raw is not None:
+                out[i] = unpack(raw)
+        return out
 
     # ------------------------------------------------------------ terms
     def _term(self, ctx, term: str) -> Optional[dict]:
@@ -127,20 +249,26 @@ class FtIndex:
         if did is None:
             return
 
-        # remove the old posting set
+        # remove the old posting set: tombstones, not deletes — the old
+        # postings may live inside packed bulk chunks the overlay overrides
         old_tf = _tf(old_tokens) if old_tokens is not None else None
         if old_tokens is not None:
             for term in old_tf:
                 meta = self._term(ctx, term)
                 if meta is None:
                     continue
-                txn.delete(self._k(ctx, b"p" + enc_u64(meta["id"]) + enc_u64(did)))
+                txn.set(
+                    self._k(ctx, b"p" + enc_u64(meta["id"]) + enc_u64(did)),
+                    pack_posting(0),
+                )
                 meta["df"] -= 1
                 self._put_term(ctx, term, meta)
             lraw = txn.get(self._k(ctx, b"l" + enc_u64(did)))
             if lraw is not None:
                 st["tl"] -= unpack(lraw)
-                txn.delete(self._k(ctx, b"l" + enc_u64(did)))
+            else:
+                st["tl"] -= int(self._chunk_len_of(ctx, did))
+            txn.set(self._k(ctx, b"l" + enc_u64(did)), pack(0))
             st["dc"] -= 1
 
         # write the new posting set
@@ -163,8 +291,9 @@ class FtIndex:
             st["dc"] += 1
         else:
             # document no longer has the field: drop the id mapping
+            # (rid map tombstone: the did may live in a packed R chunk)
             txn.delete(self._k(ctx, b"d" + enc_value_key(rid)))
-            txn.delete(self._k(ctx, b"r" + enc_u64(did)))
+            txn.set(self._k(ctx, b"r" + enc_u64(did)), pack(None))
 
         self._put_stats(ctx, st)
         # buffered mirror delta, applied on commit (idx/ft_mirror.py)
@@ -175,19 +304,102 @@ class FtIndex:
             self.tb,
             self.name,
             rid,
+            did,
             {t: c for t, (c, _) in old_tf.items()} if old_tf is not None else None,
             {t: c for t, (c, _) in tfs.items()} if tfs is not None else None,
             len(new_tokens) if new_tokens is not None else 0,
         )
 
+    def _chunk_len_of(self, ctx, did: int) -> float:
+        """Doc length for a bulk-chunk-indexed doc (no per-doc l key):
+        the covering L chunk is the last one with start <= did."""
+        txn = ctx.txn()
+        pre = self._k(ctx, b"L")
+        last = None
+        for k, v in txn.scan(pre, pre + enc_u64(did) + b"\xff"):
+            last = (k, v)
+        if last is None:
+            return 0.0
+        start, _ = dec_u64(last[0], len(pre))
+        lens = unpack_lens(last[1])
+        off = did - start
+        return float(lens[off]) if 0 <= off < len(lens) else 0.0
+
     def index_documents_bulk(self, ctx, batch) -> None:
         """Index a batch of NEW documents (no prior posting sets — the bulk
-        insert path verified the records did not exist). Statistics and term
-        metadata are merged in memory across the batch and written once per
-        distinct term / once per batch, instead of the per-(term, doc)
-        read-modify-write the single-document path pays."""
+        insert path verified the records did not exist). The offset-free
+        path writes ONE packed chunk per touched term (plus one lengths +
+        one rid chunk) instead of per-(term, doc) keys; highlight-enabled
+        indexes need per-posting offsets and keep the per-doc layout."""
+        if self.highlights:
+            return self._bulk_with_offsets(ctx, batch)
         from collections import Counter
 
+        st = self._stats(ctx)
+        txn = ctx.txn()
+        az = self.analyzer(ctx)
+        ns, db = ctx.ns_db()
+        base = self._k(ctx, b"")
+        tset = txn.set
+
+        start = st["nd"]
+        term_offs: Dict[str, List[int]] = {}
+        term_tfs: Dict[str, List[int]] = {}
+        lens: List[int] = []
+        rids: List[Thing] = []
+        for rid, vals in batch:
+            terms = self._terms_of_fast(az, vals)
+            if terms is None:
+                continue
+            tf_counts = Counter(terms)
+            # records on this path are verified-new (the bulk inserter
+            # checked existence), so the id mapping cannot exist
+            did = st["nd"]
+            st["nd"] += 1
+            tset(base + b"d" + enc_value_key(rid), pack(did))
+            off = did - start
+            for term, count in tf_counts.items():
+                lo = term_offs.get(term)
+                if lo is None:
+                    lo = term_offs[term] = []
+                    term_tfs[term] = []
+                lo.append(off)
+                term_tfs[term].append(count)
+            lens.append(len(terms))
+            rids.append(rid)
+
+        if rids:
+            delta_terms: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+            for term, offs in term_offs.items():
+                meta = self._term(ctx, term)
+                if meta is None:
+                    meta = {"id": st["nt"], "df": 0}
+                    st["nt"] += 1
+                meta["df"] += len(offs)
+                self._put_term(ctx, term, meta)
+                offs_a = np.asarray(offs, dtype=np.uint32)
+                tfs_a = np.asarray(term_tfs[term], dtype=np.uint32)
+                tset(
+                    base + b"P" + enc_u64(meta["id"]) + enc_u64(start),
+                    pack_plist(start, offs_a, tfs_a),
+                )
+                delta_terms[term] = (
+                    start + offs_a.astype(np.int64),
+                    tfs_a.astype(np.float32),
+                )
+            lens_a = np.asarray(lens, dtype=np.uint32)
+            tset(base + b"L" + enc_u64(start), pack_lens(lens_a))
+            tset(base + b"R" + enc_u64(start), pack(list(rids)))
+            st["tl"] += int(lens_a.sum())
+            st["dc"] += len(rids)
+            txn.ft_bulk_delta(
+                ns, db, self.tb, self.name,
+                start, delta_terms, lens_a.astype(np.float32), rids,
+            )
+        self._put_stats(ctx, st)
+
+    def _bulk_with_offsets(self, ctx, batch) -> None:
+        """Per-doc bulk path for highlight indexes (postings carry offsets)."""
         st = self._stats(ctx)
         txn = ctx.txn()
         az = self.analyzer(ctx)
@@ -197,29 +409,16 @@ class FtIndex:
         touched: set = set()
         base = self._k(ctx, b"")
         pbase = base + b"p"
-        hl = self.highlights
         tset = txn.set
         ft_delta = txn.ft_delta
 
         for rid, vals in batch:
-            if hl:
-                tokens = self._tokens_of(az, vals)
-                if tokens is None:
-                    continue
-                tfs_full = _tf(tokens)
-                tf_counts: Dict[str, int] = {t: c for t, (c, _) in tfs_full.items()}
-                length = len(tokens)
-            else:
-                # offset-free fast path: bulk inserts never highlight, so
-                # the analyzer can skip span tracking entirely
-                terms = self._terms_of_fast(az, vals)
-                if terms is None:
-                    continue
-                tfs_full = None
-                tf_counts = Counter(terms)
-                length = len(terms)
-            # records on this path are verified-new (the bulk inserter checked
-            # existence), so the doc-id mapping cannot exist: allocate blind
+            tokens = self._tokens_of(az, vals)
+            if tokens is None:
+                continue
+            tfs_full = _tf(tokens)
+            tf_counts: Dict[str, int] = {t: c for t, (c, _) in tfs_full.items()}
+            length = len(tokens)
             did = st["nd"]
             st["nd"] += 1
             did_enc = enc_u64(did)
@@ -239,14 +438,11 @@ class FtIndex:
                 te = tid_enc.get(term)
                 if te is None:
                     te = tid_enc[term] = enc_u64(meta["id"])
-                tset(
-                    pbase + te + did_enc,
-                    pack_posting(count, tfs_full[term][1] if tfs_full else None),
-                )
+                tset(pbase + te + did_enc, pack_posting(count, tfs_full[term][1]))
             tset(base + b"l" + did_enc, pack(length))
             st["tl"] += length
             st["dc"] += 1
-            ft_delta(ns, db, self.tb, self.name, rid, None, dict(tf_counts), length)
+            ft_delta(ns, db, self.tb, self.name, rid, did, None, dict(tf_counts), length)
 
         for term in touched:
             self._put_term(ctx, term, term_cache[term])
@@ -297,36 +493,28 @@ class FtIndex:
         if not term_metas:
             return FtResults(self, {}, terms)
 
-        # postings scan per term, rarest first for cheap intersection
+        # postings per term (packed chunks + overlay), rarest first for
+        # cheap sorted-array intersection
         term_metas.sort(key=lambda tm: tm[1]["df"])
-        candidate: Optional[Dict[int, List[int]]] = None  # did -> [tf per term]
-        for pos, (t, meta) in enumerate(term_metas):
-            pre = self._k(ctx, b"p" + enc_u64(meta["id"]))
-            found: Dict[int, dict] = {}
-            for k, raw in txn.scan(pre, prefix_end(pre)):
-                did, _ = dec_u64(k, len(pre))
-                found[did] = unpack_posting(raw)
-            if candidate is None:
-                candidate = {did: [p["tf"]] for did, p in found.items()}
-            else:
-                nxt = {}
-                for did, tfs in candidate.items():
-                    if did in found:
-                        nxt[did] = tfs + [found[did]["tf"]]
-                candidate = nxt
-            if not candidate:
+        rows = [self._term_postings(ctx, meta["id"]) for _, meta in term_metas]
+        cand = rows[0][0]
+        tf_cols = [rows[0][1]]
+        for r_dids, r_tfs in rows[1:]:
+            if cand.size == 0 or r_dids.size == 0:
                 return FtResults(self, {}, terms)
+            pos = np.searchsorted(r_dids, cand)
+            pos_c = np.clip(pos, 0, len(r_dids) - 1)
+            mask = r_dids[pos_c] == cand
+            cand = cand[mask]
+            tf_cols = [c[mask] for c in tf_cols]
+            tf_cols.append(r_tfs[pos_c[mask]])
+        if cand.size == 0:
+            return FtResults(self, {}, terms)
 
-        dids = list(candidate.keys())
-        tf_mat = np.asarray([candidate[d] for d in dids], dtype=np.float32)
+        dids = [int(d) for d in cand]
+        tf_mat = np.stack(tf_cols, axis=1)
         df = np.asarray([m["df"] for _, m in term_metas], dtype=np.float32)
-        lens = np.asarray(
-            [
-                unpack(txn.get(self._k(ctx, b"l" + enc_u64(d))) or pack(0))
-                for d in dids
-            ],
-            dtype=np.float32,
-        )
+        lens = self._cand_lens(ctx, cand)
 
         k1 = float(self.ix["index"].get("k1", 1.2))
         b = float(self.ix["index"].get("b", 0.75))
@@ -347,9 +535,10 @@ class FtIndex:
                     np.float32(st["dc"]), np.float32(st["tl"]), k1, b,
                 )
             )
+        resolve = self._rid_resolver(ctx)
         by_rid: Dict[Tuple[str, str], Tuple[Thing, float]] = {}
         for did, s in zip(dids, scores):
-            rid = self._rid_of(ctx, did)
+            rid = resolve(did)
             if rid is not None:
                 by_rid[(rid.tb, repr(rid.id))] = (rid, float(s))
         return FtResults(self, by_rid, terms)

@@ -3,49 +3,59 @@
 Role of the reference's per-query posting B-tree walks (reference:
 core/src/idx/ft/postings.rs, termdocs.rs, scorer.rs:13-92) re-designed
 TPU-first, the same way idx/knn.py mirrors vectors and idx/graph_csr.py
-mirrors edges: the inverted index's postings are packed once into CSR arrays
+mirrors edges: the inverted index's postings are packed into CSR arrays
 (term → sorted doc ids + term frequencies) kept in sync with committed
-writes by per-document deltas, so a MATCHES query is numpy slicing +
-searchsorted intersection + ONE batched BM25 kernel (ops/bm25.py) instead of
-a per-posting KV scan-and-unpack loop.
+writes, so a MATCHES query is numpy slicing + searchsorted intersection +
+ONE batched BM25 kernel (ops/bm25.py) instead of a per-posting KV
+scan-and-unpack loop.
 
-The KV inverted index (idx/ft_index.py) stays authoritative/durable; this is
-the compute replica (reference analog: TreeCache generation swap,
+The mirror's base state is the bulk ingest's packed chunks
+(idx/ft_index.py P/L/R keys) loaded wholesale as numpy arrays — the build
+never unpacks per-(term, doc) keys for bulk data. Single-document changes
+land in small per-term overlay dicts (tf<=0 = tombstone) merged into the
+CSR lazily, mirroring the KV layout's chunk+overlay split exactly.
+
+The KV inverted index stays authoritative/durable; this is the compute
+replica (reference analog: TreeCache generation swap,
 trees/store/cache.rs — improved to incremental deltas, VERDICT r1 item 4).
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from surrealdb_tpu import key as keys
-from surrealdb_tpu.key.encode import dec_u64, enc_u64, prefix_end
+from surrealdb_tpu.key.encode import dec_u64, prefix_end
 from surrealdb_tpu.sql.value import Thing
 from surrealdb_tpu.utils.ser import unpack
-from surrealdb_tpu.idx.ft_index import unpack_posting
-
-
-def _rid_key(rid) -> tuple:
-    return (rid.tb, repr(rid.id)) if isinstance(rid, Thing) else rid
+from surrealdb_tpu.idx.ft_index import unpack_lens, unpack_plist, unpack_posting
 
 
 class FtMirror:
-    """One search index's postings, host-authoritative dicts + lazily
-    compacted CSR arrays (pattern of idx/graph_csr.py PointerCsr)."""
+    """One search index's postings: packed base chunks + overlay dicts,
+    lazily compacted into CSR arrays (pattern of idx/graph_csr.py)."""
 
     def __init__(self):
         self.built = False
         self.term_ids: Dict[str, int] = {}  # term -> local tid
-        self.postings: List[Dict[int, int]] = []  # tid -> {did: tf}
-        self.doc_len: Dict[int, int] = {}
-        self.did_of: Dict[tuple, int] = {}
-        self.rid_of: Dict[int, Thing] = {}
+        # base postings: per tid, list of (dids asc, tfs) chunk arrays in
+        # ascending did order (chunk starts are allocated monotonically)
+        self.chunks: List[List[Tuple[np.ndarray, np.ndarray]]] = []
+        self.overlay: List[Dict[int, float]] = []  # per tid; tf<=0 tombstone
+        # doc lengths: [(start, lens f32)] + overlay {did: len} (0 = absent)
+        self.len_chunks: List[Tuple[int, np.ndarray]] = []
+        self.len_overlay: Dict[int, float] = {}
+        # did -> rid: [(start, rid list)] + overlay {did: rid | None}
+        self.rid_chunks: List[Tuple[int, list]] = []
+        self.rid_overlay: Dict[int, Optional[Thing]] = {}
+        self._chunk_starts: set = set()  # bulk idempotence guard
         self.next_did = 0
-        self.dc = 0  # docs indexed
-        self.tl = 0  # total token length
+        self.dc = 0
+        self.tl = 0.0
         self.dirty = True
         # compacted arrays
         self.t_indptr: Optional[np.ndarray] = None
@@ -73,9 +83,10 @@ class FtMirror:
             txn = ctx.ds().transaction(False)
             try:
                 base = keys.index_state(ns, db, tb, name, b"")
+                st_raw = txn.get(base + b"s")
+                st = unpack(st_raw) if st_raw else {"dc": 0, "tl": 0, "nt": 0, "nd": 0}
                 kv_tid_local: Dict[int, int] = {}
                 term_ids: Dict[str, int] = {}
-                postings: List[Dict[int, int]] = []
                 # terms: t{term} -> {id, df}
                 pre = base + b"t"
                 for chunk in txn.batch(pre, prefix_end(pre), 4096):
@@ -84,52 +95,83 @@ class FtMirror:
                         if meta.get("df", 0) <= 0:
                             continue
                         term = self._dec_term(k, len(pre))
-                        local = len(postings)
+                        local = len(term_ids)
                         term_ids[term] = local
                         kv_tid_local[meta["id"]] = local
-                        postings.append({})
-                # postings: p{tid}{did} -> {tf}
+                chunks: List[List[Tuple[np.ndarray, np.ndarray]]] = [
+                    [] for _ in range(len(term_ids))
+                ]
+                overlay: List[Dict[int, float]] = [{} for _ in range(len(term_ids))]
+                # packed posting chunks: P{tid}{start}
+                chunk_starts: set = set()
+                pre = base + b"P"
+                for batch in txn.batch(pre, prefix_end(pre), 1024):
+                    for k, v in batch:
+                        tid, off = dec_u64(k, len(pre))
+                        start, _ = dec_u64(k, off)
+                        local = kv_tid_local.get(tid)
+                        if local is not None:
+                            chunks[local].append(unpack_plist(v))
+                        chunk_starts.add(start)
+                # posting overlay: p{tid}{did}
                 pre = base + b"p"
-                for chunk in txn.batch(pre, prefix_end(pre), 8192):
-                    for k, v in chunk:
+                for batch in txn.batch(pre, prefix_end(pre), 8192):
+                    for k, v in batch:
                         tid, off = dec_u64(k, len(pre))
                         did, _ = dec_u64(k, off)
                         local = kv_tid_local.get(tid)
                         if local is not None:
-                            postings[local][did] = unpack_posting(v)["tf"]
-                # doc lengths: l{did}
-                doc_len: Dict[int, int] = {}
+                            overlay[local][did] = float(unpack_posting(v)["tf"])
+                # doc lengths
+                len_chunks: List[Tuple[int, np.ndarray]] = []
+                pre = base + b"L"
+                for batch in txn.batch(pre, prefix_end(pre), 1024):
+                    for k, v in batch:
+                        start, _ = dec_u64(k, len(pre))
+                        len_chunks.append((start, unpack_lens(v)))
+                len_overlay: Dict[int, float] = {}
                 pre = base + b"l"
-                for chunk in txn.batch(pre, prefix_end(pre), 8192):
-                    for k, v in chunk:
+                for batch in txn.batch(pre, prefix_end(pre), 8192):
+                    for k, v in batch:
                         did, _ = dec_u64(k, len(pre))
-                        doc_len[did] = unpack(v)
-                # rid maps: r{did}
-                rid_of: Dict[int, Thing] = {}
-                did_of: Dict[tuple, int] = {}
+                        len_overlay[did] = float(unpack(v))
+                # rid maps
+                rid_chunks: List[Tuple[int, list]] = []
+                pre = base + b"R"
+                for batch in txn.batch(pre, prefix_end(pre), 256):
+                    for k, v in batch:
+                        start, _ = dec_u64(k, len(pre))
+                        rid_chunks.append((start, unpack(v)))
+                rid_overlay: Dict[int, Optional[Thing]] = {}
                 pre = base + b"r"
-                for chunk in txn.batch(pre, prefix_end(pre), 8192):
-                    for k, v in chunk:
+                for batch in txn.batch(pre, prefix_end(pre), 8192):
+                    for k, v in batch:
                         did, _ = dec_u64(k, len(pre))
-                        rid = unpack(v)
-                        rid_of[did] = rid
-                        did_of[_rid_key(rid)] = did
+                        rid_overlay[did] = unpack(v)
             finally:
                 txn.cancel()
+            len_chunks.sort(key=lambda c: c[0])
+            rid_chunks.sort(key=lambda c: c[0])
             with self._lock:
                 self.term_ids = term_ids
-                self.postings = postings
-                self.doc_len = doc_len
-                self.rid_of = rid_of
-                self.did_of = did_of
-                self.next_did = max(rid_of) + 1 if rid_of else 0
-                self.dc = len(doc_len)
-                self.tl = sum(doc_len.values())
+                self.chunks = chunks
+                self.overlay = overlay
+                self.len_chunks = len_chunks
+                self.len_overlay = len_overlay
+                self.rid_chunks = rid_chunks
+                self.rid_overlay = rid_overlay
+                self._chunk_starts = chunk_starts | {s for s, _ in len_chunks}
+                self.next_did = st["nd"]
+                self.dc = st["dc"]
+                self.tl = float(st["tl"])
                 self.dirty = True
                 self.built = True
                 pending, self._pending = self._pending, None
-                for args in pending:
-                    self.apply_ft(*args)
+                for tag, args in pending:
+                    if tag == "doc":
+                        self.apply_ft(*args)
+                    else:
+                        self.apply_ft_bulk(*args)
 
     @staticmethod
     def _dec_term(k: bytes, off: int) -> str:
@@ -138,9 +180,32 @@ class FtMirror:
         return dec_str(k, off)[0]
 
     # ------------------------------------------------------------ deltas
+    def _tid_for(self, term: str) -> int:
+        tid = self.term_ids.get(term)
+        if tid is None:
+            tid = len(self.term_ids)
+            self.term_ids[term] = tid
+            self.chunks.append([])
+            self.overlay.append({})
+        return tid
+
+    def _len_of(self, did: int) -> float:
+        """Current doc length; 0 = not indexed."""
+        v = self.len_overlay.get(did)
+        if v is not None:
+            return v
+        i = bisect.bisect_right(self.len_chunks, did, key=lambda c: c[0]) - 1
+        if i >= 0:
+            start, lens = self.len_chunks[i]
+            off = did - start
+            if 0 <= off < len(lens):
+                return float(lens[off])
+        return 0.0
+
     def apply_ft(
         self,
         rid,
+        did: int,
         old_tf: Optional[Dict[str, int]],
         new_tf: Optional[Dict[str, int]],
         new_len: int,
@@ -149,77 +214,133 @@ class FtMirror:
         idx/ft_index.py index_document's diff semantics; None = absent."""
         with self._lock:
             if self._pending is not None:
-                self._pending.append((rid, old_tf, new_tf, new_len))
+                self._pending.append(("doc", (rid, did, old_tf, new_tf, new_len)))
                 return
             if not self.built:
                 return
-            k = _rid_key(rid)
-            did = self.did_of.get(k)
-            if old_tf is not None and did is not None:
+            if old_tf is not None:
                 for term in old_tf:
                     tid = self.term_ids.get(term)
                     if tid is not None:
-                        self.postings[tid].pop(did, None)
-                ln = self.doc_len.pop(did, None)
-                if ln is not None:
-                    self.tl -= ln
+                        self.overlay[tid][did] = 0.0
+                prev = self._len_of(did)
+                if prev > 0:
+                    self.tl -= prev
                     self.dc -= 1
+                self.len_overlay[did] = 0.0
             if new_tf is not None:
-                if did is None:
-                    did = self.next_did
-                    self.next_did += 1
-                    self.did_of[k] = did
-                    self.rid_of[did] = rid
                 # idempotence (the build-window replay protocol relies on
-                # it, like VectorMirror.apply): a delta whose doc the build
-                # scan already loaded must not double-count dc/tl
-                prev = self.doc_len.get(did)
-                if prev is not None:
+                # it): a delta whose doc the build scan already loaded must
+                # not double-count dc/tl
+                prev = self._len_of(did)
+                if prev > 0:
                     self.tl -= prev
                     self.dc -= 1
                 for term, tf in new_tf.items():
-                    tid = self.term_ids.get(term)
-                    if tid is None:
-                        tid = len(self.postings)
-                        self.term_ids[term] = tid
-                        self.postings.append({})
-                    self.postings[tid][did] = tf
-                self.doc_len[did] = new_len
+                    self.overlay[self._tid_for(term)][did] = float(tf)
+                self.len_overlay[did] = float(new_len)
+                self.rid_overlay[did] = rid
                 self.dc += 1
                 self.tl += new_len
-            elif did is not None:
-                self.did_of.pop(k, None)
-                self.rid_of.pop(did, None)
+                if did >= self.next_did:
+                    self.next_did = did + 1
+            elif old_tf is not None:
+                self.rid_overlay[did] = None
             self.dirty = True
+
+    def apply_ft_bulk(self, start: int, terms: Dict[str, tuple], lens, rids) -> None:
+        """One committed bulk batch: append its packed arrays as new base
+        chunks (no per-doc work)."""
+        with self._lock:
+            if self._pending is not None:
+                self._pending.append(("bulk", (start, terms, lens, rids)))
+                return
+            if not self.built:
+                return
+            if start in self._chunk_starts:
+                return  # the build scan already loaded this batch
+            self._chunk_starts.add(start)
+            for term, (dids, tfs) in terms.items():
+                self.chunks[self._tid_for(term)].append(
+                    (np.asarray(dids), np.asarray(tfs, dtype=np.float32))
+                )
+            lens = np.asarray(lens, dtype=np.float32)
+            self.len_chunks.append((start, lens))
+            self.rid_chunks.append((start, list(rids)))
+            self.dc += len(lens)
+            self.tl += float(lens.sum())
+            if start + len(lens) > self.next_did:
+                self.next_did = start + len(lens)
+            self.dirty = True
+
+    # ------------------------------------------------------------ rid map
+    def rid_for(self, did: int) -> Optional[Thing]:
+        with self._lock:
+            if did in self.rid_overlay:
+                return self.rid_overlay[did]
+            i = bisect.bisect_right(self.rid_chunks, did, key=lambda c: c[0]) - 1
+            if i >= 0:
+                start, rids = self.rid_chunks[i]
+                off = did - start
+                if 0 <= off < len(rids):
+                    return rids[off]
+            return None
 
     # ------------------------------------------------------------ arrays
     def _ensure_arrays(self) -> None:
         if not self.dirty and self.t_indptr is not None:
             return
-        T = len(self.postings)
-        counts = np.fromiter(
-            (len(p) for p in self.postings), dtype=np.int64, count=T
-        )
+        T = len(self.term_ids)
+        rows: List[Tuple[np.ndarray, np.ndarray]] = []
+        for tid in range(T):
+            parts = self.chunks[tid]
+            ov = self.overlay[tid]
+            if parts and not ov:
+                if len(parts) == 1:
+                    rows.append(parts[0])
+                else:
+                    d = np.concatenate([p[0] for p in parts])
+                    f = np.concatenate([p[1] for p in parts])
+                    rows.append((d, f))
+                    self.chunks[tid] = [rows[-1]]  # keep the compaction
+                continue
+            if parts:
+                d = np.concatenate([p[0] for p in parts])
+                f = np.concatenate([p[1] for p in parts])
+            else:
+                d = np.empty(0, np.int64)
+                f = np.empty(0, np.float32)
+            if ov:
+                ov_d = np.fromiter(ov.keys(), np.int64, count=len(ov))
+                ov_t = np.fromiter(ov.values(), np.float32, count=len(ov))
+                if d.size:
+                    keep = ~np.isin(d, ov_d)
+                    d, f = d[keep], f[keep]
+                live = ov_t > 0
+                d = np.concatenate([d, ov_d[live]])
+                f = np.concatenate([f, ov_t[live]])
+                order = np.argsort(d, kind="stable")
+                d, f = d[order], f[order]
+            rows.append((d, f))
+        counts = np.fromiter((len(r[0]) for r in rows), dtype=np.int64, count=T)
         indptr = np.zeros(T + 1, dtype=np.int64)
         np.cumsum(counts, out=indptr[1:])
         nnz = int(indptr[-1])
         dids = np.empty(nnz, dtype=np.int64)
         tfs = np.empty(nnz, dtype=np.float32)
-        for tid, p in enumerate(self.postings):
+        for tid, (d, f) in enumerate(rows):
             s, e = indptr[tid], indptr[tid + 1]
-            if s == e:
-                continue
-            d = np.fromiter(p.keys(), dtype=np.int64, count=len(p))
-            f = np.fromiter(p.values(), dtype=np.float32, count=len(p))
-            order = np.argsort(d, kind="stable")
-            dids[s:e] = d[order]
-            tfs[s:e] = f[order]
+            dids[s:e] = d
+            tfs[s:e] = f
         cap = max(self.next_did, 1)
         dl = np.zeros(cap, dtype=np.float32)
-        if self.doc_len:
-            idx = np.fromiter(self.doc_len.keys(), dtype=np.int64, count=len(self.doc_len))
-            val = np.fromiter(self.doc_len.values(), dtype=np.float32, count=len(self.doc_len))
-            dl[idx] = val
+        for start, lens in self.len_chunks:
+            dl[start : start + len(lens)] = lens
+        if self.len_overlay:
+            idx = np.fromiter(self.len_overlay.keys(), np.int64, count=len(self.len_overlay))
+            val = np.fromiter(self.len_overlay.values(), np.float32, count=len(self.len_overlay))
+            ok = idx < cap
+            dl[idx[ok]] = val[ok]
         self.t_indptr, self.t_dids, self.t_tfs, self.doclen_arr = indptr, dids, tfs, dl
         self.dirty = False
 

@@ -24,6 +24,8 @@ class MatchesPlan:
         self.query = query if isinstance(query, str) else str(query)
         self.ft = FtIndex.for_index(None, ix)
         self.results = None  # FtResults after iterate()
+        self.provides_order = False  # set by the planner (score-order pushdown)
+        self.order_pushed = False  # set by stmt_exec when it's the only source
 
     def explain(self) -> dict:
         return {
@@ -38,7 +40,7 @@ class MatchesPlan:
         ns, db = ctx.ns_db()
         want = (ns, db, self.tb, self.ix["name"])
         pending = getattr(ctx.txn(), "ft_deltas", None)
-        if pending and any(d[:4] == want for d in pending):
+        if pending and any(d[1:5] == want for d in pending):
             # this txn has uncommitted writes to the index: exact KV search
             # (sees the txn's own writes; the shared mirror must not)
             self.results = self.ft.search(ctx, self.query)
@@ -54,12 +56,33 @@ class MatchesPlan:
             k1 = float(self.ix["index"].get("k1", 1.2))
             b = float(self.ix["index"].get("b", 0.75))
             dids, scores = mirror.search(terms, k1, b)
+            import numpy as np
+
+            order = np.argsort(-scores, kind="stable")
+            if self.order_pushed:
+                # single-source score-ordered scan: LIMIT stops iteration
+                # after a handful of rows, so materialize rids lazily and
+                # fill the score lookup as docs are yielded (only yielded
+                # docs are ever probed by matches()/score())
+                self.results = FtResults(self.ft, {}, terms)
+                by_rid = self.results.by_rid
+                for i in order:
+                    rid = mirror.rid_for(int(dids[i]))
+                    if rid is None:
+                        continue
+                    s = float(scores[i])
+                    by_rid[(rid.tb, repr(rid.id))] = (rid, s)
+                    yield rid, None, {"score": s}
+                return
             by_rid = {}
-            for did, s in zip(dids, scores):
-                rid = mirror.rid_of.get(int(did))
+            for i in order:
+                rid = mirror.rid_for(int(dids[i]))
                 if rid is not None:
-                    by_rid[(rid.tb, repr(rid.id))] = (rid, float(s))
+                    by_rid[(rid.tb, repr(rid.id))] = (rid, float(scores[i]))
             self.results = FtResults(self.ft, by_rid, terms)
+            for rid, score in by_rid.values():
+                yield rid, None, {"score": score}
+            return
         ranked = sorted(self.results, key=lambda rs: -rs[1])
         for rid, score in ranked:
             yield rid, None, {"score": score}

@@ -382,8 +382,45 @@ def _plan_matches(ctx, tb: str, indexes: List[dict], m: MatchesOp, stm):
             continue
         if not ix["fields"] or repr(ix["fields"][0]) != field_txt:
             continue
-        return MatchesPlan(tb, ix, m, m.r.compute(ctx))
+        plan = MatchesPlan(tb, ix, m, m.r.compute(ctx))
+        plan.provides_order = _matches_score_order(stm, m)
+        return plan
     return None
+
+
+def _matches_score_order(stm, m: MatchesOp) -> bool:
+    """ORDER BY <search score> DESC — directly or through a projection
+    alias — ranks rows exactly how the MATCHES iterator already yields
+    them (BM25 descending), so the post-sort can be skipped and LIMIT can
+    stop the scan early (the reference's top-k search shortcut;
+    planner/executor.rs score-ordered iteration)."""
+    order = getattr(stm, "order", None)
+    if not order or len(order) != 1:
+        return False
+    o = order[0]
+    if o.asc or getattr(o, "rand", False):
+        return False
+    if stm.group or getattr(stm, "group_all", False) or stm.split:
+        return False
+    target = repr(o.idiom)
+    expr = None
+    for f in getattr(stm, "fields", None) or []:
+        if getattr(f, "all", False) or f.expr is None:
+            continue
+        name = repr(f.alias) if f.alias is not None else repr(f.expr)
+        if name == target:
+            expr = f.expr
+            break
+    if expr is None:
+        return False
+    from surrealdb_tpu.sql.ast import FunctionCall
+
+    return (
+        isinstance(expr, FunctionCall)
+        and expr.name == "search::score"
+        and len(expr.args) == 1
+        and repr(expr.args[0]) == repr(m.ref)
+    )
 
 
 def _plan_condition(ctx, tb: str, indexes: List[dict], cond):

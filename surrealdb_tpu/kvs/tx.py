@@ -75,10 +75,14 @@ class Transaction:
                     mirror.apply(rid, vec)
             self.vector_deltas = []
         if self.ft_deltas and self._index_stores is not None:
-            for ns, db, tb, name, rid, old_tf, new_tf, new_len in self.ft_deltas:
-                mirror = self._index_stores.get(ns, db, tb, name)
-                if mirror is not None and hasattr(mirror, "apply_ft"):
-                    mirror.apply_ft(rid, old_tf, new_tf, new_len)
+            for d in self.ft_deltas:
+                mirror = self._index_stores.get(d[1], d[2], d[3], d[4])
+                if mirror is None:
+                    continue
+                if d[0] == "doc" and hasattr(mirror, "apply_ft"):
+                    mirror.apply_ft(*d[5:])
+                elif d[0] == "bulk" and hasattr(mirror, "apply_ft_bulk"):
+                    mirror.apply_ft_bulk(*d[5:])
             self.ft_deltas = []
         for fn in self._on_commit:
             fn()
@@ -141,10 +145,15 @@ class Transaction:
         """Record one vector-row mutation for post-commit mirror upkeep."""
         self.vector_deltas.append((ns, db, tb, name, rid, vec))
 
-    def ft_delta(self, ns, db, tb, name, rid, old_tf, new_tf, new_len) -> None:
+    def ft_delta(self, ns, db, tb, name, rid, did, old_tf, new_tf, new_len) -> None:
         """Record one full-text document mutation for post-commit mirror
         upkeep (idx/ft_mirror.py)."""
-        self.ft_deltas.append((ns, db, tb, name, rid, old_tf, new_tf, new_len))
+        self.ft_deltas.append(("doc", ns, db, tb, name, rid, did, old_tf, new_tf, new_len))
+
+    def ft_bulk_delta(self, ns, db, tb, name, start, terms, lens, rids) -> None:
+        """Record one bulk-ingested batch (packed chunk arrays) for
+        post-commit mirror upkeep (idx/ft_mirror.py apply_ft_bulk)."""
+        self.ft_deltas.append(("bulk", ns, db, tb, name, start, terms, lens, rids))
 
     def cancel(self) -> None:
         self.tr.cancel()

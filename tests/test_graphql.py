@@ -244,3 +244,9 @@ def test_root_fragment_merge_single_execution(gds):
     out = execute_graphql(gds, _sess(), {"query": q})
     assert "errors" not in out, out
     assert out["data"]["person"] == [{"name": "link", "age": 1}]
+
+
+def test_conflicting_args_same_key_rejected(gds):
+    q = '{ person(filter: {name: "p1"}) { name } person(filter: {name: "p2"}) { age } }'
+    out = execute_graphql(gds, _sess(), {"query": q})
+    assert "cannot merge" in out["errors"][0]["message"]
