@@ -66,6 +66,17 @@ TPU_KNN_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_KNN_ONDEVICE_THRESHOLD", 4096
 # should lower this.
 TPU_FT_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_FT_ONDEVICE_THRESHOLD", 262_144)
 TPU_GRAPH_ONDEVICE_THRESHOLD = _env_int("SURREAL_TPU_GRAPH_ONDEVICE_THRESHOLD", 2048)
+# static-shape stabilizers for the fused chain kernel: frontier pad floor and
+# fixed vmap lane count, so concurrent chain queries share ONE compiled
+# executable (XLA compiles per shape; ~20s+ each on a tunneled chip)
+TPU_GRAPH_FRONTIER_PAD = _env_int("SURREAL_TPU_GRAPH_FRONTIER_PAD", 256)
+TPU_GRAPH_BATCH_LANES = _env_int("SURREAL_TPU_GRAPH_BATCH_LANES", 32)
+# count-only chains over at least this many total edges skip host hops and
+# run the whole chain on device from the seed frontier
+TPU_GRAPH_COUNT_EDGES = _env_int("SURREAL_TPU_GRAPH_COUNT_EDGES", 50_000)
+# largest per-table node count for the composed dense-matmul count path
+# (a 16384^2 bf16 operator is 512MB device-resident)
+TPU_GRAPH_DENSE_MAX = _env_int("SURREAL_TPU_GRAPH_DENSE_MAX", 16384)
 # corpus size at which `<|k|>` switches from exact search to the IVF ANN
 TPU_ANN_MIN_ROWS = _env_int("SURREAL_TPU_ANN_MIN_ROWS", 8192)
 TPU_DISABLE = _env_bool("SURREAL_TPU_DISABLE", False)

@@ -140,19 +140,21 @@ class FtIndex:
         return did
 
     def _rid_resolver(self, ctx):
-        """did -> rid resolver for one search: packed R chunks loaded once
-        (bisect for the covering chunk) + per-did overlay point gets."""
+        """did -> rid resolver for one search: R chunk KEYS are read once
+        (raw bytes, cheap), but a chunk's rid list is msgpack-decoded only
+        when a candidate actually lands in it — searches resolve a handful
+        of top candidates out of millions of mappings."""
         import bisect as _bisect
 
         txn = ctx.txn()
         pre = self._k(ctx, b"R")
         starts: List[int] = []
-        lists: List[list] = []
+        raws: List[Any] = []  # raw bytes until first hit, then the list
         for chunk in txn.batch(pre, prefix_end(pre), 256):
             for k, v in chunk:
                 start, _ = dec_u64(k, len(pre))
                 starts.append(start)
-                lists.append(unpack(v))
+                raws.append(v)
         rpre = self._k(ctx, b"r")
 
         def resolve(did: int) -> Optional[Thing]:
@@ -161,9 +163,12 @@ class FtIndex:
                 return unpack(raw)  # may be a None tombstone
             i = _bisect.bisect_right(starts, did) - 1
             if i >= 0:
+                lst = raws[i]
+                if isinstance(lst, bytes):
+                    lst = raws[i] = unpack(lst)
                 off = did - starts[i]
-                if 0 <= off < len(lists[i]):
-                    return lists[i][off]
+                if 0 <= off < len(lst):
+                    return lst[off]
             return None
 
         return resolve
@@ -223,7 +228,7 @@ class FtIndex:
         for i, did in enumerate(cand):
             raw = txn.get(lpre + enc_u64(int(did)))
             if raw is not None:
-                out[i] = unpack(raw)
+                out[i] = max(unpack(raw), 0)  # -1 tombstone scores as 0
         return out
 
     # ------------------------------------------------------------ terms
@@ -265,10 +270,11 @@ class FtIndex:
                 self._put_term(ctx, term, meta)
             lraw = txn.get(self._k(ctx, b"l" + enc_u64(did)))
             if lraw is not None:
-                st["tl"] -= unpack(lraw)
+                st["tl"] -= max(unpack(lraw), 0)
             else:
                 st["tl"] -= int(self._chunk_len_of(ctx, did))
-            txn.set(self._k(ctx, b"l" + enc_u64(did)), pack(0))
+            # -1 = removal tombstone, distinct from a present zero-token doc
+            txn.set(self._k(ctx, b"l" + enc_u64(did)), pack(-1))
             st["dc"] -= 1
 
         # write the new posting set

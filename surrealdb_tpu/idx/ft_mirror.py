@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import bisect
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -136,12 +136,14 @@ class FtMirror:
                         did, _ = dec_u64(k, len(pre))
                         len_overlay[did] = float(unpack(v))
                 # rid maps
-                rid_chunks: List[Tuple[int, list]] = []
+                # rid chunks stay raw bytes until a result lands in them
+                # (rid_for decodes on demand — searches touch few chunks)
+                rid_chunks: List[Tuple[int, Any]] = []
                 pre = base + b"R"
                 for batch in txn.batch(pre, prefix_end(pre), 256):
                     for k, v in batch:
                         start, _ = dec_u64(k, len(pre))
-                        rid_chunks.append((start, unpack(v)))
+                        rid_chunks.append((start, v))
                 rid_overlay: Dict[int, Optional[Thing]] = {}
                 pre = base + b"r"
                 for batch in txn.batch(pre, prefix_end(pre), 8192):
@@ -189,18 +191,21 @@ class FtMirror:
             self.overlay.append({})
         return tid
 
-    def _len_of(self, did: int) -> float:
-        """Current doc length; 0 = not indexed."""
+    def _len_of(self, did: int) -> Optional[float]:
+        """Current doc length, or None when the doc is not indexed. The
+        overlay stores -1.0 as its removal tombstone so a present zero-token
+        doc (length 0) stays distinguishable from an absent one — dc/tl
+        accounting depends on that distinction."""
         v = self.len_overlay.get(did)
         if v is not None:
-            return v
+            return None if v < 0 else v
         i = bisect.bisect_right(self.len_chunks, did, key=lambda c: c[0]) - 1
         if i >= 0:
             start, lens = self.len_chunks[i]
             off = did - start
             if 0 <= off < len(lens):
                 return float(lens[off])
-        return 0.0
+        return None
 
     def apply_ft(
         self,
@@ -224,16 +229,16 @@ class FtMirror:
                     if tid is not None:
                         self.overlay[tid][did] = 0.0
                 prev = self._len_of(did)
-                if prev > 0:
+                if prev is not None:
                     self.tl -= prev
                     self.dc -= 1
-                self.len_overlay[did] = 0.0
+                self.len_overlay[did] = -1.0
             if new_tf is not None:
                 # idempotence (the build-window replay protocol relies on
                 # it): a delta whose doc the build scan already loaded must
                 # not double-count dc/tl
                 prev = self._len_of(did)
-                if prev > 0:
+                if prev is not None:
                     self.tl -= prev
                     self.dc -= 1
                 for term, tf in new_tf.items():
@@ -281,6 +286,9 @@ class FtMirror:
             i = bisect.bisect_right(self.rid_chunks, did, key=lambda c: c[0]) - 1
             if i >= 0:
                 start, rids = self.rid_chunks[i]
+                if isinstance(rids, bytes):
+                    rids = unpack(rids)
+                    self.rid_chunks[i] = (start, rids)
                 off = did - start
                 if 0 <= off < len(rids):
                     return rids[off]
@@ -340,7 +348,7 @@ class FtMirror:
             idx = np.fromiter(self.len_overlay.keys(), np.int64, count=len(self.len_overlay))
             val = np.fromiter(self.len_overlay.values(), np.float32, count=len(self.len_overlay))
             ok = idx < cap
-            dl[idx[ok]] = val[ok]
+            dl[idx[ok]] = np.maximum(val[ok], 0.0)  # -1 tombstone scores as 0
         self.t_indptr, self.t_dids, self.t_tfs, self.doclen_arr = indptr, dids, tfs, dl
         self.dirty = False
 

@@ -70,10 +70,13 @@ class PointerCsr:
     def __init__(self, interner: NodeInterner):
         self.interner = interner
         self.adj: Dict[int, List[int]] = {}
+        self.version = 0  # bumped on every mutation (dense-operator cache key)
         self.dirty = True
         self.indptr: Optional[np.ndarray] = None
         self.indices: Optional[np.ndarray] = None
         self._dev = None  # (jnp indptr, jnp indices) cache
+        self._dev_csc = None  # (jnp cptr, jnp csrc) dst-sorted cache
+        self.edge_count = 0
         self.n_built = 0
         self.max_degree = 0
         self._lock = threading.Lock()
@@ -81,6 +84,8 @@ class PointerCsr:
     def load(self, adj: Dict[int, List[int]]) -> None:
         with self._lock:
             self.adj = adj
+            self.edge_count = sum(len(v) for v in adj.values())
+            self.version += 1
             self.dirty = True
 
     def apply(self, src: int, dst: int, add: bool) -> None:
@@ -91,13 +96,16 @@ class PointerCsr:
             if add:
                 if dst not in lst:
                     lst.append(dst)
+                    self.edge_count += 1
             else:
                 try:
                     lst.remove(dst)
+                    self.edge_count -= 1
                 except ValueError:
                     pass
                 if not lst:
                     del self.adj[src]
+            self.version += 1
             self.dirty = True
 
     def ensure_arrays(self) -> None:
@@ -126,6 +134,7 @@ class PointerCsr:
             self.indptr = indptr
             self.indices = indices
             self._dev = None
+            self._dev_csc = None
             self.n_built = n
             self.dirty = False
 
@@ -136,6 +145,34 @@ class PointerCsr:
         if self._dev is None:
             self._dev = (jnp.asarray(self.indptr), jnp.asarray(self.indices))
         return self._dev
+
+    def device_csc(self):
+        """Destination-sorted (cptr, csrc) device arrays for scatter-free
+        dense SpMV hops (batched count chains): y[v] = Σ x[src] over edges
+        into v becomes cumsum over dst-sorted x[csrc] + a boundary gather —
+        gathers and a prefix-scan only, no scatter (TPU scatter-add is
+        serial-slow; cumsum + gather ride the VPU). Padding edges carry the
+        sentinel src/dst `cap` and fall outside every real bin."""
+        import jax.numpy as jnp
+
+        self.ensure_arrays()
+        if self._dev_csc is None:
+            cap = len(self.indptr) - 1
+            nnz = int(self.indptr[-1])
+            E = len(self.indices)
+            esrc = np.full(E, cap, dtype=np.int32)
+            esrc[:nnz] = np.repeat(
+                np.arange(cap, dtype=np.int32), np.diff(self.indptr)
+            )
+            edst = self.indices.astype(np.int64, copy=True)
+            edst[nnz:] = cap
+            order = np.argsort(edst, kind="stable")
+            csrc = esrc[order]
+            counts = np.bincount(edst, minlength=cap + 1)
+            cptr = np.zeros(cap + 2, dtype=np.int32)
+            np.cumsum(counts, out=cptr[1:])
+            self._dev_csc = (jnp.asarray(cptr[: cap + 1]), jnp.asarray(csrc))
+        return self._dev_csc
 
 
 # ------------------------------------------------------------------ kernels
@@ -180,6 +217,33 @@ def _kernels():
         present = jnp.nonzero(dense > 0, size=out_size, fill_value=n_nodes)[0]
         return present, jnp.where(present < n_nodes, dense[present], 0)
 
+    def chain_impl(hops, frontier, weights, mds, n_cap, out_sizes, count_only):
+        frj, cwj = frontier, weights
+        last = len(hops) - 1
+        for h, mirrors in enumerate(hops):
+            if h == last and count_only:
+                # the final hop of a count never materializes neighbors:
+                # paths through node v multiply by deg(v), so the count is
+                # one weighted degree reduction (no gather, no scatter —
+                # the batched form stays tiny at any frontier width)
+                total = 0
+                for (ptr, _idx), _md in zip(mirrors, mds[h]):
+                    n = ptr.shape[0] - 1
+                    fr_c = jnp.clip(frj, 0, jnp.maximum(n - 1, 0))
+                    deg = ptr[fr_c + 1] - ptr[fr_c]
+                    valid = (frj < n) & (cwj > 0)
+                    total = total + jnp.where(valid, deg * cwj, 0).sum()
+                return total
+            pieces, ws = [], []
+            for (ptr, idx), md in zip(mirrors, mds[h]):
+                nodes, w = gather_hop(ptr, idx, frj, cwj, md)
+                pieces.append(nodes)
+                ws.append(w)
+            allnodes = jnp.concatenate(pieces) if len(pieces) > 1 else pieces[0]
+            allw = jnp.concatenate(ws) if len(ws) > 1 else ws[0]
+            frj, cwj = accum_cap(allnodes, allw, n_cap, out_sizes[h])
+        return frj, cwj
+
     @partial(
         jax.jit, static_argnames=("mds", "n_cap", "out_sizes", "count_only")
     )
@@ -188,22 +252,93 @@ def _kernels():
         tuples of (indptr, indices) device arrays (one per contributing
         mirror); mds/out_sizes: matching static pow2 paddings. count_only
         skips the final compaction and returns the scalar path count."""
-        frj, cwj = frontier, weights
-        last = len(hops) - 1
-        for h, mirrors in enumerate(hops):
-            pieces, ws = [], []
-            for (ptr, idx), md in zip(mirrors, mds[h]):
-                nodes, w = gather_hop(ptr, idx, frj, cwj, md)
-                pieces.append(nodes)
-                ws.append(w)
-            allnodes = jnp.concatenate(pieces) if len(pieces) > 1 else pieces[0]
-            allw = jnp.concatenate(ws) if len(ws) > 1 else ws[0]
-            if h == last and count_only:
-                return allw.sum()
-            frj, cwj = accum_cap(allnodes, allw, n_cap, out_sizes[h])
-        return frj, cwj
+        return chain_impl(hops, frontier, weights, mds, n_cap, out_sizes, count_only)
+
+    def _deg(ptr, frj, cwj):
+        """Weighted degree reduction: Σ cw[v]·deg(v) over a compact frontier."""
+        n = ptr.shape[0] - 1
+        fr_c = jnp.clip(frj, 0, jnp.maximum(n - 1, 0))
+        deg = ptr[fr_c + 1] - ptr[fr_c]
+        return jnp.where((frj < n) & (cwj > 0), deg * cwj, 0).sum(axis=-1)
+
+    @partial(jax.jit, static_argnames=("n_cap",))
+    def chain_count_batch(csc_hops, last_hop, frontiers, weights, n_cap):
+        """Batched count-only chains for B concurrent queries over the SAME
+        adjacency (the cross-query coalescing seam, dbs/dispatch.py).
+        Scatter-free: TPU scatter-add is serial-slow and vmapped
+        nonzero/compaction is worse, so every hop is cast as dense SpMV in
+        cumsum form —
+        - seeds densify with one tiny scatter (B x frontier-width updates)
+        - each non-final hop: gather counts at dst-sorted edge sources,
+          prefix-scan, difference at bin boundaries (y[v] = S[end_v] -
+          S[start_v]) — gathers + one cumsum, VPU-friendly at any width
+        - the final hop of a count never materializes neighbors: it is a
+          degree dot-product
+        csc_hops: tuple per non-final hop of ((cptr, csrc), ...);
+        last_hop: ((ptr,), ...)."""
+        B = frontiers.shape[0]
+        if not csc_hops and not last_hop:
+            return jnp.zeros((B,), dtype=jnp.int32)
+        if not csc_hops:
+            # 1-hop count: weighted degree over the compact seed frontier
+            total = 0
+            for (ptr,) in last_hop:
+                n = ptr.shape[0] - 1
+                fr_c = jnp.clip(frontiers, 0, jnp.maximum(n - 1, 0))
+                deg = ptr[fr_c + 1] - ptr[fr_c]
+                total = total + jnp.where(
+                    (frontiers < n) & (weights > 0), deg * weights, 0
+                ).sum(axis=1)
+            return total
+        # densify the seed frontier: [B, n_cap+1] (sentinel column n_cap)
+        lane_off = (jnp.arange(B) * (n_cap + 1))[:, None]
+        safe = jnp.where(weights > 0, jnp.clip(frontiers, 0, n_cap), n_cap)
+        x = (
+            jnp.zeros(B * (n_cap + 1), dtype=jnp.int32)
+            .at[(lane_off + safe).reshape(-1)]
+            .add(weights.reshape(-1))
+            .reshape(B, n_cap + 1)
+        )
+        zcol = jnp.zeros((B, 1), dtype=jnp.int32)
+        for mirrors in csc_hops:
+            x = x.at[:, n_cap].set(0)
+            y = 0
+            for cptr, csrc in mirrors:
+                vals = x[:, csrc]  # sentinel src reads the zeroed column
+                s = jnp.concatenate([zcol, jnp.cumsum(vals, axis=1)], axis=1)
+                y = y + (s[:, cptr[1:]] - s[:, cptr[:-1]])
+            x = jnp.concatenate([y, zcol], axis=1)
+        xr = x[:, :n_cap]
+        total = 0
+        for (ptr,) in last_hop:
+            deg = ptr[1 : n_cap + 1] - ptr[:n_cap]
+            total = total + (xr * deg[None, :]).sum(axis=1)
+        return total
+
+    @partial(jax.jit, static_argnames=("n0",))
+    def dense_count_batch(As, outdeg, frontiers, weights, n0):
+        """Batched count chains as MXU matmuls: each logical `->edge->node`
+        pair is pre-composed into a dense node->node adjacency (bf16, exact
+        for small integer multiplicities), so B concurrent 3-hop counts are
+        TWO [B, n]x[n, n] matmuls + a degree dot-product in ONE dispatch —
+        the gather/scatter-free formulation of graph traversal this
+        hardware actually wants. seeds arrive as compact LOCAL ids."""
+        B = frontiers.shape[0]
+        lane = (jnp.arange(B) * (n0 + 1))[:, None]
+        safe = jnp.where(weights > 0, jnp.clip(frontiers, 0, n0), n0)
+        x = (
+            jnp.zeros(B * (n0 + 1), dtype=jnp.float32)
+            .at[(lane + safe).reshape(-1)]
+            .add(weights.reshape(-1).astype(jnp.float32))
+            .reshape(B, n0 + 1)[:, :n0]
+        )
+        for A in As:
+            x = jnp.dot(x, A.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST)
+        return (x * outdeg[None, :]).sum(axis=1)
 
     _JITTED["chain"] = chain_kernel
+    _JITTED["chain_count_batch"] = chain_count_batch
+    _JITTED["dense_count_batch"] = dense_count_batch
     return chain_kernel
 
 
@@ -215,6 +350,9 @@ class GraphMirrors:
         self._interners: Dict[Tuple[str, str], NodeInterner] = {}
         self._m: Dict[tuple, PointerCsr] = {}
         self._built: Set[Tuple[str, str, str]] = set()
+        # dense composed operators + per-table compact id spaces
+        self._spaces: Dict[tuple, dict] = {}  # (ns,db,tb) -> space dict
+        self._dense: Dict[tuple, dict] = {}  # pair key -> operator dict
         # tables mid-build: deltas committed during the build scan are
         # buffered here and replayed after load (closes the scan→built gap)
         self._building: Dict[Tuple[str, str, str], List[tuple]] = {}
@@ -367,9 +505,189 @@ class GraphMirrors:
         nodes = np.fromiter(sorted(out), dtype=np.int32, count=len(out))
         return nodes, np.array([out[int(n)] for n in nodes], dtype=np.int32)
 
+    # ------------------------------------------------ dense composed counts
+    def table_space(self, ns: str, db: str, tb: str) -> dict:
+        """Compact per-table id space over the shared interner: sorted
+        global ids of `tb`'s nodes + a global->local inverse array.
+        Incrementally extended as the interner grows (append-only)."""
+        it = self.interner(ns, db)
+        with self._lock:
+            sp = self._spaces.get((ns, db, tb))
+            if sp is None:
+                sp = self._spaces[(ns, db, tb)] = {
+                    "globals": [], "inv": {}, "scanned": 0,
+                }
+            n = len(it.node_of)
+            if sp["scanned"] < n:
+                g, inv = sp["globals"], sp["inv"]
+                for i in range(sp["scanned"], n):
+                    if it.node_of[i].tb == tb:
+                        inv[i] = len(g)
+                        g.append(i)
+                sp["scanned"] = n
+            return sp
+
+    @staticmethod
+    def _pad128(n: int) -> int:
+        return max(((n + 127) // 128) * 128, 128)
+
+    def _dense_pair(self, ns, db, spec1, spec2):
+        """Composed dense operator for one `->edge->node` spec pair:
+        A[local_src, local_dst] = number of 2-hop paths through the edge
+        table (bf16 on device — exact for multiplicities < 256; falls back
+        to None if anything about the pair doesn't fit the dense form)."""
+        import jax.numpy as jnp
+        from surrealdb_tpu import cnf
+
+        srcs1, dirs1, fts1 = spec1
+        srcs2, dirs2, fts2 = spec2
+        if len(srcs1) != 1 or len(fts1) != 1 or len(dirs1) != 1:
+            return None
+        if len(fts2) != 1 or len(dirs2) != 1:
+            return None
+        src_tb, edge_tb, dst_tb = srcs1[0], fts1[0], fts2[0]
+        m1s = self._hop_mirrors(ns, db, spec1)
+        m2s = self._hop_mirrors(ns, db, spec2)
+        if len(m1s) != 1 or len(m2s) != 1:
+            return None
+        m1, m2 = m1s[0], m2s[0]
+        sp_s = self.table_space(ns, db, src_tb)
+        sp_d = self.table_space(ns, db, dst_tb)
+        n_s, n_d = len(sp_s["globals"]), len(sp_d["globals"])
+        if not n_s or not n_d:
+            return None
+        if max(n_s, n_d) > cnf.TPU_GRAPH_DENSE_MAX:
+            return None
+        key = (ns, db, src_tb, dirs1[0], edge_tb, dirs2[0], dst_tb)
+        gen = (m1.version, m2.version, n_s, n_d)
+        with self._lock:
+            op = self._dense.get(key)
+        if op is not None and op["gen"] == gen:
+            return op
+        # host composition: one pass over m1's edges, mapping each middle
+        # edge-record to its m2 destinations
+        inv_s, inv_d = sp_s["inv"], sp_d["inv"]
+        ns_pad, nd_pad = self._pad128(n_s), self._pad128(n_d)
+        A = np.zeros((ns_pad + 1, nd_pad), dtype=np.float32)
+        # copy both adjacencies up front: the O(paths) composition loop must
+        # not hold mirror locks (it would stall every concurrent RELATE)
+        with m1._lock:
+            adj1 = {k: list(v) for k, v in m1.adj.items()}
+        with m2._lock:
+            adj2 = {k: list(v) for k, v in m2.adj.items()}
+        rows_s, rows_d = [], []
+        for g_src, mids in adj1.items():
+            ls = inv_s.get(g_src)
+            if ls is None:
+                continue
+            for mid in mids:
+                for g_dst in adj2.get(mid, ()):
+                    ld = inv_d.get(g_dst)
+                    if ld is not None:
+                        rows_s.append(ls)
+                        rows_d.append(ld)
+        if rows_s:
+            np.add.at(
+                A,
+                (np.asarray(rows_s, np.int64), np.asarray(rows_d, np.int64)),
+                1.0,
+            )
+        if float(A.max(initial=0.0)) >= 256.0:
+            return None  # bf16 would round the multiplicity
+        outdeg = A[:ns_pad].sum(axis=1).astype(np.float32)
+        import ml_dtypes
+
+        op = {
+            "gen": gen,
+            "n_src": n_s,
+            "n_dst": n_d,
+            "ns_pad": ns_pad,
+            "nd_pad": nd_pad,
+            "A": jnp.asarray(A[:ns_pad].astype(ml_dtypes.bfloat16)),
+            "outdeg": jnp.asarray(outdeg),
+            # ∞-norm of the operator: bounds count growth per hop for the
+            # f32-exactness guard in _dense_chain_count
+            "rowmax": float(outdeg.max(initial=0.0)),
+            "space_src": sp_s,
+        }
+        with self._lock:
+            self._dense[key] = op
+        return op
+
+    def _dense_chain_count(self, ns, db, frontier, counts, specs, dispatch):
+        """Count chain as composed dense matmuls (see dense_count_batch).
+        Returns None when the chain doesn't fit the dense form (odd spec
+        count, multi-table hops, oversized tables, fat multiplicities) —
+        the caller then uses the CSC path."""
+        import jax.numpy as jnp
+        from surrealdb_tpu import cnf
+
+        if len(specs) < 2 or len(specs) % 2 != 0:
+            return None
+        ops = []
+        for i in range(0, len(specs), 2):
+            op = self._dense_pair(ns, db, specs[i], specs[i + 1])
+            if op is None:
+                return None
+            ops.append(op)
+        # chain spaces must line up: pair i's dst space is pair i+1's src
+        for a, b in zip(ops, ops[1:]):
+            if a["nd_pad"] != b["ns_pad"] or a["n_dst"] != b["n_src"]:
+                return None
+        # f32 matmuls are exact only below 2^24: bound the worst-case count
+        # (Σ seed weights × Π per-hop ∞-norms) and fall back to the exact
+        # int32 CSC path when it could overflow the mantissa
+        bound = float(counts.sum())
+        for op in ops:
+            bound *= max(op["rowmax"], 1.0)
+        if bound >= float(1 << 24):
+            return None
+        _kernels()
+        kernel = _JITTED["dense_count_batch"]
+        n0 = ops[0]["ns_pad"]
+        inv0 = ops[0]["space_src"]["inv"]
+        fsz = _next_pow2(max(frontier.size, cnf.TPU_GRAPH_FRONTIER_PAD))
+        fr = np.full(fsz, n0, dtype=np.int32)
+        cw = np.zeros(fsz, dtype=np.int32)
+        j = 0
+        for g, c in zip(frontier.tolist(), counts.tolist()):
+            loc = inv0.get(int(g))
+            if loc is not None:
+                fr[j] = loc
+                cw[j] = c
+                j += 1
+        if j == 0:
+            return 0
+        As = tuple(op["A"] for op in ops[:-1])
+        outdeg = ops[-1]["outdeg"]
+        key = (
+            "gdense", fsz, n0,
+            tuple(id(a) for a in As), id(outdeg),
+        )
+
+        def runner(payloads):
+            B = len(payloads)
+            bp = max(_next_pow2(B), cnf.TPU_GRAPH_BATCH_LANES)
+            frs = np.full((bp, fsz), n0, dtype=np.int32)
+            cws = np.zeros((bp, fsz), dtype=np.int32)
+            for i, (f, c) in enumerate(payloads):
+                frs[i] = f
+                cws[i] = c
+            out = kernel(
+                As, outdeg, jnp.asarray(frs), jnp.asarray(cws), n0=n0
+            )
+
+            def collect():
+                vals = np.asarray(out)
+                return [int(round(float(vals[i]))) for i in range(B)]
+
+            return collect
+
+        return dispatch.submit(key, (fr, cw), runner)
+
     def _device_chain(
         self, ns, db, frontier: np.ndarray, counts: np.ndarray, specs,
-        count_only: bool = False,
+        count_only: bool = False, dispatch=None,
     ):
         """Run the remaining hops entirely on device in ONE fused dispatch:
         one upload, H weighted gathers with on-device scatter-add dedup
@@ -378,10 +696,15 @@ class GraphMirrors:
         dedup output) is pow2-rounded so steady writes don't recompile."""
         import jax.numpy as jnp
 
+        from surrealdb_tpu import cnf
+
         chain_kernel = _kernels()
         it = self.interner(ns, db)
         n_cap = _next_pow2(len(it))
-        fsz = _next_pow2(frontier.size)
+        # floor the frontier pad: XLA compiles per static shape (~20s+ on a
+        # tunneled chip), and chains arriving with 90- vs 130-node frontiers
+        # must share ONE compiled kernel to coalesce
+        fsz = _next_pow2(max(frontier.size, cnf.TPU_GRAPH_FRONTIER_PAD))
         fr = np.full(fsz, n_cap, dtype=np.int32)
         fr[: frontier.size] = frontier
         cw = np.zeros(fsz, dtype=np.int32)
@@ -406,9 +729,49 @@ class GraphMirrors:
             mds.append(tuple(hop_mds))
             width = _next_pow2(min(total, n_cap))
             out_sizes.append(width)
+        hops, mds, out_sizes = tuple(hops), tuple(mds), tuple(out_sizes)
+        if count_only and dispatch is not None:
+            # coalesce concurrent count-chains with identical shape/adjacency
+            # into one batched dispatch (dbs/dispatch.py leader-follower)
+            batch_kernel = _JITTED["chain_count_batch"]
+            csc_hops = tuple(
+                tuple(m.device_csc() for m in self._hop_mirrors(ns, db, sp))
+                for sp in specs[:-1]
+            )
+            last_hop = tuple((pair[0],) for pair in hops[-1])
+            key = (
+                "gchain", fsz, n_cap, len(specs),
+                tuple(id(a) for hop in csc_hops for pair in hop for a in pair),
+                tuple(id(p) for (p,) in last_hop),
+            )
+
+            def runner(payloads):
+                B = len(payloads)
+                # fixed lane count: a batch of 1 and a batch of 32 share the
+                # same compiled executable (padding lanes carry zero weights
+                # and cost nothing next to the dispatch RTT)
+                bp = max(_next_pow2(B), cnf.TPU_GRAPH_BATCH_LANES)
+                frs = np.full((bp, fsz), n_cap, dtype=np.int32)
+                cws = np.zeros((bp, fsz), dtype=np.int32)
+                for i, (f, c) in enumerate(payloads):
+                    frs[i] = f
+                    cws[i] = c
+                out = batch_kernel(
+                    csc_hops, last_hop,
+                    jnp.asarray(frs), jnp.asarray(cws),
+                    n_cap=n_cap,
+                )
+
+                def collect():
+                    vals = np.asarray(out)
+                    return [int(vals[i]) for i in range(B)]
+
+                return collect
+
+            return dispatch.submit(key, (fr, cw), runner)
         out = chain_kernel(
-            tuple(hops), jnp.asarray(fr), jnp.asarray(cw),
-            mds=tuple(mds), n_cap=n_cap, out_sizes=tuple(out_sizes),
+            hops, jnp.asarray(fr), jnp.asarray(cw),
+            mds=mds, n_cap=n_cap, out_sizes=out_sizes,
             count_only=count_only,
         )
         if count_only:
@@ -443,14 +806,50 @@ class GraphMirrors:
                 cmap[i] = cmap.get(i, 0) + 1
         frontier = np.fromiter(sorted(cmap), dtype=np.int32, count=len(cmap))
         counts = np.array([cmap[int(i)] for i in frontier], dtype=np.int32)
+        dispatch = getattr(ctx.ds(), "dispatch", None)
+        if (
+            count_only
+            and not cnf.TPU_DISABLE
+            and dispatch is not None
+            and frontier.size
+            and sum(
+                m.edge_count
+                for sp in specs
+                for m in self._hop_mirrors(ns, db, sp)
+            )
+            >= cnf.TPU_GRAPH_COUNT_EDGES
+        ):
+            # big count chain: straight to device from the seed — the whole
+            # chain is one tiny-upload batched dispatch (no host hops means
+            # no GIL serialization across concurrent clients, and every
+            # query shares one compiled shape so they coalesce). Preferred
+            # form: composed dense matmuls on the MXU; CSC cumsum otherwise.
+            res = self._dense_chain_count(ns, db, frontier, counts, specs, dispatch)
+            if res is not None:
+                return res
+            return self._device_chain(
+                ns, db, frontier, counts, specs,
+                count_only=True, dispatch=dispatch,
+            )
         i = 0
         while i < len(specs):
-            if (
-                not cnf.TPU_DISABLE
-                and frontier.size >= cnf.TPU_GRAPH_ONDEVICE_THRESHOLD
-            ):
+            # a hop goes on device once the CURRENT frontier is device-sized,
+            # or — for count-only chains — as soon as the NEXT frontier would
+            # be: the whole remaining chain fuses into one dispatch either
+            # way, and skipping the host hops keeps every concurrent query's
+            # shapes identical so they coalesce into one vmapped launch
+            md = max(
+                (m.max_degree for m in self._hop_mirrors(ns, db, specs[i])),
+                default=0,
+            )
+            device_now = frontier.size >= cnf.TPU_GRAPH_ONDEVICE_THRESHOLD or (
+                count_only
+                and frontier.size * md >= cnf.TPU_GRAPH_ONDEVICE_THRESHOLD
+            )
+            if not cnf.TPU_DISABLE and device_now:
                 res = self._device_chain(
-                    ns, db, frontier, counts, specs[i:], count_only=count_only
+                    ns, db, frontier, counts, specs[i:],
+                    count_only=count_only, dispatch=dispatch,
                 )
                 if count_only:
                     return res

@@ -135,3 +135,28 @@ def test_highlight_still_works_via_mirror_path(ds):
         "SELECT search::highlight('<b>', '</b>', 1) AS h FROM doc WHERE body @1@ 'beta';"
     )
     assert ok(r[0])[0]["h"] == "alpha <b>beta</b> gamma"
+
+
+def test_zero_token_doc_dc_accounting(ds):
+    """A doc whose field analyzes to zero tokens must round-trip dc
+    correctly through insert + delete (mirror vs KV stats)."""
+    from surrealdb_tpu.dbs.session import Session
+
+    s = Session.owner()
+    s.ns, s.db = "test", "test"
+    ds.execute(
+        "DEFINE ANALYZER a TOKENIZERS blank FILTERS lowercase; "
+        "DEFINE TABLE d SCHEMALESS; "
+        "DEFINE INDEX f ON d FIELDS body SEARCH ANALYZER a BM25;", s)
+    ds.execute("INSERT INTO d $rows", s, vars={"rows": [
+        {"id": i, "body": "alpha beta"} for i in range(10)]})
+    # build the mirror
+    ds.execute("SELECT id FROM d WHERE body @1@ 'alpha'", s)
+    mirror = ds.index_stores.get("test", "test", "d", "f")
+    base = mirror.count()
+    for _ in range(3):
+        ds.execute("CREATE d:999 SET body = ''", s)   # zero tokens, present
+        ds.execute("DELETE d:999", s)
+    assert mirror.count() == base, (mirror.count(), base)
+    out = ds.execute("SELECT count() FROM d WHERE body @1@ 'alpha' GROUP ALL", s)
+    assert out[-1]["result"][0]["count"] == 10
