@@ -117,4 +117,11 @@ def run_script(ctx, src: str, args: List[Any], doc: Optional[dict]) -> Any:
         raise SurrealError(f"Problem with embedded script function. {e}") from None
     except ScriptError as e:
         raise SurrealError(f"Problem with embedded script function. {e}") from None
+    except RecursionError:
+        # the interpreter's own depth guard counts JS frames, but deeply
+        # nested EXPRESSIONS recurse the host evaluator between guard
+        # checks — surface the same clean limit error either way
+        raise SurrealError(
+            "Problem with embedded script function. script stack depth exceeded"
+        ) from None
     return from_js(out)

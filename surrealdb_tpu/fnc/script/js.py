@@ -105,7 +105,11 @@ def _tokenize(src: str) -> List[_Tok]:
                 continue
             while j < n and (src[j].isdigit() or src[j] in ".eE" or (src[j] in "+-" and src[j - 1] in "eE")):
                 j += 1
-            toks.append(_Tok("num", float(src[i:j]), i))
+            try:
+                num = float(src[i:j])
+            except ValueError:
+                raise ScriptError(f"invalid number literal at {i}")
+            toks.append(_Tok("num", num, i))
             i = j
             continue
         if c in "'\"":

@@ -541,6 +541,11 @@ class KnnPlan(_KnnExecutorMixin):
             return
         k = min(self.k, n)
         q = np.asarray(self.target, dtype=np.float32)
+        # MTREE preserves the reference's exactness contract
+        # (core/src/idx/trees/mtree.rs:135 — an exact metric tree): it
+        # always takes the exact fused distance+top-k paths; only HNSW
+        # indexes may serve approximate IVF results
+        approx_ok = self.ix["index"]["type"] != "mtree"
         # ANN pays off only when k is a small fraction of the corpus; a big-k
         # query gets the exact fused kernel (IVF would cap results at the
         # probed-candidate count)
@@ -556,7 +561,7 @@ class KnnPlan(_KnnExecutorMixin):
             # (sharded_knn) serves instead — never a latency cliff.
             matrix, _, rids = mirror.device_snapshot(mesh)
             mask_dev = mirror.device_sharded_mask()
-            want_ivf = n >= cnf.TPU_ANN_MIN_ROWS and self.k * 4 <= n
+            want_ivf = approx_ok and n >= cnf.TPU_ANN_MIN_ROWS and self.k * 4 <= n
             ivf = mirror.ensure_ivf(matrix) if want_ivf else None
             if ivf is not None:
                 from surrealdb_tpu.idx.ivf import default_nprobe
@@ -602,7 +607,12 @@ class KnnPlan(_KnnExecutorMixin):
                     return list(zip(dd, rr))
 
                 dists, slots = ds.dispatch.submit(key, q, runner)
-        elif not cnf.TPU_DISABLE and n >= cnf.TPU_ANN_MIN_ROWS and self.k * 4 <= n:
+        elif (
+            not cnf.TPU_DISABLE
+            and approx_ok
+            and n >= cnf.TPU_ANN_MIN_ROWS
+            and self.k * 4 <= n
+        ):
             self.strategy = "ivf"
             # snapshot first: device_view may compact dead slots, which
             # renumbers the slot space and invalidates any trained IVF; the
@@ -671,7 +681,8 @@ class KnnPlan(_KnnExecutorMixin):
             # needs the device matrix); exact scan otherwise.
             ivf = mirror.ivf
             if (
-                ivf is not None
+                approx_ok
+                and ivf is not None
                 and not ivf.needs_retrain()
                 and metric in ("euclidean", "cosine")
                 and n >= cnf.TPU_ANN_MIN_ROWS

@@ -196,12 +196,19 @@ class _Dec:
         self.i = 0
 
     def u8(self) -> int:
+        if self.i >= len(self.b):
+            raise SurrealError("truncated CBOR")
         v = self.b[self.i]
         self.i += 1
         return v
 
+    def peek(self) -> int:
+        if self.i >= len(self.b):
+            raise SurrealError("truncated CBOR")
+        return self.b[self.i]
+
     def read(self, n: int) -> bytes:
-        if self.i + n > len(self.b):
+        if n < 0 or self.i + n > len(self.b):
             raise SurrealError("truncated CBOR")
         v = self.b[self.i : self.i + n]
         self.i += n
@@ -232,12 +239,15 @@ class _Dec:
         if major == 2:
             return self._chunks(info, 2)
         if major == 3:
-            return self._chunks(info, 3).decode()
+            try:
+                return self._chunks(info, 3).decode()
+            except UnicodeDecodeError:
+                raise SurrealError("invalid CBOR text (not UTF-8)")
         if major == 4:
             n = self.length(info)
             if n < 0:
                 out: List[Any] = []
-                while self.b[self.i] != 0xFF:
+                while self.peek() != 0xFF:
                     out.append(self.value())
                 self.i += 1
                 return out
@@ -246,7 +256,7 @@ class _Dec:
             n = self.length(info)
             obj = {}
             if n < 0:
-                while self.b[self.i] != 0xFF:
+                while self.peek() != 0xFF:
                     k = self.value()
                     obj[str(k)] = self.value()
                 self.i += 1
@@ -257,7 +267,13 @@ class _Dec:
             return obj
         if major == 6:
             tag = self.length(info)
-            return _untag(tag, self.value())
+            payload = self.value()
+            try:
+                return _untag(tag, payload)
+            except SurrealError:
+                raise
+            except (TypeError, ValueError, IndexError, KeyError, AttributeError, OverflowError):
+                raise SurrealError(f"malformed CBOR tag {tag} payload")
         # major 7: simple / float
         if info == 20:
             return False
@@ -280,11 +296,14 @@ class _Dec:
         if n >= 0:
             return self.read(n)
         out = bytearray()
-        while self.b[self.i] != 0xFF:
+        while self.peek() != 0xFF:
             ib = self.u8()
             if ib >> 5 != major:
                 raise SurrealError("bad indefinite chunk")
-            out += self.read(self.length(ib & 0x1F))
+            m = self.length(ib & 0x1F)
+            if m < 0:  # nested indefinite chunk is invalid (RFC 8949 §3.2.3)
+                raise SurrealError("bad indefinite chunk")
+            out += self.read(m)
         self.i += 1
         return bytes(out)
 
@@ -316,6 +335,8 @@ def _untag(tag: int, v: Any) -> Any:
     if tag == TAG_SPEC_UUID:
         import uuid as _uuid
 
+        if not isinstance(v, (bytes, bytearray)) or len(v) != 16:
+            raise SurrealError("Expected a 16-byte UUID payload")
         return Uuid(_uuid.UUID(bytes=bytes(v)))
     if tag == TAG_STRING_DECIMAL:
         try:
@@ -360,6 +381,13 @@ def _dec_range(v: Any) -> Range:
 
 
 def decode(data: bytes) -> Any:
+    try:
+        return _decode_inner(data)
+    except RecursionError:
+        raise SurrealError("CBOR value is too deeply nested") from None
+
+
+def _decode_inner(data: bytes) -> Any:
     d = _Dec(data)
     v = d.value()
     return v

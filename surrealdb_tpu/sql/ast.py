@@ -194,7 +194,12 @@ class RegexLit(Expr):
 
     def __init__(self, pattern: str):
         self.pattern = pattern
-        self.compiled = _re.compile(pattern)
+        try:
+            self.compiled = _re.compile(pattern)
+        except _re.error as e:
+            from surrealdb_tpu.err import ParseError
+
+            raise ParseError(f"invalid regex literal: {e}")
 
     def compute(self, ctx):
         return self.compiled

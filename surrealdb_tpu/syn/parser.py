@@ -77,6 +77,14 @@ class Parser:
             self.i += 1
         return t
 
+    def expect_int(self, what: str) -> int:
+        """Next token as an integer, or a clean parse error."""
+        tok = self.next()
+        try:
+            return int(tok.value)
+        except (TypeError, ValueError, OverflowError):
+            raise self.error(f"expected {what}", tok)
+
     def error(self, msg: str, tok: Optional[Token] = None) -> ParseError:
         t = tok or self.peek()
         line = self.text.count("\n", 0, t.pos) + 1
@@ -927,13 +935,13 @@ class Parser:
                       "vtype": "f64", "capacity": 40}
                 while True:
                     if self.eat_kw("DIMENSION"):
-                        ix["dimension"] = int(self.next().value)
+                        ix["dimension"] = self.expect_int("a dimension")
                     elif self.eat_kw("DIST"):
                         ix["dist"] = self._distance_name()
                     elif self.eat_kw("TYPE"):
                         ix["vtype"] = self.ident("vector type").lower()
                     elif self.eat_kw("CAPACITY"):
-                        ix["capacity"] = int(self.next().value)
+                        ix["capacity"] = self.expect_int("a capacity")
                     else:
                         break
                 args["index"] = ix
@@ -942,19 +950,23 @@ class Parser:
                       "vtype": "f64", "efc": 150, "m": 12, "m0": 24, "lm": None}
                 while True:
                     if self.eat_kw("DIMENSION"):
-                        ix["dimension"] = int(self.next().value)
+                        ix["dimension"] = self.expect_int("a dimension")
                     elif self.eat_kw("DIST"):
                         ix["dist"] = self._distance_name()
                     elif self.eat_kw("TYPE"):
                         ix["vtype"] = self.ident("vector type").lower()
                     elif self.eat_kw("EFC"):
-                        ix["efc"] = int(self.next().value)
+                        ix["efc"] = self.expect_int("an EFC value")
                     elif self.eat_kw("M0"):
-                        ix["m0"] = int(self.next().value)
+                        ix["m0"] = self.expect_int("an M0 value")
                     elif self.eat_kw("M"):
-                        ix["m"] = int(self.next().value)
+                        ix["m"] = self.expect_int("an M value")
                     elif self.eat_kw("LM"):
-                        ix["lm"] = float(self.next().value)
+                        tok = self.next()
+                        try:
+                            ix["lm"] = float(tok.value)
+                        except (TypeError, ValueError):
+                            raise self.error("expected an LM value", tok)
                     elif self.eat_kw("EXTEND_CANDIDATES") or self.eat_kw("KEEP_PRUNED_CONNECTIONS"):
                         pass
                     else:
@@ -1421,7 +1433,7 @@ class Parser:
                 inner = self.parse_kind()
                 size = None
                 if self.eat_op(","):
-                    size = int(self.next().value)
+                    size = self.expect_int("an array size")
                 self.expect_op(">")
                 return Kind(name, [inner], size)
             return Kind(name)
@@ -1650,13 +1662,16 @@ class Parser:
 
     def _knn_tail(self, lhs: A.Expr) -> A.Expr:
         self.expect_op("<|")
-        k = int(self.next().value)
+        k = self.expect_int("a kNN k")
         ef = None
         dist = None
         if self.eat_op(","):
             t = self.next()
             if t.kind == "NUMBER":
-                ef = int(t.value)
+                try:
+                    ef = int(t.value)
+                except (OverflowError, ValueError):
+                    raise self.error("expected a kNN ef", t)
             else:
                 dist = str(t.value).lower()
                 if dist == "minkowski":
@@ -1669,7 +1684,7 @@ class Parser:
         self.expect_op("@")
         ref = None
         if self.peek().kind == "NUMBER":
-            ref = int(self.next().value)
+            ref = self.expect_int("a match ref")
         self.expect_op("@")
         rhs = self.parse_expr(45)
         return A.MatchesOp(lhs, rhs, ref)
@@ -1820,9 +1835,9 @@ class Parser:
         if self.peek().kind == "IDENT" and self.is_op(":", 1):
             tb = self.ident("table name")
             self.expect_op(":")
-            n1 = int(self.next().value)
+            n1 = self.expect_int("a number")
             if self.eat_op(".."):
-                n2 = int(self.next().value)
+                n2 = self.expect_int("a number")
                 self.expect_op("|")
                 return A.MockExpr(tb, None, (n1, n2))
             self.expect_op("|")
@@ -2225,12 +2240,20 @@ class _ExprStatement(S.Statement):
 
 # ------------------------------------------------------------------ entries
 def parse_query(text: str) -> S.Query:
-    return Parser(text).parse_query()
+    try:
+        return Parser(text).parse_query()
+    except RecursionError:
+        # pathological nesting (the reference bounds computation depth the
+        # same way, cnf MAX_COMPUTATION_DEPTH) — report a clean parse error
+        raise ParseError("query is too deeply nested") from None
 
 
 def parse_expr_text(text: str) -> A.Expr:
-    p = Parser(text)
-    e = p.parse_expr()
+    try:
+        p = Parser(text)
+        e = p.parse_expr()
+    except RecursionError:
+        raise ParseError("expression is too deeply nested") from None
     if p.peek().kind != "EOF":
         raise p.error("unexpected trailing input")
     return e

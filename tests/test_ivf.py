@@ -167,3 +167,31 @@ def test_sql_knn_txn_overlay(vec_ds):
     )
     got = _knn_ids(ds, [8.5] * 8, k=1)
     assert got and got != [888]
+
+
+def test_mtree_exact_contract(ds, monkeypatch):
+    """DEFINE INDEX ... MTREE must return EXACT kNN results (reference
+    mtree.rs:135 — an exact metric tree), never approximate IVF, even at
+    sizes where HNSW indexes would route to ANN."""
+    import numpy as np
+    from surrealdb_tpu import cnf
+    from surrealdb_tpu.dbs.session import Session
+
+    monkeypatch.setattr(cnf, "TPU_ANN_MIN_ROWS", 64)
+    monkeypatch.setattr(cnf, "TPU_KNN_ONDEVICE_THRESHOLD", 1 << 60)
+
+    s = Session.owner()
+    s.ns, s.db = "test", "test"
+    rng = np.random.default_rng(3)
+    vecs = rng.standard_normal((400, 16)).astype(np.float32)
+    ds.execute(
+        "DEFINE TABLE item SCHEMALESS; "
+        "DEFINE INDEX im ON item FIELDS emb MTREE DIMENSION 16 DIST EUCLIDEAN;", s)
+    ds.execute("INSERT INTO item $rows", s, vars={
+        "rows": [{"id": i, "emb": vecs[i].tolist()} for i in range(400)]})
+    q = vecs[7] + 0.01
+    out = ds.execute("SELECT id FROM item WHERE emb <|10,4|> $q", s, vars={"q": q.tolist()})
+    got = [int(str(r["id"]).split(":")[1]) for r in out[-1]["result"]]
+    d = ((vecs - q) ** 2).sum(axis=1)
+    want = set(np.argsort(d)[:10].tolist())
+    assert set(got) == want, (sorted(got), sorted(want))
