@@ -420,6 +420,8 @@ def bench_knn(ds, s, corpus, rng):
         t.join()
     conc_dt = time.perf_counter() - t0
     conc_qps = (nthreads * rounds - len(errors)) / conc_dt if conc_dt > 0 else 0.0
+    if errors:
+        log(f"knn: WARNING {len(errors)} concurrent queries failed; first: {errors[0]!r:.300}")
     d1 = ds.dispatch.stats()
     dstats = {k: d1[k] - stats0[k] for k in d1}
 

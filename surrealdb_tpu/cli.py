@@ -31,6 +31,8 @@ def main(argv=None) -> int:
     p_start.add_argument("--user", "-u")
     p_start.add_argument("--pass", "-p", dest="password")
     p_start.add_argument("--unauthenticated", action="store_true")
+    p_start.add_argument("--web-crt", dest="web_crt", help="TLS certificate (PEM)")
+    p_start.add_argument("--web-key", dest="web_key", help="TLS private key (PEM)")
     p_start.add_argument("--profile", action="store_true",
                          help="record timed spans around statements and kernel dispatches")
     # capability flags (reference: surreal start --allow-*/--deny-*)
@@ -94,6 +96,12 @@ def main(argv=None) -> int:
     p_ready = sub.add_parser("isready", help="check a server is responding")
     p_ready.add_argument("--endpoint", "-e", default="http://127.0.0.1:8000")
 
+    p_fix = sub.add_parser("fix", help="repair a damaged file datastore")
+    p_fix.add_argument("path")
+
+    p_up = sub.add_parser("upgrade", help="migrate a file datastore to the current storage version")
+    p_up.add_argument("path")
+
     sub.add_parser("version", help="print version")
 
     args = ap.parse_args(argv)
@@ -108,6 +116,8 @@ def main(argv=None) -> int:
         "ml": _ml,
         "validate": _validate,
         "isready": _isready,
+        "fix": _fix,
+        "upgrade": _upgrade,
         "version": _version,
     }[args.cmd](args)
 
@@ -135,6 +145,8 @@ def _start(args) -> int:
         args.path, host or "127.0.0.1", int(port or 8000),
         auth_enabled=not args.unauthenticated,
         capabilities=from_env_and_args(args),
+        tls_cert=getattr(args, "web_crt", None),
+        tls_key=getattr(args, "web_key", None),
     )
     if args.user and args.password:
         from surrealdb_tpu.sql.value import format_value
@@ -232,6 +244,37 @@ def _ml(args) -> int:
         return 0
     print("usage: surrealdb-tpu ml {import,export} ...", file=sys.stderr)
     return 1
+
+
+def _fix(args) -> int:
+    from surrealdb_tpu.kvs.file import repair
+
+    try:
+        stats = repair(args.path)
+    except (ValueError, OSError) as e:
+        print(f"fix failed: {e}", file=sys.stderr)
+        return 1
+    print(
+        f"{args.path}: repaired — {stats['keys']} keys, "
+        f"{stats['wal_frames']} WAL frames replayed, "
+        f"{stats['snapshot_dropped_bytes']} torn snapshot bytes dropped"
+    )
+    return 0
+
+
+def _upgrade(args) -> int:
+    from surrealdb_tpu.kvs.file import upgrade
+
+    try:
+        stats = upgrade(args.path)
+    except (ValueError, OSError) as e:
+        print(f"upgrade failed: {e}", file=sys.stderr)
+        return 1
+    print(
+        f"{args.path}: storage version {stats['from_version']} -> "
+        f"{stats['to_version']} ({stats['keys']} keys)"
+    )
+    return 0
 
 
 def _validate(args) -> int:

@@ -83,6 +83,15 @@ TPU_DISABLE = _env_bool("SURREAL_TPU_DISABLE", False)
 
 # Changefeeds
 CHANGEFEED_GC_INTERVAL_SECS = _env_int("SURREAL_CHANGEFEED_GC_INTERVAL", 10)
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+# statements slower than this are counted + logged (slow-query reporting)
+SLOW_QUERY_THRESHOLD_SECS = _env_float("SURREAL_SLOW_QUERY_THRESHOLD", 1.0)
 
 # Websocket / server
 # largest accepted HTTP request body (model imports carry inline weights)

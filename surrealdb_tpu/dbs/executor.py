@@ -13,6 +13,7 @@ import sys
 import time
 from typing import Any, Dict, List, Optional
 
+from surrealdb_tpu import cnf
 from surrealdb_tpu.err import (
     ControlFlow,
     QueryCancelledError,
@@ -189,8 +190,21 @@ class Executor:
             from surrealdb_tpu import telemetry
 
             try:
+                import time as _time
+
+                _t0 = _time.perf_counter()
                 with telemetry.span("statement", kind=type(stm).__name__):
                     result = stm.compute(ctx)
+                _dt = _time.perf_counter() - _t0
+                if _dt >= cnf.SLOW_QUERY_THRESHOLD_SECS:
+                    # slow-query reporting (reference: query duration
+                    # warnings in telemetry/metrics) — counted and logged
+                    telemetry.inc("slow_queries", kind=type(stm).__name__)
+                    import logging
+
+                    logging.getLogger("surrealdb_tpu.slow_query").warning(
+                        "slow statement (%.3fs): %.200r", _dt, stm
+                    )
             except ReturnError as r:
                 result = r.value
             if own_txn:

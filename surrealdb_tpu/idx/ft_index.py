@@ -77,6 +77,33 @@ def unpack_plist(raw: bytes) -> Tuple[np.ndarray, np.ndarray]:
     return base + offs.astype(np.int64), tfs.astype(np.float32)
 
 
+def pack_rids(rids: list) -> Any:
+    """R-chunk payload: columnar {tb, packed int64 ids} when the batch is
+    uniform int-id Things (the common bulk shape — decodes in O(1) instead
+    of unpacking tens of thousands of Thing exts per chunk), else the
+    generic rid list."""
+    if rids and all(
+        isinstance(r, Thing) and isinstance(r.id, int) and r.tb == rids[0].tb
+        for r in rids
+    ):
+        try:
+            ids = np.asarray([r.id for r in rids], dtype="<i8")
+        except OverflowError:
+            return list(rids)  # an id beyond int64: generic payload
+        return {"t": rids[0].tb, "i": ids.tobytes()}
+    return list(rids)
+
+
+def rid_chunk_get(decoded, off: int) -> Optional[Thing]:
+    """Index into a decoded R-chunk payload (columnar or list form)."""
+    if isinstance(decoded, dict):
+        ids = decoded["i"]
+        if 0 <= off * 8 < len(ids):
+            return Thing(decoded["t"], struct.unpack_from("<q", ids, off * 8)[0])
+        return None
+    return decoded[off] if 0 <= off < len(decoded) else None
+
+
 def pack_lens(lens: np.ndarray) -> bytes:
     return struct.pack("<I", len(lens)) + lens.astype("<u4", copy=False).tobytes()
 
@@ -163,12 +190,10 @@ class FtIndex:
                 return unpack(raw)  # may be a None tombstone
             i = _bisect.bisect_right(starts, did) - 1
             if i >= 0:
-                lst = raws[i]
-                if isinstance(lst, bytes):
-                    lst = raws[i] = unpack(lst)
-                off = did - starts[i]
-                if 0 <= off < len(lst):
-                    return lst[off]
+                dec = raws[i]
+                if isinstance(dec, bytes):
+                    dec = raws[i] = unpack(dec)
+                return rid_chunk_get(dec, did - starts[i])
             return None
 
         return resolve
@@ -395,7 +420,7 @@ class FtIndex:
                 )
             lens_a = np.asarray(lens, dtype=np.uint32)
             tset(base + b"L" + enc_u64(start), pack_lens(lens_a))
-            tset(base + b"R" + enc_u64(start), pack(list(rids)))
+            tset(base + b"R" + enc_u64(start), pack(pack_rids(rids)))
             st["tl"] += int(lens_a.sum())
             st["dc"] += len(rids)
             txn.ft_bulk_delta(

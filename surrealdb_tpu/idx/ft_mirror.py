@@ -32,7 +32,12 @@ from surrealdb_tpu import key as keys
 from surrealdb_tpu.key.encode import dec_u64, prefix_end
 from surrealdb_tpu.sql.value import Thing
 from surrealdb_tpu.utils.ser import unpack
-from surrealdb_tpu.idx.ft_index import unpack_lens, unpack_plist, unpack_posting
+from surrealdb_tpu.idx.ft_index import (
+    rid_chunk_get,
+    unpack_lens,
+    unpack_plist,
+    unpack_posting,
+)
 
 
 class FtMirror:
@@ -287,11 +292,9 @@ class FtMirror:
             if i >= 0:
                 start, rids = self.rid_chunks[i]
                 if isinstance(rids, bytes):
-                    rids = unpack(rids)
+                    rids = unpack(rids)  # columnar dict or generic list
                     self.rid_chunks[i] = (start, rids)
-                off = did - start
-                if 0 <= off < len(rids):
-                    return rids[off]
+                return rid_chunk_get(rids, did - start)
             return None
 
     # ------------------------------------------------------------ arrays

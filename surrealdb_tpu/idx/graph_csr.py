@@ -505,6 +505,25 @@ class GraphMirrors:
         nodes = np.fromiter(sorted(out), dtype=np.int32, count=len(out))
         return nodes, np.array([out[int(n)] for n in nodes], dtype=np.int32)
 
+    def _chain_work_estimate(self, ns, db, specs, counts) -> float:
+        """Expected edges traversed by a count chain: Σ over hops of the
+        frontier size estimate × that hop's average degree (random-graph
+        expectation from mirror edge counts). Decides device routing — a
+        1-seed chain over a degree-4 graph is ~40 edges of HOST work no
+        matter how many total edges the graph has, while the same seed on
+        a degree-100 social graph explodes past any host budget."""
+        frontier_est = float(counts.sum())
+        work = 0.0
+        for sp in specs:
+            deg = 0.0
+            for m in self._hop_mirrors(ns, db, sp):
+                deg += m.edge_count / max(len(m.adj), 1)
+            frontier_est *= deg
+            work += frontier_est
+            if work >= 1e12:
+                break
+        return work
+
     # ------------------------------------------------ dense composed counts
     def table_space(self, ns: str, db: str, tb: str) -> dict:
         """Compact per-table id space over the shared interner: sorted
@@ -812,11 +831,7 @@ class GraphMirrors:
             and not cnf.TPU_DISABLE
             and dispatch is not None
             and frontier.size
-            and sum(
-                m.edge_count
-                for sp in specs
-                for m in self._hop_mirrors(ns, db, sp)
-            )
+            and self._chain_work_estimate(ns, db, specs, counts)
             >= cnf.TPU_GRAPH_COUNT_EDGES
         ):
             # big count chain: straight to device from the seed — the whole

@@ -33,6 +33,29 @@ from .mem import MemDatastore, MemTransaction
 
 MAGIC = b"STPU1\n"
 WAL_MAGIC = b"STPUW1\n"
+
+# ---------------------------------------------------------------- versioning
+# On-disk format versions (role of the reference's storage version gate +
+# migration path, core/src/kvs/version/mod.rs + ds.rs:524): the snapshot
+# magic encodes the version; opening an older-but-known version runs the
+# registered migrations then rewrites the snapshot at CURRENT_VERSION.
+KNOWN_MAGICS = {MAGIC: 1}
+CURRENT_VERSION = 1
+# {from_version: fn(snapshot_items) -> snapshot_items} — chained upward.
+# v1 is the first released format, so the chain is empty today; the gate
+# and `surreal upgrade` exist so a v2 change is a registry entry, not a
+# breaking release.
+MIGRATIONS: dict = {}
+
+
+def storage_version(path: str) -> int:
+    """Version of an on-disk datastore; raises on unrecognized files."""
+    with open(path, "rb") as f:
+        head = f.read(16)
+    for magic, ver in KNOWN_MAGICS.items():
+        if head.startswith(magic):
+            return ver
+    raise ValueError(f"{path} is not a surrealdb_tpu datastore")
 _TOMBSTONE = 0xFFFFFFFF
 
 
@@ -101,18 +124,38 @@ class FileDatastore(BackendDatastore):
     def _load_snapshot(self) -> None:
         with open(self.path, "rb") as f:
             data = f.read()
-        if not data.startswith(MAGIC):
+        ver = None
+        for magic, v in KNOWN_MAGICS.items():
+            if data.startswith(magic):
+                ver, pos = v, len(magic)
+                break
+        if ver is None:
             raise ValueError(f"{self.path} is not a surrealdb_tpu datastore")
-        pos = len(MAGIC)
         n = len(data)
-        keys = []
+        items = []
         while pos < n:
+            if pos + 8 > n:
+                raise ValueError(
+                    f"{self.path}: truncated snapshot record at byte {pos} "
+                    "— run `surreal fix` to repair"
+                )
             klen, vlen = struct.unpack_from(">II", data, pos)
             pos += 8
+            if pos + klen + vlen > n:
+                raise ValueError(
+                    f"{self.path}: truncated snapshot record at byte {pos} "
+                    "— run `surreal fix` to repair"
+                )
             k = data[pos : pos + klen]
             pos += klen
             v = data[pos : pos + vlen]
             pos += vlen
+            items.append((k, v))
+        while ver < CURRENT_VERSION:
+            items = MIGRATIONS[ver](items)
+            ver += 1
+        keys = []
+        for k, v in items:
             self.mem.data[k] = [(0, v)]
             keys.append(k)
         self.mem.sorted_keys.update(keys)
@@ -195,10 +238,6 @@ class FileDatastore(BackendDatastore):
             os.fsync(f.fileno())
         self._open_wal()
 
-    def flush(self) -> None:
-        with self._lock:
-            self._compact()
-
     def transaction(self, write: bool) -> BackendTransaction:
         return FileTransaction(self, write)
 
@@ -209,6 +248,86 @@ class FileDatastore(BackendDatastore):
                 os.fsync(self._wal_f.fileno())
                 self._wal_f.close()
                 self._wal_f = None
+
+    def flush(self) -> None:
+        with self._lock:
+            self._compact()
+
+
+def repair(path: str) -> dict:
+    """`surreal fix` (reference: src/cli/fix.rs): tolerantly re-read a
+    possibly-damaged store — keep every intact snapshot record, drop the
+    torn tail, replay every intact WAL frame — then rewrite a clean
+    snapshot + empty WAL. Returns repair statistics."""
+    stats = {"keys": 0, "snapshot_dropped_bytes": 0, "wal_frames": 0, "version": None}
+    if not os.path.exists(path):
+        raise ValueError(f"{path} does not exist")
+    with open(path, "rb") as f:
+        data = f.read()
+    ver, pos = None, 0
+    for magic, v in KNOWN_MAGICS.items():
+        if data.startswith(magic):
+            ver, pos = v, len(magic)
+            break
+    if data and ver is None:
+        raise ValueError(f"{path} is not a surrealdb_tpu datastore")
+    stats["version"] = ver or CURRENT_VERSION
+    items = {}
+    n = len(data)
+    while pos < n:
+        if pos + 8 > n:
+            break
+        klen, vlen = struct.unpack_from(">II", data, pos)
+        if pos + 8 + klen + vlen > n:
+            break
+        k = data[pos + 8 : pos + 8 + klen]
+        v = data[pos + 8 + klen : pos + 8 + klen + vlen]
+        items[k] = v
+        pos += 8 + klen + vlen
+    stats["snapshot_dropped_bytes"] = n - pos
+    if ver is not None:
+        lst = list(items.items())
+        while ver < CURRENT_VERSION:
+            lst = MIGRATIONS[ver](lst)
+            ver += 1
+        items = dict(lst)
+    wal_path = path + ".wal"
+    if os.path.exists(wal_path):
+        with open(wal_path, "rb") as f:
+            wal = f.read()
+        if wal.startswith(WAL_MAGIC):
+            for payload, _end in _iter_frames(wal, len(WAL_MAGIC)):
+                stats["wal_frames"] += 1
+                for k, v in _iter_records(payload):
+                    if v is None:
+                        items.pop(k, None)
+                    else:
+                        items[k] = v
+    stats["keys"] = len(items)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(MAGIC)
+        for k, v in sorted(items.items()):
+            f.write(struct.pack(">II", len(k), len(v)))
+            f.write(k)
+            f.write(v)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    with open(wal_path, "wb") as f:
+        f.write(WAL_MAGIC)
+        f.flush()
+        os.fsync(f.fileno())
+    return stats
+
+
+def upgrade(path: str) -> dict:
+    """`surreal upgrade`: migrate an on-disk store to CURRENT_VERSION
+    (a no-op rewrite when already current)."""
+    before = storage_version(path)
+    stats = repair(path)
+    stats["from_version"], stats["to_version"] = before, CURRENT_VERSION
+    return stats
 
 
 class FileTransaction(MemTransaction):

@@ -36,14 +36,22 @@ class BodyTooLarge(Exception):
 
 
 def _capped(fn):
-    """Route wrapper: any oversized request body becomes a 413 instead of an
-    unbounded read (the body is never read — see _body)."""
+    """Route wrapper (the per-request middleware seam, reference
+    src/net/mod.rs:68-183 + net/tracer.rs): request-id assignment, client-ip
+    extraction, duration telemetry, and the oversized-body 413 guard."""
 
     def inner(self):
+        import time as _time
+
+        from surrealdb_tpu import telemetry
+
+        t0 = _time.perf_counter()
         try:
             return fn(self)
         except BodyTooLarge:
             return self._send(413, {"error": "request body too large"})
+        finally:
+            telemetry.observe("http_request_duration", _time.perf_counter() - t0)
 
     return inner
 
@@ -53,6 +61,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
     server_version = f"surrealdb-tpu/{__version__}"
     ds = None  # set by serve()
     auth_enabled = True
+    cors_origins = "*"  # None disables CORS headers entirely
 
     # ------------------------------------------------------------ plumbing
     def log_message(self, fmt, *args):  # quiet by default
@@ -61,7 +70,68 @@ class SurrealHandler(BaseHTTPRequestHandler):
     def parse_request(self):
         # one handler instance serves many keep-alive requests
         self.__dict__.pop("_cached_body", None)
+        self.__dict__.pop("_req_id", None)
         return super().parse_request()
+
+    def request_id(self) -> str:
+        """Per-request id: the client's x-request-id when given (so traces
+        compose across services), else a fresh UUID — echoed on every
+        response (reference: src/net/mod.rs request-id layer)."""
+        rid = self.__dict__.get("_req_id")
+        if rid is None:
+            import uuid as _uuid
+
+            rid = self.headers.get("x-request-id") or str(_uuid.uuid4())
+            self._req_id = rid[:128]
+        return self._req_id
+
+    def client_ip(self) -> str:
+        """Originating client ip: first X-Forwarded-For hop, X-Real-IP, or
+        the socket peer (reference: src/net/client_ip.rs)."""
+        fwd = self.headers.get("x-forwarded-for")
+        if fwd:
+            return fwd.split(",")[0].strip()
+        real = self.headers.get("x-real-ip")
+        if real:
+            return real.strip()
+        return self.client_address[0]
+
+    def _cors_headers(self) -> list:
+        origins = self.cors_origins
+        if origins is None:
+            return []
+        origin = self.headers.get("Origin")
+        if origins == "*":
+            allow = "*"
+        elif isinstance(origins, str):
+            # a single allowed origin — EXACT match (substring matching
+            # would reflect attacker origins)
+            if origin != origins:
+                return []
+            allow = origin
+        elif origin and origin in origins:  # list/set membership
+            allow = origin
+        else:
+            return []
+        out = [("Access-Control-Allow-Origin", allow)]
+        if allow != "*":
+            out.append(("Vary", "Origin"))
+        return out
+
+    def do_OPTIONS(self):
+        """CORS preflight (reference: src/net/mod.rs CorsLayer)."""
+        self.send_response(204)
+        for k, v in self._cors_headers():
+            self.send_header(k, v)
+        self.send_header("Access-Control-Allow-Methods", "GET, POST, PUT, PATCH, DELETE, OPTIONS")
+        self.send_header(
+            "Access-Control-Allow-Headers",
+            "Authorization, Content-Type, Accept, NS, DB, surreal-ns, surreal-db, x-request-id",
+        )
+        self.send_header("Access-Control-Max-Age", "86400")
+        self.send_header("x-request-id", self.request_id())
+        self.send_header("Content-Length", "0")
+        self.end_headers()
 
     def _body(self) -> bytes:
         if not hasattr(self, "_cached_body"):
@@ -98,6 +168,9 @@ class SurrealHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in self._cors_headers():
+            self.send_header(k, v)
+        self.send_header("x-request-id", self.request_id())
         self.end_headers()
         self.wfile.write(body)
 
@@ -628,11 +701,30 @@ class SurrealHandler(BaseHTTPRequestHandler):
 class Server:
     """Embedded server handle (reference: `surreal start`)."""
 
-    def __init__(self, ds, host: str = "127.0.0.1", port: int = 8000, auth_enabled: bool = True):
+    def __init__(
+        self,
+        ds,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        auth_enabled: bool = True,
+        tls_cert: Optional[str] = None,
+        tls_key: Optional[str] = None,
+        cors_origins="*",
+    ):
         handler = type(
-            "BoundHandler", (SurrealHandler,), {"ds": ds, "auth_enabled": auth_enabled}
+            "BoundHandler",
+            (SurrealHandler,),
+            {"ds": ds, "auth_enabled": auth_enabled, "cors_origins": cors_origins},
         )
         self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.tls = bool(tls_cert)
+        if tls_cert:
+            # TLS termination (reference: surreal start --web-crt/--web-key)
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key or tls_cert)
+            self.httpd.socket = ctx.wrap_socket(self.httpd.socket, server_side=True)
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
         # node membership bootstrap (reference ds.rs:623): register this
@@ -659,7 +751,8 @@ class Server:
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
     def start_background(self) -> "Server":
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
@@ -682,6 +775,9 @@ def serve(
     port: int = 8000,
     auth_enabled: bool = True,
     capabilities=None,
+    tls_cert: Optional[str] = None,
+    tls_key: Optional[str] = None,
+    cors_origins="*",
 ) -> Server:
     from surrealdb_tpu.kvs.ds import Datastore
 
@@ -689,4 +785,7 @@ def serve(
     ds.enable_notifications()
     if capabilities is not None:
         ds.capabilities = capabilities
-    return Server(ds, host, port, auth_enabled)
+    return Server(
+        ds, host, port, auth_enabled,
+        tls_cert=tls_cert, tls_key=tls_key, cors_origins=cors_origins,
+    )

@@ -59,7 +59,12 @@ class RpcContext:
         m = method.lower()
         if m not in METHODS:
             raise SurrealError(f"Method '{method}' not found")
-        return getattr(self, f"_m_{m}")(params)
+        from surrealdb_tpu import telemetry
+
+        # one seam covers BOTH the HTTP /rpc route and the WS actor
+        # (reference: src/telemetry/metrics/ws/ rpc method instrumentation)
+        with telemetry.span("rpc_method", method=m):
+            return getattr(self, f"_m_{m}")(params)
 
     # ------------------------------------------------------------ helpers
     def _query(self, text: str, vars: Optional[Dict[str, Any]] = None) -> List[dict]:

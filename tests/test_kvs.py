@@ -304,3 +304,61 @@ def test_wal_compaction_truncates_and_preserves(tmp_path, monkeypatch):
     assert tx.get_record("n", "d", "t", 49) == {"v": "x" * 100}
     tx.cancel()
     ds2.close()
+
+
+def _owner():
+    from surrealdb_tpu.dbs.session import Session
+
+    s = Session.owner()
+    s.ns, s.db = "n", "d"
+    return s
+
+
+def test_fix_repairs_torn_snapshot(tmp_path):
+    """`surreal fix` recovers the intact prefix of a damaged snapshot and
+    replays intact WAL frames (reference: src/cli/fix.rs)."""
+    from surrealdb_tpu.kvs.ds import Datastore
+    from surrealdb_tpu.kvs.file import repair, storage_version
+
+    path = str(tmp_path / "db")
+    ds = Datastore(f"file://{path}")
+    s = _owner()
+    ds.execute("CREATE t:1 SET v = 1; CREATE t:2 SET v = 2;", s)
+    ds.backend.flush()
+    ds.execute("CREATE t:3 SET v = 3;", s)  # lives in the WAL
+    ds.close()
+
+    # tear the snapshot tail
+    with open(path, "ab") as f:
+        f.write(b"\x00\x01garbage")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="surreal fix"):
+        Datastore(f"file://{path}")
+
+    stats = repair(path)
+    assert stats["snapshot_dropped_bytes"] > 0
+    assert stats["wal_frames"] >= 1
+    assert storage_version(path) == 1
+
+    ds2 = Datastore(f"file://{path}")
+    out = ds2.execute("SELECT VALUE v FROM t ORDER BY v;", s)
+    assert out[-1]["result"] == [1, 2, 3]
+    ds2.close()
+
+
+def test_upgrade_reports_version(tmp_path):
+    from surrealdb_tpu.kvs.ds import Datastore
+    from surrealdb_tpu.kvs.file import upgrade
+
+    path = str(tmp_path / "db")
+    ds = Datastore(f"file://{path}")
+    ds.execute("CREATE t:1 SET v = 1;", _owner())
+    ds.backend.flush()
+    ds.close()
+    stats = upgrade(path)
+    assert stats["from_version"] == 1 and stats["to_version"] == 1
+    ds2 = Datastore(f"file://{path}")
+    out = ds2.execute("SELECT VALUE v FROM t;", _owner())
+    assert out[-1]["result"] == [1]
+    ds2.close()
