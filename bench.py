@@ -396,6 +396,18 @@ def bench_knn(ds, s, corpus, rng):
     log("knn: concurrent-clients pass (dispatch coalescing)")
     import threading
 
+    # untimed warm burst: compiles any batch-tile shapes the coalesced
+    # pass will hit (a remote-compile round mid-measurement would both
+    # skew the number and stress the tunnel's compile service)
+    wthreads = [
+        threading.Thread(target=lambda i=i: run(ds, s, sql, {"q": qs[i % nq].tolist()}))
+        for i in range(8)
+    ]
+    for t in wthreads:
+        t.start()
+    for t in wthreads:
+        t.join()
+
     stats0 = ds.dispatch.stats()  # diff out the sequential passes
     nthreads, rounds = 32, 2
     cq = rng.integers(0, NI, size=nthreads * rounds)

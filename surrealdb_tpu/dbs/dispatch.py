@@ -74,6 +74,7 @@ class DispatchQueue:
         self.submitted = 0
         self.dispatches = 0
         self.batched = 0  # requests that rode someone else's dispatch
+        self.retries = 0  # batches retried after a transient device error
         self.launch_s = 0.0  # time in runner launch phases (upload + enqueue)
         self.collect_s = 0.0  # time awaiting device results (download)
 
@@ -132,12 +133,32 @@ class DispatchQueue:
         with self._lock:
             self.dispatches += 1
             self.batched += len(batch) - 1
+        payloads = [r.payload for r in batch]
+        runner = batch[0].runner
+
+        def run_sync():
+            """One full runner execution (launch + collect for two-phase)."""
+            r = runner(payloads)
+            return r() if callable(r) else r
+
         t0 = _time.perf_counter()
         try:
             from surrealdb_tpu import telemetry
 
             with telemetry.span("dispatch_launch", batch=str(len(batch))):
-                res = batch[0].runner([r.payload for r in batch])
+                res = runner(payloads)
+        except Exception:
+            # transient device-side failures happen on tunneled/remote
+            # chips (e.g. the remote compile service returning 500 under
+            # load) — retry the whole batch ONCE before failing every rider
+            with self._lock:
+                self.retries += 1
+            try:
+                _time.sleep(0.2)
+                self._distribute(batch, run_sync())
+            except BaseException as e2:
+                self._fail(batch, e2)
+            return None
         except BaseException as e:  # propagate to every waiter
             self._fail(batch, e)
             return None
@@ -155,6 +176,15 @@ class DispatchQueue:
 
                 with telemetry.span("dispatch_collect"):
                     results = res()
+            except Exception:
+                with self._lock:
+                    self.retries += 1
+                try:
+                    _time.sleep(0.2)
+                    self._distribute(batch, run_sync())
+                except BaseException as e2:
+                    self._fail(batch, e2)
+                return
             except BaseException as e:
                 self._fail(batch, e)
                 return
@@ -193,6 +223,7 @@ class DispatchQueue:
                 "submitted": self.submitted,
                 "dispatches": self.dispatches,
                 "batched": self.batched,
+                "retries": self.retries,
                 "launch_s": round(self.launch_s, 4),
                 "collect_s": round(self.collect_s, 4),
             }

@@ -277,3 +277,55 @@ def test_parallel_shows_in_explain(ds, sess):
     assert "Parallel" in ops
     out2 = ds.execute("SELECT * FROM a, b EXPLAIN", sess)[-1]["result"]
     assert "Parallel" not in [r["operation"] for r in out2]
+
+
+def test_transient_runner_failure_retried_once():
+    """A batch whose runner raises a transient device error is retried
+    once before failing every rider (tunneled chips' remote compile
+    service occasionally 500s under load)."""
+    from surrealdb_tpu.dbs.dispatch import DispatchQueue
+
+    q = DispatchQueue()
+    calls = {"n": 0}
+
+    def runner(payloads):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("remote_compile: HTTP 500")
+        return [p * 10 for p in payloads]
+
+    assert q.submit("k", 4, runner) == 40
+    assert calls["n"] == 2
+    assert q.stats()["retries"] == 1
+
+
+def test_transient_collect_failure_retried_once():
+    from surrealdb_tpu.dbs.dispatch import DispatchQueue
+
+    q = DispatchQueue()
+    calls = {"n": 0}
+
+    def runner(payloads):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            def bad_collect():
+                raise RuntimeError("transfer failed")
+            return bad_collect
+        return [p + 1 for p in payloads]
+
+    assert q.submit("k", 5, runner) == 6
+    assert calls["n"] == 2
+
+
+def test_persistent_failure_still_fails():
+    from surrealdb_tpu.dbs.dispatch import DispatchQueue
+
+    q = DispatchQueue()
+
+    def runner(payloads):
+        raise RuntimeError("always broken")
+
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="always broken"):
+        q.submit("k", 1, runner)
