@@ -396,12 +396,13 @@ def bench_knn(ds, s, corpus, rng):
     log("knn: concurrent-clients pass (dispatch coalescing)")
     import threading
 
-    # untimed warm burst: compiles any batch-tile shapes the coalesced
-    # pass will hit (a remote-compile round mid-measurement would both
-    # skew the number and stress the tunnel's compile service)
+    # untimed warm burst at the SAME client count as the timed pass:
+    # compiles the batch-tile shapes the coalesced pass will hit (a
+    # remote-compile round mid-measurement would both skew the number and
+    # stress the tunnel's compile service)
     wthreads = [
         threading.Thread(target=lambda i=i: run(ds, s, sql, {"q": qs[i % nq].tolist()}))
-        for i in range(8)
+        for i in range(32)
     ]
     for t in wthreads:
         t.start()

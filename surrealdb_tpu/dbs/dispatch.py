@@ -35,6 +35,28 @@ import time as _time
 from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Tuple
 
 
+_TRANSIENT_MARKERS = (
+    "remote_compile",
+    "HTTP 5",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "RESOURCE_EXHAUSTED",
+    "INTERNAL",
+    "Connection reset",
+    "Broken pipe",
+)
+
+
+def _transient(e: BaseException) -> bool:
+    """Device-side failures worth one retry: tunneled/remote chips drop
+    compiles and transfers under load. Deterministic errors (bad payload
+    shapes, engine bugs) must NOT re-execute the batch."""
+    if type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError"):
+        return True
+    msg = str(e)
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
 class _Req:
     __slots__ = ("payload", "runner", "event", "result", "error", "promoted", "done")
 
@@ -147,16 +169,20 @@ class DispatchQueue:
 
             with telemetry.span("dispatch_launch", batch=str(len(batch))):
                 res = runner(payloads)
-        except Exception:
+        except Exception as e:
             # transient device-side failures happen on tunneled/remote
             # chips (e.g. the remote compile service returning 500 under
             # load) — retry the whole batch ONCE before failing every rider
+            if not _transient(e):
+                self._fail(batch, e)
+                return None
             with self._lock:
                 self.retries += 1
             try:
                 _time.sleep(0.2)
                 self._distribute(batch, run_sync())
             except BaseException as e2:
+                e2.__cause__ = e
                 self._fail(batch, e2)
             return None
         except BaseException as e:  # propagate to every waiter
@@ -176,13 +202,17 @@ class DispatchQueue:
 
                 with telemetry.span("dispatch_collect"):
                     results = res()
-            except Exception:
+            except Exception as e:
+                if not _transient(e):
+                    self._fail(batch, e)
+                    return
                 with self._lock:
                     self.retries += 1
                 try:
                     _time.sleep(0.2)
                     self._distribute(batch, run_sync())
                 except BaseException as e2:
+                    e2.__cause__ = e
                     self._fail(batch, e2)
                 return
             except BaseException as e:

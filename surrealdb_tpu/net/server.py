@@ -644,6 +644,57 @@ class SurrealHandler(BaseHTTPRequestHandler):
         t = threading.Thread(target=pump, daemon=True)
         t.start()
 
+        # per-socket concurrent request pool (reference: the WS actor's
+        # concurrent-request semaphore, src/rpc/connection.rs:80-147).
+        # Concurrency here is what lets one connection's queries coalesce
+        # into shared kernel launches (dbs/dispatch.py); session-mutating
+        # methods drain in-flight work first and run inline so `use`/
+        # `signin` can't race a concurrently-executing query.
+        from surrealdb_tpu import cnf
+        from surrealdb_tpu.net.ws import DaemonPool
+
+        pool = DaemonPool(max(cnf.WEBSOCKET_MAX_CONCURRENT_REQUESTS, 1))
+        inflight: list = []
+        _SESSION_METHODS = {
+            "use", "signin", "signup", "authenticate", "invalidate",
+            "let", "set", "unset", "reset",
+        }
+
+        def handle(req: dict, binary: bool) -> None:
+            rid = req.get("id")
+            method = req.get("method", "")
+            frame = None
+            try:
+                # same capability policy as HTTP /rpc; checked per message
+                # because signin/authenticate upgrade the session mid-stream
+                denied = self._rpc_denied(method, ctx.session)
+                if denied is not None:
+                    raise InvalidAuthError(denied)
+                result = ctx.execute(method, req.get("params") or [])
+                resp: Dict[str, Any] = {"id": rid, "result": result}
+                # encode INSIDE the guard: an unserializable result must
+                # still produce an error frame, never a silent dropped id
+                if binary:
+                    frame = wsproto.encode_frame(wsproto.OP_BINARY, self._ws_encode(resp))
+                else:
+                    frame = wsproto.encode_frame(
+                        wsproto.OP_TEXT, json.dumps(to_json_value(resp)).encode()
+                    )
+            except Exception as e:  # noqa: BLE001 — a worker must not die silently
+                msg = str(e) if isinstance(e, SurrealError) else f"Internal error: {e}"
+                resp = {"id": rid, "error": {"code": -32000, "message": msg}}
+                if binary:
+                    frame = wsproto.encode_frame(wsproto.OP_BINARY, self._ws_encode(resp))
+                else:
+                    frame = wsproto.encode_frame(
+                        wsproto.OP_TEXT, json.dumps(to_json_value(resp)).encode()
+                    )
+            try:
+                with send_lock:
+                    sock.sendall(frame)
+            except OSError:
+                pass
+
         try:
             while True:
                 # read via the buffered rfile (it may hold early frame bytes)
@@ -670,31 +721,21 @@ class SurrealHandler(BaseHTTPRequestHandler):
                         req = wire_unpack(payload)
                 except Exception:
                     continue
-                rid = req.get("id")
-                method = req.get("method", "")
-                try:
-                    # same capability policy as HTTP /rpc; checked per
-                    # message because signin/authenticate upgrade the session
-                    # mid-connection
-                    denied = self._rpc_denied(method, ctx.session)
-                    if denied is not None:
-                        raise InvalidAuthError(denied)
-                    result = ctx.execute(method, req.get("params") or [])
-                    resp: Dict[str, Any] = {"id": rid, "result": result}
-                except SurrealError as e:
-                    resp = {"id": rid, "error": {"code": -32000, "message": str(e)}}
-                if op == wsproto.OP_BINARY:
-                    frame = wsproto.encode_frame(wsproto.OP_BINARY, self._ws_encode(resp))
+                if not isinstance(req, dict):
+                    continue
+                inflight = [ev for ev in inflight if not ev.is_set()]
+                if str(req.get("method", "")).lower() in _SESSION_METHODS:
+                    for ev in inflight:
+                        ev.wait()
+                    inflight.clear()
+                    handle(req, op == wsproto.OP_BINARY)
                 else:
-                    frame = wsproto.encode_frame(
-                        wsproto.OP_TEXT, json.dumps(to_json_value(resp)).encode()
-                    )
-                with send_lock:
-                    sock.sendall(frame)
+                    inflight.append(pool.submit(handle, req, op == wsproto.OP_BINARY))
         except (ConnectionError, OSError):
             pass
         finally:
             alive["v"] = False
+            pool.shutdown()
         self.close_connection = True
 
 

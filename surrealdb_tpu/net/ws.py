@@ -136,3 +136,48 @@ class BufferedSocket:
 
     def sendall(self, data: bytes) -> None:
         self.sock.sendall(data)
+
+
+class DaemonPool:
+    """Tiny fixed-size worker pool on DAEMON threads (a stuck query must
+    not block interpreter exit the way concurrent.futures' atexit-joined
+    workers would — the surrounding HTTP handler threads are daemonized
+    for the same reason). submit() returns a threading.Event that sets
+    when the task finishes (exceptions included — tasks handle their own
+    errors)."""
+
+    def __init__(self, workers: int):
+        import queue as _queue
+        import threading as _threading
+
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._threads = [
+            _threading.Thread(target=self._worker, daemon=True, name=f"ws-pool-{i}")
+            for i in range(max(workers, 1))
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, args, done = item
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 — tasks report their own errors
+                pass
+            finally:
+                done.set()
+
+    def submit(self, fn, *args):
+        import threading as _threading
+
+        done = _threading.Event()
+        self._q.put((fn, args, done))
+        return done
+
+    def shutdown(self) -> None:
+        for _ in self._threads:
+            self._q.put(None)

@@ -309,12 +309,32 @@ def test_transient_collect_failure_retried_once():
         calls["n"] += 1
         if calls["n"] == 1:
             def bad_collect():
-                raise RuntimeError("transfer failed")
+                raise RuntimeError("UNAVAILABLE: transfer failed")
             return bad_collect
         return [p + 1 for p in payloads]
 
     assert q.submit("k", 5, runner) == 6
     assert calls["n"] == 2
+
+
+def test_deterministic_failure_not_retried():
+    """Non-transient errors (bad payloads, engine bugs) fail immediately
+    without re-executing the batch."""
+    from surrealdb_tpu.dbs.dispatch import DispatchQueue
+
+    q = DispatchQueue()
+    calls = {"n": 0}
+
+    def runner(payloads):
+        calls["n"] += 1
+        raise ValueError("bad shape")
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        q.submit("k", 1, runner)
+    assert calls["n"] == 1
+    assert q.stats()["retries"] == 0
 
 
 def test_persistent_failure_still_fails():
@@ -323,7 +343,7 @@ def test_persistent_failure_still_fails():
     q = DispatchQueue()
 
     def runner(payloads):
-        raise RuntimeError("always broken")
+        raise RuntimeError("UNAVAILABLE: always broken")
 
     import pytest as _pytest
 

@@ -85,3 +85,47 @@ def test_cors_origin_allowlist(ds):
         c.close()
     finally:
         srv.shutdown()
+
+
+def test_ws_pipelined_requests_run_concurrently(ds):
+    """Per-socket concurrency: a fast query pipelined behind a slow one
+    must answer FIRST (reference: WS actor's concurrent-request
+    semaphore, src/rpc/connection.rs)."""
+    import socket as _socket
+
+    from surrealdb_tpu.net import ws as wsproto
+
+    srv = Server(ds, port=0, auth_enabled=False).start_background()
+    try:
+        s = _socket.create_connection((srv.host, srv.port))
+        key = "dGhlIHNhbXBsZSBub25jZQ=="
+        s.sendall(
+            (
+                f"GET /rpc HTTP/1.1\r\nHost: {srv.host}\r\nUpgrade: websocket\r\n"
+                f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+                "Sec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        # read the 101 response headers
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            buf += s.recv(4096)
+        f = s.makefile("rb")
+
+        def send(obj):
+            s.sendall(wsproto.encode_frame(wsproto.OP_TEXT, json.dumps(obj).encode(), mask=True))
+
+        send({"id": 1, "method": "use", "params": ["t", "t"]})
+        op, payload = wsproto.read_frame(f)
+        assert json.loads(payload)["id"] == 1
+        send({"id": "slow", "method": "query", "params": ["RETURN sleep(600ms) OR 'slept';"]})
+        send({"id": "fast", "method": "query", "params": ["RETURN 1 + 1;"]})
+        op, payload = wsproto.read_frame(f)
+        first = json.loads(payload)
+        op, payload = wsproto.read_frame(f)
+        second = json.loads(payload)
+        assert first["id"] == "fast", (first, second)
+        assert second["id"] == "slow"
+        s.close()
+    finally:
+        srv.shutdown()
