@@ -1,0 +1,194 @@
+/* Native order-preserving key codec (role of the reference's derive(Key)
+ * order-preserving serializer, core/src/key/mod.rs:1-77 — there a Rust
+ * proc-macro; here a CPython extension compiled by the in-tree toolchain,
+ * surrealdb_tpu/native/__init__.py).
+ *
+ * Implements the hot primitives of surrealdb_tpu/key/encode.py with
+ * identical byte-for-byte output (property-tested against the Python
+ * twins in tests/test_native_codec.py):
+ *   enc_str / enc_bytes    0x00 -> 0x00 0xFF escape + 0x00 terminator
+ *   dec_bytes              inverse, returns (bytes, next_pos)
+ *   enc_int_key            T_NUMBER tag + f64 offset-bits + i64 offset
+ *   enc_value_key_fast     int/str fast path; None for other types (the
+ *                          Python layer handles the full Value domain)
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <cstdint>
+#include <cstring>
+
+static const uint8_t T_NUMBER = 0x10;
+static const uint8_t T_STRAND = 0x20;
+
+/* escape src into dst (dst must hold 2*n+1); returns bytes written */
+static Py_ssize_t escape_terminate(const uint8_t *src, Py_ssize_t n, uint8_t *dst) {
+    Py_ssize_t w = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        uint8_t c = src[i];
+        dst[w++] = c;
+        if (c == 0x00) dst[w++] = 0xFF;
+    }
+    dst[w++] = 0x00;
+    return w;
+}
+
+static PyObject *enc_escaped(const uint8_t *src, Py_ssize_t n) {
+    /* common case: no NUL bytes -> one memchr + one copy */
+    if (memchr(src, 0, (size_t)n) == NULL) {
+        PyObject *out = PyBytes_FromStringAndSize(NULL, n + 1);
+        if (!out) return NULL;
+        uint8_t *d = (uint8_t *)PyBytes_AS_STRING(out);
+        memcpy(d, src, (size_t)n);
+        d[n] = 0x00;
+        return out;
+    }
+    PyObject *out = PyBytes_FromStringAndSize(NULL, 2 * n + 1);
+    if (!out) return NULL;
+    Py_ssize_t w = escape_terminate(src, n, (uint8_t *)PyBytes_AS_STRING(out));
+    if (_PyBytes_Resize(&out, w) < 0) return NULL;
+    return out;
+}
+
+static PyObject *py_enc_str(PyObject *self, PyObject *arg) {
+    if (!PyUnicode_Check(arg)) {
+        PyErr_SetString(PyExc_TypeError, "enc_str expects str");
+        return NULL;
+    }
+    Py_ssize_t n;
+    const char *s = PyUnicode_AsUTF8AndSize(arg, &n);
+    if (!s) return NULL;
+    return enc_escaped((const uint8_t *)s, n);
+}
+
+static PyObject *py_enc_bytes(PyObject *self, PyObject *arg) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
+    PyObject *out = enc_escaped((const uint8_t *)view.buf, view.len);
+    PyBuffer_Release(&view);
+    return out;
+}
+
+static PyObject *py_dec_bytes(PyObject *self, PyObject *args) {
+    Py_buffer view;
+    Py_ssize_t pos;
+    if (!PyArg_ParseTuple(args, "y*n", &view, &pos)) return NULL;
+    const uint8_t *b = (const uint8_t *)view.buf;
+    Py_ssize_t n = view.len;
+    if (pos < 0 || pos > n) {
+        PyBuffer_Release(&view);
+        PyErr_SetString(PyExc_ValueError, "position out of range");
+        return NULL;
+    }
+    /* first pass: find terminator, count escapes */
+    Py_ssize_t i = pos, esc = 0, end = -1;
+    while (i < n) {
+        if (b[i] == 0x00) {
+            if (i + 1 < n && b[i + 1] == 0xFF) { esc++; i += 2; continue; }
+            end = i; break;
+        }
+        i++;
+    }
+    if (end < 0) {
+        PyBuffer_Release(&view);
+        PyErr_SetString(PyExc_ValueError, "unterminated string in key");
+        return NULL;
+    }
+    PyObject *out = PyBytes_FromStringAndSize(NULL, end - pos - esc);
+    if (!out) { PyBuffer_Release(&view); return NULL; }
+    uint8_t *d = (uint8_t *)PyBytes_AS_STRING(out);
+    for (i = pos; i < end; ) {
+        uint8_t c = b[i];
+        *d++ = c;
+        i += (c == 0x00) ? 2 : 1;  /* skip the 0xFF escape byte */
+    }
+    PyObject *ret = Py_BuildValue("Nn", out, end + 1);
+    PyBuffer_Release(&view);
+    return ret;
+}
+
+static inline void store_be64(uint8_t *d, uint64_t v) {
+    for (int i = 7; i >= 0; i--) { d[i] = (uint8_t)(v & 0xFF); v >>= 8; }
+}
+
+/* T_NUMBER | f64-orderbits | i64-offset — byte-compatible with
+ * encode.py _enc_int_key */
+static int enc_int_key_raw(int64_t v, uint8_t out[17]) {
+    double dv = (double)v;
+    uint64_t bits;
+    memcpy(&bits, &dv, 8);
+    if (bits & 0x8000000000000000ULL) bits = ~bits;
+    else bits |= 0x8000000000000000ULL;
+    out[0] = T_NUMBER;
+    store_be64(out + 1, bits);
+    store_be64(out + 9, (uint64_t)v ^ 0x8000000000000000ULL);
+    return 0;
+}
+
+static PyObject *py_enc_int_key(PyObject *self, PyObject *arg) {
+    int overflow = 0;
+    long long v = PyLong_AsLongLongAndOverflow(arg, &overflow);
+    if (overflow || (v == -1 && PyErr_Occurred())) {
+        if (!PyErr_Occurred())
+            PyErr_SetString(PyExc_ValueError, "integer key component out of i64 range");
+        return NULL;
+    }
+    uint8_t buf[17];
+    enc_int_key_raw((int64_t)v, buf);
+    return PyBytes_FromStringAndSize((const char *)buf, 17);
+}
+
+/* int/str fast path of enc_value_key; returns None for any other type so
+ * the Python layer can handle the full Value domain (bool is a PyLong
+ * subtype — exclude it exactly like the Python `type(v) is int` check). */
+static PyObject *py_enc_value_key_fast(PyObject *self, PyObject *arg) {
+    if (PyLong_CheckExact(arg)) {
+        int overflow = 0;
+        long long v = PyLong_AsLongLongAndOverflow(arg, &overflow);
+        if (overflow) {
+            PyErr_SetString(PyExc_ValueError, "integer key component out of i64 range");
+            return NULL;
+        }
+        if (v == -1 && PyErr_Occurred()) return NULL;
+        uint8_t buf[17];
+        enc_int_key_raw((int64_t)v, buf);
+        return PyBytes_FromStringAndSize((const char *)buf, 17);
+    }
+    if (PyUnicode_CheckExact(arg)) {
+        Py_ssize_t n;
+        const char *s = PyUnicode_AsUTF8AndSize(arg, &n);
+        if (!s) return NULL;
+        if (memchr(s, 0, (size_t)n) == NULL) {
+            PyObject *out = PyBytes_FromStringAndSize(NULL, n + 2);
+            if (!out) return NULL;
+            uint8_t *d = (uint8_t *)PyBytes_AS_STRING(out);
+            d[0] = T_STRAND;
+            memcpy(d + 1, s, (size_t)n);
+            d[n + 1] = 0x00;
+            return out;
+        }
+        PyObject *out = PyBytes_FromStringAndSize(NULL, 2 * n + 2);
+        if (!out) return NULL;
+        uint8_t *d = (uint8_t *)PyBytes_AS_STRING(out);
+        d[0] = T_STRAND;
+        Py_ssize_t w = escape_terminate((const uint8_t *)s, n, d + 1);
+        if (_PyBytes_Resize(&out, w + 1) < 0) return NULL;
+        return out;
+    }
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef Methods[] = {
+    {"enc_str", py_enc_str, METH_O, "order-preserving string encode"},
+    {"enc_bytes", py_enc_bytes, METH_O, "order-preserving bytes encode"},
+    {"dec_bytes", py_dec_bytes, METH_VARARGS, "decode escaped bytes at pos"},
+    {"enc_int_key", py_enc_int_key, METH_O, "T_NUMBER int key component"},
+    {"enc_value_key_fast", py_enc_value_key_fast, METH_O,
+     "int/str value-key fast path (None for other types)"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_keycodec", NULL, -1, Methods,
+};
+
+PyMODINIT_FUNC PyInit__keycodec(void) { return PyModule_Create(&moduledef); }
