@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Validator for bench_results_*.json artifacts (driver-proof evidence).
+
+Schema-checks the artifact bench.py writes so a kNN anomaly (or any other
+per-config regression) stays attributable from the artifact alone even when
+the driver truncates stdout: every requested config must be present, and
+every per-config line must carry the error/retry/strategy/batch accounting
+pulled from the engine's telemetry counters.
+
+Usage:
+    python scripts/check_bench_artifact.py bench_results_r06.json
+    python scripts/check_bench_artifact.py            # newest bench_results_*.json
+
+Exit code 0 = valid; 1 = invalid (reasons on stderr). Also importable:
+`validate(path) -> list[str]` returns the problems found (empty = valid).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+from typing import List
+
+SCHEMA = "surrealdb-tpu-bench/1"
+
+# keys every emitted line must carry (bench.py `emit`)
+RESULT_KEYS = ("metric", "value", "unit", "vs_baseline")
+# accounting keys every per-config line must carry (the driver-proof part)
+CONFIG_KEYS = ("config", "errors", "retries", "strategy", "batch")
+BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
+
+
+def validate(path: str) -> List[str]:
+    problems: List[str] = []
+    try:
+        with open(path) as f:
+            art = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable artifact: {e}"]
+
+    if not isinstance(art, dict):
+        return [f"{path}: artifact must be a JSON object"]
+    if art.get("schema") != SCHEMA:
+        problems.append(f"schema is {art.get('schema')!r}, expected {SCHEMA!r}")
+    for key in ("scale", "configs", "results"):
+        if key not in art:
+            problems.append(f"missing top-level key {key!r}")
+    results = art.get("results")
+    if not isinstance(results, list) or not results:
+        problems.append("results must be a non-empty list")
+        return problems
+
+    seen_configs = set()
+    headline = False
+    for i, r in enumerate(results):
+        where = f"results[{i}]"
+        if not isinstance(r, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        for key in RESULT_KEYS:
+            if key not in r:
+                problems.append(f"{where}: missing {key!r}")
+        metric = str(r.get("metric", ""))
+        if metric.startswith("north_star"):
+            headline = True
+            continue
+        if "config" not in r:
+            problems.append(f"{where} ({metric}): missing 'config'")
+            continue
+        seen_configs.add(str(r["config"]))
+        for key in CONFIG_KEYS:
+            if key not in r:
+                problems.append(f"{where} ({metric}): missing {key!r}")
+        batch = r.get("batch")
+        if isinstance(batch, dict):
+            for key in BATCH_KEYS:
+                if key not in batch:
+                    problems.append(f"{where} ({metric}): batch missing {key!r}")
+        elif "batch" in r:
+            problems.append(f"{where} ({metric}): batch must be an object")
+
+    want = {str(c) for c in art.get("configs") or []}
+    missing = want - seen_configs
+    if missing:
+        problems.append(f"configs absent from results: {sorted(missing)}")
+    if not headline:
+        problems.append("missing north_star headline line")
+    return problems
+
+
+def main(argv: List[str]) -> int:
+    if argv:
+        path = argv[0]
+    else:
+        candidates = sorted(glob.glob("bench_results_*.json"), key=os.path.getmtime)
+        if not candidates:
+            print("no bench_results_*.json found", file=sys.stderr)
+            return 1
+        path = candidates[-1]
+    problems = validate(path)
+    if problems:
+        for p in problems:
+            print(f"INVALID {path}: {p}", file=sys.stderr)
+        return 1
+    print(f"OK {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

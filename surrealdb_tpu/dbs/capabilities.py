@@ -133,7 +133,7 @@ RPC_METHODS = frozenset(
 HTTP_ROUTES = frozenset(
     {
         "export", "import", "rpc", "version", "sql", "signin", "signup", "key",
-        "ml", "graphql", "health", "sync", "status",
+        "ml", "graphql", "health", "sync", "status", "metrics", "slow",
     }
 )
 

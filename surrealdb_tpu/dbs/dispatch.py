@@ -57,8 +57,18 @@ def _transient(e: BaseException) -> bool:
     return any(m in msg for m in _TRANSIENT_MARKERS)
 
 
+def _retry_cause(e: BaseException) -> str:
+    """Low-cardinality retry-cause label: the matched transient marker,
+    else the exception class."""
+    msg = str(e)
+    for m in _TRANSIENT_MARKERS:
+        if m in msg:
+            return m.strip().replace(" ", "_")
+    return type(e).__name__
+
+
 class _Req:
-    __slots__ = ("payload", "runner", "event", "result", "error", "promoted", "done")
+    __slots__ = ("payload", "runner", "event", "result", "error", "promoted", "done", "t_submit")
 
     def __init__(self, payload, runner):
         self.payload = payload
@@ -68,6 +78,7 @@ class _Req:
         self.error: BaseException | None = None
         self.promoted = False  # woken to take over bucket leadership
         self.done = False
+        self.t_submit = _time.perf_counter()  # queue-wait accounting
 
 
 class _Bucket:
@@ -97,6 +108,7 @@ class DispatchQueue:
         self.dispatches = 0
         self.batched = 0  # requests that rode someone else's dispatch
         self.retries = 0  # batches retried after a transient device error
+        self.failures = 0  # batches that failed permanently (every rider errored)
         self.launch_s = 0.0  # time in runner launch phases (upload + enqueue)
         self.collect_s = 0.0  # time awaiting device results (download)
 
@@ -152,6 +164,8 @@ class DispatchQueue:
         """Phase 1: run the leader's runner. Sync runners finish here;
         two-phase runners return the collect closure to run after the
         bucket hand-off."""
+        from surrealdb_tpu import telemetry
+
         with self._lock:
             self.dispatches += 1
             self.batched += len(batch) - 1
@@ -164,10 +178,13 @@ class DispatchQueue:
             return r() if callable(r) else r
 
         t0 = _time.perf_counter()
+        telemetry.observe_hist("dispatch_batch_size", len(batch))
+        for r in batch:
+            telemetry.observe("dispatch_queue_wait", t0 - r.t_submit)
         try:
-            from surrealdb_tpu import telemetry
-
-            with telemetry.span("dispatch_launch", batch=str(len(batch))):
+            with telemetry.span("dispatch_launch"), telemetry.trace_annotation(
+                "dispatch_launch"
+            ):
                 res = runner(payloads)
         except Exception as e:
             # transient device-side failures happen on tunneled/remote
@@ -176,8 +193,7 @@ class DispatchQueue:
             if not _transient(e):
                 self._fail(batch, e)
                 return None
-            with self._lock:
-                self.retries += 1
+            self._count_retry(e)
             try:
                 _time.sleep(0.2)
                 self._distribute(batch, run_sync())
@@ -198,16 +214,15 @@ class DispatchQueue:
         def collect() -> None:
             t1 = _time.perf_counter()
             try:
-                from surrealdb_tpu import telemetry
-
-                with telemetry.span("dispatch_collect"):
+                with telemetry.span("dispatch_collect"), telemetry.trace_annotation(
+                    "dispatch_collect"
+                ):
                     results = res()
             except Exception as e:
                 if not _transient(e):
                     self._fail(batch, e)
                     return
-                with self._lock:
-                    self.retries += 1
+                self._count_retry(e)
                 try:
                     _time.sleep(0.2)
                     self._distribute(batch, run_sync())
@@ -225,6 +240,13 @@ class DispatchQueue:
 
         return collect
 
+    def _count_retry(self, e: BaseException) -> None:
+        from surrealdb_tpu import telemetry
+
+        with self._lock:
+            self.retries += 1
+        telemetry.inc("dispatch_retries", cause=_retry_cause(e))
+
     def _distribute(self, batch: List[_Req], results: Sequence[Any]) -> None:
         if len(results) != len(batch):
             self._fail(
@@ -240,8 +262,12 @@ class DispatchQueue:
             r.done = True
             r.event.set()
 
-    @staticmethod
-    def _fail(batch: List[_Req], e: BaseException) -> None:
+    def _fail(self, batch: List[_Req], e: BaseException) -> None:
+        from surrealdb_tpu import telemetry
+
+        with self._lock:
+            self.failures += 1
+        telemetry.inc("dispatch_failures", error=telemetry.error_class(e))
         for r in batch:
             r.error = e
             r.done = True
@@ -254,6 +280,7 @@ class DispatchQueue:
                 "dispatches": self.dispatches,
                 "batched": self.batched,
                 "retries": self.retries,
+                "failures": self.failures,
                 "launch_s": round(self.launch_s, 4),
                 "collect_s": round(self.collect_s, 4),
             }

@@ -182,29 +182,50 @@ class Executor:
             except SurrealError as e:
                 return {"status": "ERR", "result": str(e)}
 
+        from surrealdb_tpu import telemetry
+
+        t0 = time.perf_counter()
+        dstats0 = self.ds.dispatch.stats()
+        telemetry.drain_plan_notes()  # clear notes left by a prior statement
+        resp = self._execute_statement(ctx, stm)
+        dt = time.perf_counter() - t0
+        if resp.get("status") == "ERR":
+            telemetry.inc("statement_errors", kind=type(stm).__name__)
+        if dt >= cnf.SLOW_QUERY_THRESHOLD_SECS:
+            # structured slow-query record (reference: query duration
+            # warnings in telemetry/metrics) — ring-buffered with the plan
+            # decisions plus the dispatch-queue delta over this statement's
+            # window (process-global: concurrent statements' dispatches are
+            # included), drained via telemetry.snapshot() or GET /slow
+            kind = type(stm).__name__
+            telemetry.inc("slow_queries", kind=kind)
+            d1 = self.ds.dispatch.stats()
+            telemetry.record_slow_query(
+                {
+                    "ts": time.time(),
+                    "sql": repr(stm)[:500],
+                    "kind": kind,
+                    "duration_s": round(dt, 6),
+                    "plan": telemetry.drain_plan_notes(),
+                    "dispatch": {k: round(d1[k] - dstats0[k], 4) for k in d1},
+                    "error": str(resp["result"])[:500]
+                    if resp.get("status") == "ERR"
+                    else None,
+                }
+            )
+        return resp
+
+    def _execute_statement(self, ctx: Context, stm) -> dict:
+        from surrealdb_tpu import telemetry
+
         writeable = stm.writeable()
         own_txn = not self.explicit
         if own_txn:
             self._open(writeable)
         try:
-            from surrealdb_tpu import telemetry
-
             try:
-                import time as _time
-
-                _t0 = _time.perf_counter()
                 with telemetry.span("statement", kind=type(stm).__name__):
                     result = stm.compute(ctx)
-                _dt = _time.perf_counter() - _t0
-                if _dt >= cnf.SLOW_QUERY_THRESHOLD_SECS:
-                    # slow-query reporting (reference: query duration
-                    # warnings in telemetry/metrics) — counted and logged
-                    telemetry.inc("slow_queries", kind=type(stm).__name__)
-                    import logging
-
-                    logging.getLogger("surrealdb_tpu.slow_query").warning(
-                        "slow statement (%.3fs): %.200r", _dt, stm
-                    )
             except ReturnError as r:
                 result = r.value
             if own_txn:

@@ -512,15 +512,26 @@ class Iterator:
     def _process_index(self, it: IIndex) -> None:
         """Index-plan iteration: batches of (rid, doc, ir) from the planner's
         ThingIterator equivalents (reference processor.rs:703-737)."""
-        for rid, docv, ir in it.plan.iterate(self.ctx):
-            if docv is None:
-                ns, db = self.ctx.ns_db()
-                docv = self.ctx.txn().get_record(ns, db, rid.tb, rid.id)
+        from surrealdb_tpu import telemetry
+
+        n = 0
+        try:
+            for rid, docv, ir in it.plan.iterate(self.ctx):
+                n += 1
                 if docv is None:
-                    continue
-            self._process_record(rid, docv, ir=ir)
-            if self._full():
-                return
+                    ns, db = self.ctx.ns_db()
+                    docv = self.ctx.txn().get_record(ns, db, rid.tb, rid.id)
+                    if docv is None:
+                        continue
+                self._process_record(rid, docv, ir=ir)
+                if self._full():
+                    return
+        finally:
+            # candidates the chosen plan actually surfaced — the scan-width
+            # signal for "why was this statement slow"
+            telemetry.observe_hist(
+                "plan_candidates", n, buckets=telemetry.COUNT_BUCKETS
+            )
 
     # -------------------------------------------------------------- ml batching
     def _batched_projection(self, rows: List[Any]) -> List[Any]:

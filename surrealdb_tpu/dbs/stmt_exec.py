@@ -96,6 +96,9 @@ def select_compute(ctx, stm) -> Any:
         except OrderPushdownBailout:
             # the ordered scan met an array-valued row: key order would be
             # wrong, so re-run on the plain scan + post-sort path
+            from surrealdb_tpu import telemetry
+
+            telemetry.inc("plan_fallbacks", cause="order_pushdown_bailout")
             it = Iterator(c, stm, "select")
             for s in sources:
                 it.ingest(ITable(s.tb) if isinstance(s, IIndex) else s)

@@ -295,6 +295,11 @@ class IvfState:
             cand = np.fromiter(
                 (s for l in cand_lists for s in l), dtype=np.int64, count=total
             )
+            from surrealdb_tpu import telemetry
+
+            telemetry.observe_hist(
+                "ivf_candidates", total, buckets=telemetry.COUNT_BUCKETS, path="host"
+            )
             x = data[cand]
             if metric == "cosine":
                 xn = np.maximum(np.sqrt((x**2).sum(1)), 1e-30)
@@ -349,6 +354,15 @@ class IvfState:
         # XLA compile; {1, 8, tile} bounds compiles AND padding waste
         nq = qs.shape[0]
         tile = dispatch_tile(nq, tile)
+        from surrealdb_tpu import telemetry
+
+        # per-query probed-candidate ceiling (the kernel scans whole lists)
+        telemetry.observe_hist(
+            "ivf_candidates",
+            nprobe * int(list_rows.shape[1]),
+            buckets=telemetry.COUNT_BUCKETS,
+            path="device",
+        )
         pending = []
         for lo, hi in tile_slices(nq, tile):
             d, r = _ivf_search(

@@ -705,6 +705,7 @@ class KnnPlan(_KnnExecutorMixin):
                     q[None, :], data, metric, k, x_sq_norms=norms
                 )
                 dists, slots = dists[0], np.asarray(li)[0]
+        self._count_strategy(n)
         for d, s in zip(np.asarray(dists), np.asarray(slots)):
             if not np.isfinite(d) or s < 0 or s >= len(rids):
                 continue
@@ -714,9 +715,24 @@ class KnnPlan(_KnnExecutorMixin):
             self.result.add(rid, float(d))
             yield rid, None, {"dist": float(d)}
 
+    def _count_strategy(self, n: int) -> None:
+        """Record which serving path answered this kNN query: the strategy
+        counter attributes recall/latency anomalies per path, and the
+        fallback counter isolates queries that LOST their sublinear path
+        (quantizer still training → exact serve)."""
+        from surrealdb_tpu import telemetry
+
+        telemetry.inc("knn_strategy", strategy=self.strategy)
+        if "(ivf-training)" in self.strategy:
+            telemetry.inc("knn_fallbacks", cause="ivf_training")
+        telemetry.note_plan(
+            {"knn": self.strategy, "index": self.ix["name"], "k": self.k, "n": n}
+        )
+
     def _exact_overlay(self, mirror, overlay, metric):
         """Merge uncommitted rows over the mirror and search exactly."""
         self.strategy = "exact-overlay"
+        self._count_strategy(mirror.count())
         data, alive, rids = mirror.host_view()
         rows, out_rids = [], []
         for i in np.nonzero(alive)[0].tolist():
@@ -789,6 +805,10 @@ class BruteForceKnnPlan(_KnnExecutorMixin):
             docs[(rid.tb, repr(rid.id))] = doc
         if not rows:
             return
+        from surrealdb_tpu import telemetry
+
+        telemetry.inc("knn_strategy", strategy="brute-force")
+        telemetry.note_plan({"knn": "brute-force", "table": self.tb, "n": len(rows)})
         k = min(self.k, len(rids))
         q = np.asarray([self.target], dtype=np.float32)
         if cnf.TPU_DISABLE or len(rids) < cnf.TPU_KNN_ONDEVICE_THRESHOLD:

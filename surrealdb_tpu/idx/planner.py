@@ -265,6 +265,8 @@ def plan_sources(ctx, stm, sources: List[Any]) -> List[Any]:
     if with_ is not None and with_.noindex:
         return sources
 
+    from surrealdb_tpu import telemetry
+
     out: List[Any] = []
     for s in sources:
         if not isinstance(s, ITable):
@@ -272,8 +274,12 @@ def plan_sources(ctx, stm, sources: List[Any]) -> List[Any]:
             continue
         plan = build_plan(ctx, stm, s.tb, with_)
         if plan is None:
+            telemetry.inc("plan_strategy", strategy="TableScan")
             out.append(s)
         else:
+            strategy = type(plan).__name__
+            telemetry.inc("plan_strategy", strategy=strategy)
+            telemetry.note_plan({"table": s.tb, "plan": strategy})
             out.append(IIndex(s.tb, plan))
     return out
 

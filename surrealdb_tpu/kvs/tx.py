@@ -45,6 +45,40 @@ class Transaction:
         self.write = backend.write
 
     # ------------------------------------------------------------ lifecycle
+    def __del__(self):
+        """Leak detector (reference: core/src/kvs/mem/mod.rs:29-56 — the
+        mem backend asserts a transaction is completed before drop). A
+        transaction garbage-collected unfinished is an engine bug: its
+        buffered writes silently vanish and its MVCC snapshot pins the
+        version-chain GC horizon. Count it, release the snapshot, warn —
+        and raise under pytest, which surfaces as a loud unraisable-
+        exception traceback + PytestUnraisableExceptionWarning (a raise in
+        __del__ cannot fail the test itself, and GC timing may attribute
+        it to a later test than the leaker)."""
+        try:
+            tr = self.tr
+            if tr.done:
+                return
+            leaked_write = bool(self.write)
+            tr.cancel()  # always release the snapshot refcount
+            if not leaked_write:
+                return
+            import os
+            import warnings
+
+            from surrealdb_tpu import telemetry
+
+            telemetry.inc("unfinished_txns")
+            msg = (
+                "write transaction garbage-collected with uncommitted writes "
+                "(missing commit()/cancel())"
+            )
+            if os.environ.get("PYTEST_CURRENT_TEST"):
+                raise RuntimeError(msg)
+            warnings.warn(msg, ResourceWarning, stacklevel=2)
+        except (AttributeError, ImportError, TypeError):
+            pass  # interpreter shutdown: modules may already be torn down
+
     def commit(self) -> None:
         self.complete_changes()
         # backend commit + mirror-delta application must be one atomic unit

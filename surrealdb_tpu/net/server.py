@@ -51,7 +51,15 @@ def _capped(fn):
         except BodyTooLarge:
             return self._send(413, {"error": "request body too large"})
         finally:
-            telemetry.observe("http_request_duration", _time.perf_counter() - t0)
+            from surrealdb_tpu.dbs.capabilities import HTTP_ROUTES
+
+            seg = urlparse(self.path).path.split("/")[1] or "root"
+            telemetry.observe(
+                "http_request_duration",
+                _time.perf_counter() - t0,
+                method=self.command or "?",
+                route=seg if seg in HTTP_ROUTES or seg == "root" else "_other",
+            )
 
     return inner
 
@@ -229,13 +237,39 @@ class SurrealHandler(BaseHTTPRequestHandler):
         path = urlparse(self.path).path
         from surrealdb_tpu import telemetry
 
-        telemetry.inc("http_requests", method="GET", route=path.split("/")[1] or "root")
+        seg = path.split("/")[1] or "root"
+        # bounded label: arbitrary client paths must not mint unbounded series
+        from surrealdb_tpu.dbs.capabilities import HTTP_ROUTES
+
+        telemetry.inc(
+            "http_requests",
+            method="GET",
+            route=seg if seg in HTTP_ROUTES or seg == "root" else "_other",
+        )
         if path == "/metrics":
+            if not self._route_allowed("metrics"):
+                return
             from surrealdb_tpu import telemetry
 
             return self._send(
                 200, telemetry.render_prometheus().encode(), "text/plain"
             )
+        if path == "/slow":
+            # structured slow-query log (ring buffer; dbs/executor.py) — the
+            # /metrics-adjacent debug endpoint. Entries carry raw statement
+            # text which may embed data literals, so like /export it needs a
+            # system user, not just the route capability
+            if not self._route_allowed("slow"):
+                return
+            try:
+                sess = self._authorized_session()
+                if self.auth_enabled and sess.auth.level not in ("db", "ns", "root"):
+                    raise InvalidAuthError()
+            except SurrealError as e:
+                return self._send(401, {"error": str(e)})
+            from surrealdb_tpu import telemetry
+
+            return self._send(200, telemetry.slow_queries())
         if path == "/health":
             if not self._route_allowed("health"):
                 return
@@ -650,9 +684,10 @@ class SurrealHandler(BaseHTTPRequestHandler):
         # into shared kernel launches (dbs/dispatch.py); session-mutating
         # methods drain in-flight work first and run inline so `use`/
         # `signin` can't race a concurrently-executing query.
-        from surrealdb_tpu import cnf
+        from surrealdb_tpu import cnf, telemetry
         from surrealdb_tpu.net.ws import DaemonPool
 
+        telemetry.gauge_add("ws_connections", 1)
         pool = DaemonPool(max(cnf.WEBSOCKET_MAX_CONCURRENT_REQUESTS, 1))
         inflight: list = []
         _SESSION_METHODS = {
@@ -724,6 +759,10 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 if not isinstance(req, dict):
                     continue
                 inflight = [ev for ev in inflight if not ev.is_set()]
+                # width of the per-socket concurrent-request window — how
+                # many requests ride this socket's pool simultaneously (the
+                # population that can coalesce into shared kernel launches)
+                telemetry.observe_hist("ws_inflight_width", len(inflight) + 1)
                 if str(req.get("method", "")).lower() in _SESSION_METHODS:
                     for ev in inflight:
                         ev.wait()
@@ -736,6 +775,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
         finally:
             alive["v"] = False
             pool.shutdown()
+            telemetry.gauge_add("ws_connections", -1)
         self.close_connection = True
 
 

@@ -159,23 +159,33 @@ class DaemonPool:
             t.start()
 
     def _worker(self) -> None:
+        import time as _time
+
+        from surrealdb_tpu import telemetry
+
         while True:
             item = self._q.get()
             if item is None:
                 return
-            fn, args, done = item
+            fn, args, done, t_submit = item
+            telemetry.observe("ws_pool_queue_wait", _time.perf_counter() - t_submit)
             try:
                 fn(*args)
             except Exception:  # noqa: BLE001 — tasks report their own errors
                 pass
             finally:
                 done.set()
+                telemetry.gauge_add("ws_inflight", -1)
 
     def submit(self, fn, *args):
         import threading as _threading
+        import time as _time
 
+        from surrealdb_tpu import telemetry
+
+        telemetry.gauge_add("ws_inflight", 1)
         done = _threading.Event()
-        self._q.put((fn, args, done))
+        self._q.put((fn, args, done, _time.perf_counter()))
         return done
 
     def shutdown(self) -> None:

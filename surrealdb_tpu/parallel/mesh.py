@@ -21,6 +21,7 @@ slice (deployment).
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Optional, Tuple
 
 import jax
@@ -29,6 +30,26 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from surrealdb_tpu.ops.distances import pairwise_distance
+
+# jax moved shard_map out of experimental (>=0.6) and renamed its replication
+# check check_rep -> check_vma; support both so the mesh path runs on the
+# image's jax as well as current releases
+if hasattr(jax, "shard_map"):
+    _shard_map_impl = jax.shard_map
+else:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in inspect.signature(_shard_map_impl).parameters
+    else "check_rep"
+)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **{_CHECK_KW: check_vma}
+    )
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
@@ -66,7 +87,7 @@ def sharded_knn(
     shard_rows = n_total // n_dev
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(axis, None), P(axis), P(None, None)),
         out_specs=(P(None, None), P(None, None)),
@@ -121,7 +142,7 @@ def sharded_knn_2d(
     shard_rows = n_total // n_dev
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(data_axis, feat_axis), P(data_axis), P(None, feat_axis)),
         out_specs=(P(None, None), P(None, None)),
@@ -154,7 +175,7 @@ def _ivf_searcher(mesh, k, nprobe, kk, k_out, metric, probe_metric, axis):
     dispatches reuse one compiled executable instead of re-tracing."""
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(
             P(None, None),        # centroids, replicated
@@ -248,7 +269,7 @@ def sharded_frontier_hop(
     """
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(None), P(None), P(axis), P(axis)),
         out_specs=(P(axis), P(axis)),

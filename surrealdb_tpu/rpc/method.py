@@ -55,16 +55,25 @@ class RpcContext:
 
     # ------------------------------------------------------------ dispatch
     def execute(self, method: str, params: Optional[List[Any]] = None) -> Any:
+        from surrealdb_tpu import telemetry
+
         params = params or []
         m = method.lower()
         if m not in METHODS:
+            # bounded label: arbitrary client-supplied names must not mint
+            # unbounded metric series
+            telemetry.inc("rpc_errors", method="_unknown", error="MethodNotFound")
             raise SurrealError(f"Method '{method}' not found")
-        from surrealdb_tpu import telemetry
 
         # one seam covers BOTH the HTTP /rpc route and the WS actor
         # (reference: src/telemetry/metrics/ws/ rpc method instrumentation)
-        with telemetry.span("rpc_method", method=m):
-            return getattr(self, f"_m_{m}")(params)
+        telemetry.inc("rpc_requests", method=m)
+        try:
+            with telemetry.span("rpc_method", method=m):
+                return getattr(self, f"_m_{m}")(params)
+        except Exception as e:
+            telemetry.inc("rpc_errors", method=m, error=telemetry.error_class(e))
+            raise
 
     # ------------------------------------------------------------ helpers
     def _query(self, text: str, vars: Optional[Dict[str, Any]] = None) -> List[dict]:

@@ -59,12 +59,19 @@ _CAST_KINDS = {
 }
 
 
+# deep enough for any real query (compute bounds expressions at 120 anyway,
+# cnf MAX_COMPUTATION_DEPTH); shallow enough that ~6 Python frames per level
+# stay far from the C-stack limit the 20k recursionlimit cannot see
+_MAX_PARSE_DEPTH = 500
+
+
 class Parser:
     def __init__(self, text: str):
         self.text = text
         self.toks = lex(text)
         self.i = 0
         self._no_graph = 0  # >0: don't consume ->/<- as idiom parts (RELATE)
+        self._depth = 0  # expression nesting, bounded by _MAX_PARSE_DEPTH
 
     # ------------------------------------------------------------- helpers
     def peek(self, off: int = 0) -> Token:
@@ -1607,6 +1614,19 @@ class Parser:
 
     # ------------------------------------------------------------- exprs
     def parse_expr(self, min_bp: int = 0) -> A.Expr:
+        # explicit nesting bound: each level spans several Python frames, so
+        # pathological inputs (fuzzed `((((...`) exhaust the C stack — a hard
+        # crash — long before sys.setrecursionlimit raises RecursionError
+        self._depth += 1
+        if self._depth > _MAX_PARSE_DEPTH:
+            self._depth -= 1
+            raise self.error("query is too deeply nested")
+        try:
+            return self._parse_expr_bp(min_bp)
+        finally:
+            self._depth -= 1
+
+    def _parse_expr_bp(self, min_bp: int) -> A.Expr:
         lhs = self._parse_prefix()
         while True:
             t = self.peek()

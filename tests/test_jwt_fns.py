@@ -9,6 +9,17 @@ import pytest
 from surrealdb_tpu.dbs.session import Session
 from surrealdb_tpu.iam.token import clear_jwks_cache, verify_token
 
+try:
+    import cryptography  # noqa: F401 — only used to mint test key pairs
+
+    _HAS_CRYPTO = True
+except ImportError:
+    _HAS_CRYPTO = False
+
+requires_crypto = pytest.mark.skipif(
+    not _HAS_CRYPTO, reason="cryptography not installed: cannot generate test keys"
+)
+
 
 def _b64url(b: bytes) -> str:
     return base64.urlsafe_b64encode(b).decode().rstrip("=")
@@ -60,6 +71,7 @@ def _ec_pair(curve=None):
     return priv, pem
 
 
+@requires_crypto
 @pytest.mark.parametrize("alg", ["RS256", "RS512", "PS256"])
 def test_rsa_token_verification(alg):
     priv, pem = _rsa_pair()
@@ -73,12 +85,14 @@ def test_rsa_token_verification(alg):
         verify_token(bad, pem)
 
 
+@requires_crypto
 def test_es256_token_verification():
     priv, pem = _ec_pair()
     tok = _sign("ES256", priv, {"alg": "ES256", "typ": "JWT"}, {"sub": "e"})
     assert verify_token(tok, pem)["sub"] == "e"
 
 
+@requires_crypto
 def test_access_with_rs256_key_authenticates(ds):
     from surrealdb_tpu.iam.token import authenticate
 
@@ -96,6 +110,7 @@ def test_access_with_rs256_key_authenticates(ds):
     assert sess.auth.access == "jj"
 
 
+@requires_crypto
 def test_jwks_fetch_with_cache(ds):
     import http.server
     import threading
