@@ -1,29 +1,61 @@
-"""Telemetry: timed spans + prometheus-style metrics.
+"""Telemetry: labeled metrics, histograms, spans, slow-query log, profiler.
 
 Role of the reference's telemetry stack (reference: src/telemetry/mod.rs:
 43-99 — OTEL traces + HTTP/WS request metrics, RPC spans). This
 environment has no OTLP collector, so the equivalent surface is:
 
-- a process-global metrics registry (counters + duration histograms)
-  rendered in prometheus text format at GET /metrics;
+- a process-global metrics registry: labeled counters, labeled gauges,
+  and labeled histograms with fixed log-scale buckets, rendered as valid
+  Prometheus text exposition (`_bucket`/`_sum`/`_count`) at GET /metrics;
+- duration histograms fed by `span()`/`observe()` around statement
+  execution, device dispatches, RPC methods and HTTP requests;
+- a structured slow-query ring buffer (sql, duration, plan summary,
+  dispatch stats, error) drained via `snapshot()` or GET /slow;
 - span recording around statement execution and device dispatches,
   enabled by `--profile` / SURREAL_PROFILE=1 (spans cost nothing when
-  disabled), drained via `snapshot()` or INFO-style inspection.
+  disabled), drained via `snapshot()` or INFO-style inspection;
+- `jax.profiler` hooks: `start_trace()/stop_trace()` capture a device
+  trace directory next to bench artifacts, and `trace_annotation()`
+  labels dispatch launch/collect phases inside it. Both degrade to
+  no-ops when the profiler is unavailable.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from bisect import bisect_left
 from collections import deque
-from contextlib import contextmanager
-from typing import Deque, Dict, List, Optional, Tuple
+from contextlib import contextmanager, nullcontext
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 _lock = threading.Lock()
 _enabled = False
 _spans: Deque[Tuple[str, float, float]] = deque(maxlen=4096)  # (name, start, dur_s)
-_counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
-_durations: Dict[str, List[float]] = {}  # name -> [count, total_s, max_s]
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+_counters: Dict[Tuple[str, _LabelKey], float] = {}
+_gauges: Dict[Tuple[str, _LabelKey], float] = {}
+# family -> (buckets, {labels: [counts per bucket + overflow, sum, count, max]})
+_hists: Dict[str, Tuple[Tuple[float, ...], Dict[_LabelKey, list]]] = {}
+# summary view kept alongside the histograms (cheap INFO-style inspection)
+_durations: Dict[str, List[float]] = {}  # labeled name -> [count, total_s, max_s]
+
+# fixed log-scale buckets — one shared shape per unit so every duration /
+# size / count metric is comparable and the exposition stays small
+DURATION_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+SIZE_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+COUNT_BUCKETS: Tuple[float, ...] = (
+    1, 4, 16, 64, 256, 1024, 4096, 16384, 65536, 262144, 1048576,
+)
+
+_SLOW_LOG_SIZE = 128
+_slow: Deque[dict] = deque(maxlen=_SLOW_LOG_SIZE)
+
+_tls = threading.local()  # per-thread plan notes for the slow-query log
 
 
 def enable(on: bool = True) -> None:
@@ -35,17 +67,80 @@ def enabled() -> bool:
     return _enabled
 
 
-def inc(name: str, by: float = 1.0, **labels: str) -> None:
-    key = (name, tuple(sorted(labels.items())))
+def _key(labels: Dict[str, Any]) -> _LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+# ------------------------------------------------------------------ counters
+def inc(name: str, by: float = 1.0, **labels) -> None:
+    key = (name, _key(labels))
     with _lock:
         _counters[key] = _counters.get(key, 0.0) + by
 
 
-def observe(name: str, seconds: float) -> None:
+def get_counter(name: str, **labels) -> float:
     with _lock:
-        d = _durations.get(name)
+        return _counters.get((name, _key(labels)), 0.0)
+
+
+def counters_matching(name: str) -> Dict[_LabelKey, float]:
+    """All label-series of one counter family: {labels_tuple: value}."""
+    with _lock:
+        return {labels: v for (n, labels), v in _counters.items() if n == name}
+
+
+def error_class(e: BaseException) -> str:
+    """Stable low-cardinality error label for counters."""
+    return type(e).__name__
+
+
+# ------------------------------------------------------------------ gauges
+def gauge_add(name: str, delta: float, **labels) -> None:
+    key = (name, _key(labels))
+    with _lock:
+        _gauges[key] = _gauges.get(key, 0.0) + delta
+
+
+def gauge_set(name: str, value: float, **labels) -> None:
+    with _lock:
+        _gauges[(name, _key(labels))] = float(value)
+
+
+# ------------------------------------------------------------------ histograms
+def _hist_observe(family: str, buckets: Tuple[float, ...], value: float, labels: Dict) -> None:
+    lk = _key(labels)
+    with _lock:
+        fam = _hists.get(family)
+        if fam is None:
+            fam = _hists[family] = (buckets, {})
+        # first registration wins: a call site passing different buckets for
+        # the same family is folded into the registered shape (bisect below
+        # uses fam[0]) — a bookkeeping mismatch must never abort the query
+        # path this instruments
+        _, series = fam
+        h = series.get(lk)
+        if h is None:
+            # per-bucket counts + overflow slot, then sum, count, max
+            h = series[lk] = [0] * (len(fam[0]) + 1) + [0.0, 0, value]
+        h[bisect_left(fam[0], value)] += 1
+        h[-3] += value
+        h[-2] += 1
+        h[-1] = max(h[-1], value)
+
+
+def observe_hist(name: str, value: float, buckets: Tuple[float, ...] = SIZE_BUCKETS, **labels) -> None:
+    """Generic labeled histogram (batch widths, candidate counts, ...)."""
+    _hist_observe(name, buckets, float(value), labels)
+
+
+def observe(name: str, seconds: float, **labels) -> None:
+    """Duration histogram `surreal_<name>_duration_seconds` + summary view."""
+    _hist_observe(f"{name}_duration_seconds", DURATION_BUCKETS, seconds, labels)
+    dname = name + (_fmt_labels(_key(labels)) if labels else "")
+    with _lock:
+        d = _durations.get(dname)
         if d is None:
-            _durations[name] = [1.0, seconds, seconds]
+            _durations[dname] = [1.0, seconds, seconds]
         else:
             d[0] += 1
             d[1] += seconds
@@ -54,31 +149,130 @@ def observe(name: str, seconds: float) -> None:
 
 @contextmanager
 def span(name: str, **labels: str):
-    """Timed span: always feeds the duration metrics; records the individual
-    span only while profiling is enabled (reference #[instrument] spans)."""
+    """Timed span: always feeds the duration histograms; records the
+    individual span only while profiling is enabled (reference
+    #[instrument] spans)."""
     t0 = time.perf_counter()
     try:
         yield
     finally:
         dur = time.perf_counter() - t0
-        observe(name, dur)
+        observe(name, dur, **labels)
         if _enabled:
             with _lock:
                 _spans.append((name, t0, dur))
 
 
+# ------------------------------------------------------------------ slow queries
+def record_slow_query(entry: dict) -> None:
+    """Append one structured slow-statement record to the ring buffer
+    (replaces the print-based warning; reference: query duration warnings
+    in telemetry/metrics)."""
+    with _lock:
+        _slow.append(entry)
+
+
+def slow_queries() -> List[dict]:
+    with _lock:
+        return list(_slow)
+
+
+# ------------------------------------------------------------------ plan notes
+def note_plan(note: dict) -> None:
+    """Record a plan decision for the CURRENT thread's statement; the
+    executor drains these into the slow-query record so a slow statement's
+    entry says which index/strategy actually served it."""
+    lst = getattr(_tls, "plan_notes", None)
+    if lst is None:
+        lst = _tls.plan_notes = []
+    lst.append(note)
+    del lst[:-8]  # bound per-statement accumulation
+
+
+def drain_plan_notes() -> List[dict]:
+    lst = getattr(_tls, "plan_notes", None)
+    if not lst:
+        return []
+    out = list(lst)
+    del lst[:]
+    return out
+
+
+# ------------------------------------------------------------------ profiler
+_trace_dir: Optional[str] = None
+
+
+def start_trace(outdir: str) -> bool:
+    """Begin a `jax.profiler` trace capture into `outdir`; returns False
+    (no-op) when the profiler is unavailable (verdict item #10)."""
+    global _trace_dir
+    if _trace_dir is not None:
+        return True
+    try:
+        import jax
+
+        jax.profiler.start_trace(outdir)
+    except Exception:
+        return False
+    _trace_dir = outdir
+    return True
+
+
+def stop_trace() -> Optional[str]:
+    """Finish the in-flight trace capture; returns its directory or None."""
+    global _trace_dir
+    if _trace_dir is None:
+        return None
+    out, _trace_dir = _trace_dir, None
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception:
+        return None
+    return out
+
+
+def trace_annotation(name: str):
+    """Label a dispatch phase inside the device trace. Free when neither
+    --profile nor a trace capture is active."""
+    if not _enabled and _trace_dir is None:
+        return nullcontext()
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return nullcontext()
+
+
+# ------------------------------------------------------------------ snapshot / reset
 def snapshot() -> dict:
-    """Current metrics + (when profiling) recent spans."""
+    """Current metrics + slow queries + (when profiling) recent spans."""
     with _lock:
         return {
             "counters": {
-                name + (str(dict(labels)) if labels else ""): v
+                name + (_fmt_labels(labels) if labels else ""): v
                 for (name, labels), v in _counters.items()
+            },
+            "gauges": {
+                name + (_fmt_labels(labels) if labels else ""): v
+                for (name, labels), v in _gauges.items()
             },
             "durations": {
                 name: {"count": int(d[0]), "total_s": round(d[1], 6), "max_s": round(d[2], 6)}
                 for name, d in _durations.items()
             },
+            "histograms": {
+                fam + (_fmt_labels(labels) if labels else ""): {
+                    "count": h[-2],
+                    "sum": round(h[-3], 6),
+                    "max": round(h[-1], 6),
+                }
+                for fam, (_, series) in _hists.items()
+                for labels, h in series.items()
+            },
+            "slow_queries": list(_slow),
             "spans": [
                 {"name": n, "start": s, "dur_ms": round(dur * 1e3, 3)}
                 for n, s, dur in list(_spans)
@@ -91,25 +285,72 @@ def snapshot() -> dict:
 def reset() -> None:
     with _lock:
         _counters.clear()
+        _gauges.clear()
+        _hists.clear()
         _durations.clear()
         _spans.clear()
+        _slow.clear()
+
+
+# ------------------------------------------------------------------ exposition
+def _esc(v: str) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, newline."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(labels: _LabelKey, extra: Optional[Tuple[str, str]] = None) -> str:
+    parts = [f'{k}="{_esc(v)}"' for k, v in labels]
+    if extra is not None:
+        parts.append(f'{extra[0]}="{_esc(extra[1])}"')
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _num(v: float) -> str:
+    return repr(v) if isinstance(v, float) and not v.is_integer() else str(int(v))
+
+
+def _bucket_label(b: float) -> str:
+    return repr(b) if isinstance(b, float) and not float(b).is_integer() else str(int(b))
 
 
 def render_prometheus() -> str:
-    """Prometheus text exposition of counters + duration summaries
-    (reference telemetry/metrics/http/, ws/)."""
+    """Valid Prometheus text exposition of counters, gauges and histograms
+    (reference telemetry/metrics/http/, ws/). Label values are escaped;
+    histograms render cumulative `_bucket{le=...}` + `_sum` + `_count`."""
     lines: List[str] = []
     with _lock:
-        for (name, labels), v in sorted(_counters.items()):
-            lab = (
-                "{" + ",".join(f'{k}="{val}"' for k, val in labels) + "}"
-                if labels
-                else ""
-            )
-            lines.append(f"surreal_{name}_total{lab} {v:g}")
-        for name, d in sorted(_durations.items()):
-            base = f"surreal_{name}_duration_seconds"
-            lines.append(f"{base}_count {int(d[0])}")
-            lines.append(f"{base}_sum {d[1]:.6f}")
-            lines.append(f"{base}_max {d[2]:.6f}")
+        by_counter: Dict[str, List[Tuple[_LabelKey, float]]] = {}
+        for (name, labels), v in _counters.items():
+            by_counter.setdefault(name, []).append((labels, v))
+        for name in sorted(by_counter):
+            fam = f"surreal_{name}_total"
+            lines.append(f"# TYPE {fam} counter")
+            for labels, v in sorted(by_counter[name]):
+                lines.append(f"{fam}{_fmt_labels(labels)} {_num(v)}")
+
+        by_gauge: Dict[str, List[Tuple[_LabelKey, float]]] = {}
+        for (name, labels), v in _gauges.items():
+            by_gauge.setdefault(name, []).append((labels, v))
+        for name in sorted(by_gauge):
+            fam = f"surreal_{name}"
+            lines.append(f"# TYPE {fam} gauge")
+            for labels, v in sorted(by_gauge[name]):
+                lines.append(f"{fam}{_fmt_labels(labels)} {_num(v)}")
+
+        for family in sorted(_hists):
+            buckets, series = _hists[family]
+            fam = f"surreal_{family}"
+            lines.append(f"# TYPE {fam} histogram")
+            for labels in sorted(series):
+                h = series[labels]
+                cum = 0
+                for i, b in enumerate(buckets):
+                    cum += h[i]
+                    lines.append(
+                        f"{fam}_bucket{_fmt_labels(labels, ('le', _bucket_label(b)))} {cum}"
+                    )
+                cum += h[len(buckets)]
+                lines.append(f"{fam}_bucket{_fmt_labels(labels, ('le', '+Inf'))} {cum}")
+                lines.append(f"{fam}_sum{_fmt_labels(labels)} {h[-3]:.6f}")
+                lines.append(f"{fam}_count{_fmt_labels(labels)} {h[-2]}")
     return "\n".join(lines) + "\n"
