@@ -31,10 +31,13 @@ the full block is re-printed at the end (so a truncated stdout tail still
 carries every config), and the whole run is written to
 `bench_results_<round>.json` next to this file. Each per-config line
 carries `config`, `errors`, `retries`, `strategy` and `batch` accounting
-pulled from the engine's telemetry counters, so an anomaly (e.g. the r5
-concurrent-kNN collapse) is attributable from the artifact alone. The
-artifact is schema-checked by scripts/check_bench_artifact.py, invoked
-automatically after the write.
+pulled from the engine's telemetry counters, PLUS (r6, the instrument for
+the r5 scale-1.0 kNN collapse) `error_breakdown` — per-class deltas across
+statement/dispatch/rpc error counters — and `slowest_trace`, the full
+request-scoped span tree (tracing.py) of the config's slowest query, so
+"where did the time go / what failed" is answerable from the artifact
+alone. The artifact is schema-checked by scripts/check_bench_artifact.py,
+invoked automatically after the write.
 
 Env knobs: SURREAL_BENCH_SCALE (default 1.0 — scales the 1M corpora),
 SURREAL_BENCH_CONFIGS (default "1,2,3,4,5"), SURREAL_BENCH_OUT (artifact
@@ -67,7 +70,7 @@ OUT_PATH = os.environ.get(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), f"bench_results_{ROUND}.json"),
 )
 PROFILE = "--profile" in sys.argv[1:] or os.environ.get("SURREAL_PROFILE") == "1"
-SCHEMA = "surrealdb-tpu-bench/1"
+SCHEMA = "surrealdb-tpu-bench/2"
 
 D = 768
 NI = max(int(1_000_000 * SCALE), 1024)  # item corpus (configs 2/4/5)
@@ -119,23 +122,72 @@ def _error_counts() -> dict:
     }
 
 
+def _error_classes() -> dict:
+    """Per-class error/retry totals across every error-counter family —
+    `{family:class: count}` (the r5 action item: an anomalous config must
+    say WHICH errors it took, not just how many)."""
+    from surrealdb_tpu import telemetry
+
+    out: dict = {}
+    for fam, label in (
+        ("statement_errors", "kind"),
+        ("dispatch_failures", "error"),
+        ("dispatch_retries", "cause"),
+        ("rpc_errors", "error"),
+    ):
+        for labels, v in telemetry.counters_matching(fam).items():
+            key = f"{fam}:{dict(labels).get(label, '?')}"
+            out[key] = out.get(key, 0) + int(v)
+    return out
+
+
 def _acct_begin(ds) -> dict:
+    from surrealdb_tpu import tracing
+
+    # fresh store per accounting window: slowest_trace selection and the
+    # truncation flag are then per-window facts, and the store can never
+    # fill mid-window from prior configs' traces (bench owns the process)
+    tracing.store_reset()
     return {
         "stats": ds.dispatch.stats(),
         "errors": _error_counts(),
         "strategy": _strategy_counts(),
+        "classes": _error_classes(),
+        "trace_ids": set(tracing.trace_ids()),
     }
 
 
 def _acct_delta(ds, before: dict) -> dict:
     """Per-config accounting delta pulled from the telemetry counters — the
     fields that make a bench line attributable after the fact."""
+    from surrealdb_tpu import tracing
+
     st0, st1 = before["stats"], ds.dispatch.stats()
     e0, e1 = before["errors"], _error_counts()
     s0, s1 = before["strategy"], _strategy_counts()
+    c0, c1 = before["classes"], _error_classes()
     dd = {k: st1[k] - st0[k] for k in st1}
+    # the full span tree of this config's slowest request (TRACE_SAMPLE is
+    # forced to 1.0 for the bench process, so every query's trace is
+    # available at window close)
+    new_traces = [
+        t
+        for tid in tracing.trace_ids()
+        if tid not in before["trace_ids"]
+        for t in (tracing.get_trace(tid),)
+        if t is not None
+    ]
+    slowest = max(new_traces, key=lambda t: t["duration_ms"], default=None)
+    from surrealdb_tpu import cnf as _cnf
+
+    # a full store at window close means FIFO eviction may have dropped
+    # the true slowest — flag it instead of attributing to a survivor
+    truncated = len(tracing.trace_ids()) >= _cnf.TRACE_STORE_SIZE
     return {
         "errors": {k: e1[k] - e0[k] for k in e1},
+        "error_breakdown": {
+            k: v - c0.get(k, 0) for k, v in c1.items() if v - c0.get(k, 0)
+        },
         "retries": int(dd["retries"]),
         "strategy": {k: v - s0.get(k, 0) for k, v in s1.items() if v - s0.get(k, 0)},
         "batch": {
@@ -148,6 +200,8 @@ def _acct_delta(ds, before: dict) -> dict:
             "launch_s": round(dd["launch_s"], 4),
             "collect_s": round(dd["collect_s"], 4),
         },
+        "slowest_trace": slowest,
+        "trace_window_truncated": truncated,
     }
 
 
@@ -716,6 +770,16 @@ def main() -> None:
     from surrealdb_tpu.kvs.ds import Datastore
     from surrealdb_tpu.dbs.session import Session
 
+    from surrealdb_tpu import cnf as _cnf
+
+    # every bench query's trace must be retrievable when its config's
+    # accounting window closes (the slowest_trace artifact field); the
+    # store bound still caps memory per window — if a window ever fills
+    # it anyway, _acct_delta flags the line as trace_window_truncated
+    # rather than silently reporting the slowest SURVIVOR as the slowest
+    _cnf.TRACE_SAMPLE = 1.0
+    _cnf.TRACE_STORE_SIZE = max(_cnf.TRACE_STORE_SIZE, 4096)
+
     trace_dir = os.path.join(os.path.dirname(OUT_PATH) or ".", f"bench_trace_{ROUND}")
     traces: list = []  # per-config capture dirs actually written
     if PROFILE:
@@ -788,9 +852,13 @@ def main() -> None:
             _DEFER = False
             acct = _acct_delta(ds, acct0)
             acct["ann_training_overlap"] = training_overlap or _ann_training_active()
-            for line in RESULTS[n0:]:
+            for i, line in enumerate(RESULTS[n0:]):
                 line["config"] = cfg
                 line.update(acct)
+                if i > 0:
+                    # the span tree is per-CONFIG evidence: carry it once,
+                    # not duplicated into every metric line of the window
+                    line["slowest_trace"] = None
                 print(json.dumps(line), flush=True)
             if PROFILE:
                 telemetry.stop_trace()

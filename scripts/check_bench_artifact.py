@@ -23,13 +23,20 @@ import os
 import sys
 from typing import List
 
-SCHEMA = "surrealdb-tpu-bench/1"
+SCHEMA = "surrealdb-tpu-bench/2"
+# earlier rounds' committed artifacts stay validatable under their own rules
+KNOWN_SCHEMAS = ("surrealdb-tpu-bench/1", SCHEMA)
 
 # keys every emitted line must carry (bench.py `emit`)
 RESULT_KEYS = ("metric", "value", "unit", "vs_baseline")
 # accounting keys every per-config line must carry (the driver-proof part)
 CONFIG_KEYS = ("config", "errors", "retries", "strategy", "batch")
+# schema/2 adds the per-class error breakdown and the slowest query's
+# request-scoped span tree (tracing.py)
+CONFIG_KEYS_V2 = CONFIG_KEYS + ("error_breakdown", "slowest_trace")
 BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
+# a present (non-null) slowest_trace must be a real trace doc
+TRACE_KEYS = ("trace_id", "duration_ms", "spans")
 
 
 def validate(path: str) -> List[str]:
@@ -42,8 +49,9 @@ def validate(path: str) -> List[str]:
 
     if not isinstance(art, dict):
         return [f"{path}: artifact must be a JSON object"]
-    if art.get("schema") != SCHEMA:
-        problems.append(f"schema is {art.get('schema')!r}, expected {SCHEMA!r}")
+    if art.get("schema") not in KNOWN_SCHEMAS:
+        problems.append(f"schema is {art.get('schema')!r}, expected one of {KNOWN_SCHEMAS}")
+    config_keys = CONFIG_KEYS_V2 if art.get("schema") == SCHEMA else CONFIG_KEYS
     for key in ("scale", "configs", "results"):
         if key not in art:
             problems.append(f"missing top-level key {key!r}")
@@ -70,7 +78,7 @@ def validate(path: str) -> List[str]:
             problems.append(f"{where} ({metric}): missing 'config'")
             continue
         seen_configs.add(str(r["config"]))
-        for key in CONFIG_KEYS:
+        for key in config_keys:
             if key not in r:
                 problems.append(f"{where} ({metric}): missing {key!r}")
         batch = r.get("batch")
@@ -80,6 +88,28 @@ def validate(path: str) -> List[str]:
                     problems.append(f"{where} ({metric}): batch missing {key!r}")
         elif "batch" in r:
             problems.append(f"{where} ({metric}): batch must be an object")
+        eb = r.get("error_breakdown")
+        if "error_breakdown" in r and not (
+            isinstance(eb, dict)
+            and all(isinstance(v, int) for v in eb.values())
+        ):
+            problems.append(
+                f"{where} ({metric}): error_breakdown must map class -> int count"
+            )
+        st = r.get("slowest_trace")
+        if "slowest_trace" in r and st is not None:
+            if not isinstance(st, dict):
+                problems.append(f"{where} ({metric}): slowest_trace must be an object or null")
+            else:
+                for key in TRACE_KEYS:
+                    if key not in st:
+                        problems.append(
+                            f"{where} ({metric}): slowest_trace missing {key!r}"
+                        )
+                if not isinstance(st.get("spans"), list) or not st.get("spans"):
+                    problems.append(
+                        f"{where} ({metric}): slowest_trace.spans must be a non-empty list"
+                    )
 
     want = {str(c) for c in art.get("configs") or []}
     missing = want - seen_configs

@@ -93,6 +93,14 @@ def _env_float(name: str, default: float) -> float:
 # statements slower than this are counted + logged (slow-query reporting)
 SLOW_QUERY_THRESHOLD_SECS = _env_float("SURREAL_SLOW_QUERY_THRESHOLD", 1.0)
 
+# Request-scoped tracing (tracing.py). Recording is on by default; the
+# bounded store retains every slow/errored/client-tagged trace and a
+# TRACE_SAMPLE fraction of the rest (tail-based sampling).
+TRACE_ENABLED = _env_bool("SURREAL_TRACE_ENABLED", True)
+TRACE_SAMPLE = _env_float("SURREAL_TRACE_SAMPLE", 0.02)
+TRACE_STORE_SIZE = _env_int("SURREAL_TRACE_STORE_SIZE", 512)
+TRACE_MAX_SPANS = _env_int("SURREAL_TRACE_MAX_SPANS", 512)
+
 # Websocket / server
 # largest accepted HTTP request body (model imports carry inline weights)
 HTTP_MAX_BODY_SIZE = _env_int("SURREAL_HTTP_MAX_BODY_SIZE", 64 * 1024 * 1024)

@@ -68,7 +68,10 @@ def _retry_cause(e: BaseException) -> str:
 
 
 class _Req:
-    __slots__ = ("payload", "runner", "event", "result", "error", "promoted", "done", "t_submit")
+    __slots__ = (
+        "payload", "runner", "event", "result", "error", "promoted", "done",
+        "t_submit", "trace_ctx",
+    )
 
     def __init__(self, payload, runner):
         self.payload = payload
@@ -79,6 +82,11 @@ class _Req:
         self.promoted = False  # woken to take over bucket leadership
         self.done = False
         self.t_submit = _time.perf_counter()  # queue-wait accounting
+        # the submitting request's trace position: whoever LEADS the batch
+        # re-parents the kernel spans onto every rider here (tracing.py)
+        from surrealdb_tpu import tracing
+
+        self.trace_ctx = tracing.current()
 
 
 class _Bucket:
@@ -160,11 +168,24 @@ class DispatchQueue:
         if collect is not None:
             collect()
 
+    def _trace_batch(
+        self, batch: List[_Req], name: str, start: float, dur: float,
+        error=None, **extra,
+    ) -> None:
+        """Stamp one kernel-phase span onto EVERY rider's trace, parented
+        at the span each request was in when it submitted — a query that
+        rode someone else's launch still shows its dispatch level."""
+        from surrealdb_tpu import tracing
+
+        labels = {"batch": len(batch), **extra}
+        for r in batch:
+            tracing.record_span_into(r.trace_ctx, name, labels, start, dur, error)
+
     def _launch(self, batch: List[_Req]) -> Optional[Callable[[], None]]:
         """Phase 1: run the leader's runner. Sync runners finish here;
         two-phase runners return the collect closure to run after the
         bucket hand-off."""
-        from surrealdb_tpu import telemetry
+        from surrealdb_tpu import telemetry, tracing
 
         with self._lock:
             self.dispatches += 1
@@ -181,32 +202,42 @@ class DispatchQueue:
         telemetry.observe_hist("dispatch_batch_size", len(batch))
         for r in batch:
             telemetry.observe("dispatch_queue_wait", t0 - r.t_submit)
+            tracing.record_span_into(
+                r.trace_ctx, "dispatch_queue_wait", {"batch": len(batch)},
+                r.t_submit, t0 - r.t_submit,
+            )
         try:
-            with telemetry.span("dispatch_launch"), telemetry.trace_annotation(
+            # detached: the leader thread's own trace must not swallow the
+            # kernel spans — they are stamped onto every rider below
+            with tracing.detached(), telemetry.span(
                 "dispatch_launch"
-            ):
+            ), telemetry.trace_annotation("dispatch_launch"):
                 res = runner(payloads)
         except Exception as e:
             # transient device-side failures happen on tunneled/remote
             # chips (e.g. the remote compile service returning 500 under
             # load) — retry the whole batch ONCE before failing every rider
             if not _transient(e):
-                self._fail(batch, e)
+                self._fail(batch, e, t0)
                 return None
-            self._count_retry(e)
+            self._count_retry(batch, e, t0)
             try:
                 _time.sleep(0.2)
-                self._distribute(batch, run_sync())
+                with tracing.detached():
+                    results = run_sync()
+                self._trace_batch(batch, "dispatch_retry", t0, _time.perf_counter() - t0)
+                self._distribute(batch, results)
             except BaseException as e2:
                 e2.__cause__ = e
-                self._fail(batch, e2)
+                self._fail(batch, e2, t0)
             return None
         except BaseException as e:  # propagate to every waiter
-            self._fail(batch, e)
+            self._fail(batch, e, t0)
             return None
         finally:
             with self._lock:
                 self.launch_s += _time.perf_counter() - t0
+        self._trace_batch(batch, "dispatch_launch", t0, _time.perf_counter() - t0)
         if not callable(res):
             self._distribute(batch, res)
             return None
@@ -214,38 +245,50 @@ class DispatchQueue:
         def collect() -> None:
             t1 = _time.perf_counter()
             try:
-                with telemetry.span("dispatch_collect"), telemetry.trace_annotation(
+                with tracing.detached(), telemetry.span(
                     "dispatch_collect"
-                ):
+                ), telemetry.trace_annotation("dispatch_collect"):
                     results = res()
             except Exception as e:
                 if not _transient(e):
-                    self._fail(batch, e)
+                    self._fail(batch, e, t1)
                     return
-                self._count_retry(e)
+                self._count_retry(batch, e, t1)
                 try:
                     _time.sleep(0.2)
-                    self._distribute(batch, run_sync())
+                    with tracing.detached():
+                        results = run_sync()
+                    self._trace_batch(
+                        batch, "dispatch_retry", t1, _time.perf_counter() - t1
+                    )
+                    self._distribute(batch, results)
                 except BaseException as e2:
                     e2.__cause__ = e
-                    self._fail(batch, e2)
+                    self._fail(batch, e2, t1)
                 return
             except BaseException as e:
-                self._fail(batch, e)
+                self._fail(batch, e, t1)
                 return
             finally:
                 with self._lock:
                     self.collect_s += _time.perf_counter() - t1
+            self._trace_batch(batch, "dispatch_collect", t1, _time.perf_counter() - t1)
             self._distribute(batch, results)
 
         return collect
 
-    def _count_retry(self, e: BaseException) -> None:
+    def _count_retry(self, batch: List[_Req], e: BaseException, start: float) -> None:
         from surrealdb_tpu import telemetry
 
         with self._lock:
             self.retries += 1
         telemetry.inc("dispatch_retries", cause=_retry_cause(e))
+        # the cause rides as a LABEL, not a span error: a retried-then-
+        # successful request is not errored and must not be pinned as such
+        self._trace_batch(
+            batch, "dispatch_transient", start, _time.perf_counter() - start,
+            cause=_retry_cause(e),
+        )
 
     def _distribute(self, batch: List[_Req], results: Sequence[Any]) -> None:
         if len(results) != len(batch):
@@ -262,12 +305,18 @@ class DispatchQueue:
             r.done = True
             r.event.set()
 
-    def _fail(self, batch: List[_Req], e: BaseException) -> None:
+    def _fail(self, batch: List[_Req], e: BaseException, start: Optional[float] = None) -> None:
         from surrealdb_tpu import telemetry
 
         with self._lock:
             self.failures += 1
         telemetry.inc("dispatch_failures", error=telemetry.error_class(e))
+        t = _time.perf_counter()
+        self._trace_batch(
+            batch, "dispatch_fail", start if start is not None else t,
+            t - start if start is not None else 0.0,
+            error=telemetry.error_class(e),
+        )
         for r in batch:
             r.error = e
             r.done = True

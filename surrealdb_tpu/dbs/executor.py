@@ -182,8 +182,9 @@ class Executor:
             except SurrealError as e:
                 return {"status": "ERR", "result": str(e)}
 
-        from surrealdb_tpu import telemetry
+        from surrealdb_tpu import telemetry, tracing
 
+        tracing.annotate(**self._session_info())
         t0 = time.perf_counter()
         dstats0 = self.ds.dispatch.stats()
         telemetry.drain_plan_notes()  # clear notes left by a prior statement
@@ -191,6 +192,18 @@ class Executor:
         dt = time.perf_counter() - t0
         if resp.get("status") == "ERR":
             telemetry.inc("statement_errors", kind=type(stm).__name__)
+            # joinable side of the counter: cite the request's trace (and
+            # pin it — the citation must stay resolvable via /trace/:id)
+            tracing.force_keep()
+            telemetry.record_error(
+                {
+                    "ts": time.time(),
+                    "kind": type(stm).__name__,
+                    "error": str(resp["result"])[:300],
+                    "trace_id": tracing.current_trace_id(),
+                    "session": self._session_info(),
+                }
+            )
         if dt >= cnf.SLOW_QUERY_THRESHOLD_SECS:
             # structured slow-query record (reference: query duration
             # warnings in telemetry/metrics) — ring-buffered with the plan
@@ -199,6 +212,7 @@ class Executor:
             # included), drained via telemetry.snapshot() or GET /slow
             kind = type(stm).__name__
             telemetry.inc("slow_queries", kind=kind)
+            tracing.force_keep()  # /slow -> /trace/:id must be one hop
             d1 = self.ds.dispatch.stats()
             telemetry.record_slow_query(
                 {
@@ -208,12 +222,24 @@ class Executor:
                     "duration_s": round(dt, 6),
                     "plan": telemetry.drain_plan_notes(),
                     "dispatch": {k: round(d1[k] - dstats0[k], 4) for k in d1},
+                    "trace_id": tracing.current_trace_id(),
+                    "session": self._session_info(),
                     "error": str(resp["result"])[:500]
                     if resp.get("status") == "ERR"
                     else None,
                 }
             )
         return resp
+
+    def _session_info(self) -> dict:
+        """Joinable request context: ns/db and the auth LEVEL only — a
+        token or credential must never reach a log surface."""
+        s = self.session
+        return {
+            "ns": s.ns,
+            "db": s.db,
+            "auth": getattr(s.auth, "level", None) or "anon",
+        }
 
     def _execute_statement(self, ctx: Context, stm) -> dict:
         from surrealdb_tpu import telemetry

@@ -54,6 +54,11 @@ class NotificationHub:
         with self._lock:
             self._subs.pop(live_id, None)
 
+    def live_count(self) -> int:
+        """Open live-query subscriptions (the node runtime gauge)."""
+        with self._lock:
+            return len(self._subs)
+
     def publish(self, n: Notification) -> None:
         with self._lock:
             q = self._subs.get(n.id)

@@ -279,6 +279,9 @@ class IvfState:
         """
         if metric not in ("euclidean", "cosine"):
             raise ValueError(f"search_host supports euclidean/cosine, not {metric!r}")
+        import time as _time
+
+        _t_probe = _time.perf_counter()
         qs = np.asarray(qs, dtype=np.float32)
         cents = self.centroids
         cn = (cents**2).sum(1)
@@ -318,6 +321,17 @@ class IvfState:
             else:
                 out_d[qi, :kk] = final[sel]
             out_i[qi, :kk] = cand[sel]
+        # probe-level node under the active request's knn_search span + a
+        # path-labeled duration histogram (host twin of the device probe)
+        from surrealdb_tpu import telemetry, tracing
+
+        _dur = _time.perf_counter() - _t_probe
+        telemetry.observe("ivf_probe", _dur, path="host")
+        tracing.record_span_into(
+            tracing.current(), "ivf_probe",
+            {"path": "host", "nq": int(qs.shape[0]), "nprobe": int(nprobe)},
+            _t_probe, _dur,
+        )
         return out_d, out_i
 
     def search(

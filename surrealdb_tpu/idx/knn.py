@@ -540,89 +540,137 @@ class KnnPlan(_KnnExecutorMixin):
         if n == 0:
             return
         k = min(self.k, n)
+        import time as _time
+
+        from surrealdb_tpu import telemetry, tracing
+
+        # kernel-level node in the request's span tree: opened BEFORE the
+        # serving-path chain so the dispatch spans it triggers nest under it
+        t_search = _time.perf_counter()
+        _trace_tok = tracing.push()
+        _search_err: Optional[BaseException] = None
         q = np.asarray(self.target, dtype=np.float32)
-        # MTREE preserves the reference's exactness contract
-        # (core/src/idx/trees/mtree.rs:135 — an exact metric tree): it
-        # always takes the exact fused distance+top-k paths; only HNSW
-        # indexes may serve approximate IVF results
-        approx_ok = self.ix["index"]["type"] != "mtree"
-        # ANN pays off only when k is a small fraction of the corpus; a big-k
-        # query gets the exact fused kernel (IVF would cap results at the
-        # probed-candidate count)
-        mesh = None if cnf.TPU_DISABLE else ds.mesh()
-        if mesh is not None and n >= cnf.TPU_KNN_ONDEVICE_THRESHOLD:
-            # multi-chip: the mirror shards row-wise over the mesh. ANN
-            # composes with the mesh (VERDICT r3 weak #1): centroids are
-            # replicated, inverted-list members sharded by slot range —
-            # per-shard probe + rerank, then an O(k*devices) all-gather
-            # (parallel/mesh.py sharded_ivf_search). While the quantizer
-            # trains in the background (or for big-k queries where IVF
-            # can't pay off) the exact per-shard distance+top-k path
-            # (sharded_knn) serves instead — never a latency cliff.
-            matrix, _, rids = mirror.device_snapshot(mesh)
-            mask_dev = mirror.device_sharded_mask()
-            want_ivf = approx_ok and n >= cnf.TPU_ANN_MIN_ROWS and self.k * 4 <= n
-            ivf = mirror.ensure_ivf(matrix) if want_ivf else None
-            if ivf is not None:
-                from surrealdb_tpu.idx.ivf import default_nprobe
+        try:
+            # MTREE preserves the reference's exactness contract
+            # (core/src/idx/trees/mtree.rs:135 — an exact metric tree): it
+            # always takes the exact fused distance+top-k paths; only HNSW
+            # indexes may serve approximate IVF results
+            approx_ok = self.ix["index"]["type"] != "mtree"
+            # ANN pays off only when k is a small fraction of the corpus; a big-k
+            # query gets the exact fused kernel (IVF would cap results at the
+            # probed-candidate count)
+            mesh = None if cnf.TPU_DISABLE else ds.mesh()
+            if mesh is not None and n >= cnf.TPU_KNN_ONDEVICE_THRESHOLD:
+                # multi-chip: the mirror shards row-wise over the mesh. ANN
+                # composes with the mesh (VERDICT r3 weak #1): centroids are
+                # replicated, inverted-list members sharded by slot range —
+                # per-shard probe + rerank, then an O(k*devices) all-gather
+                # (parallel/mesh.py sharded_ivf_search). While the quantizer
+                # trains in the background (or for big-k queries where IVF
+                # can't pay off) the exact per-shard distance+top-k path
+                # (sharded_knn) serves instead — never a latency cliff.
+                matrix, _, rids = mirror.device_snapshot(mesh)
+                mask_dev = mirror.device_sharded_mask()
+                want_ivf = approx_ok and n >= cnf.TPU_ANN_MIN_ROWS and self.k * 4 <= n
+                ivf = mirror.ensure_ivf(matrix) if want_ivf else None
+                if ivf is not None:
+                    from surrealdb_tpu.idx.ivf import default_nprobe
 
-                self.strategy = "ivf-sharded"
-                ef = self.ef or self.ix["index"].get("efc")
-                nprobe = default_nprobe(ivf.nlists, ef)
-                key = ("knn-ivf-sharded", id(matrix), id(ivf), metric, k, nprobe)
+                    self.strategy = "ivf-sharded"
+                    ef = self.ef or self.ix["index"].get("efc")
+                    nprobe = default_nprobe(ivf.nlists, ef)
+                    key = ("knn-ivf-sharded", id(matrix), id(ivf), metric, k, nprobe)
 
-                def runner(qs):
-                    qm = np.stack(qs)
+                    def runner(qs):
+                        qm = np.stack(qs)
 
-                    def collect():
-                        dd, rr = ivf.search_batch_sharded(
-                            qm, mesh, matrix, metric, k, nprobe
-                        )
+                        def collect():
+                            dd, rr = ivf.search_batch_sharded(
+                                qm, mesh, matrix, metric, k, nprobe
+                            )
+                            return list(zip(dd, rr))
+
+                        return collect
+
+                    dists, slots = ds.dispatch.submit(key, q, runner)
+                else:
+                    self.strategy = (
+                        "exact-sharded(ivf-training)" if want_ivf else "exact-sharded"
+                    )
+                    key = ("knn-sharded", id(matrix), metric, k)
+
+                    def runner(qs):
+                        from surrealdb_tpu.parallel.mesh import sharded_knn
+                        from surrealdb_tpu.utils.num import dispatch_tile, pad_tail, tile_slices
+
+                        qs_m = np.stack(qs)
+                        nq = qs_m.shape[0]
+                        tile = dispatch_tile(nq)
+                        dd = np.empty((nq, k), dtype=np.float32)
+                        rr = np.empty((nq, k), dtype=np.int64)
+                        for lo, hi in tile_slices(nq, tile):
+                            d, r = sharded_knn(
+                                mesh, matrix, mask_dev, pad_tail(qs_m[lo:hi], tile), k, metric
+                            )
+                            dd[lo:hi] = np.asarray(d)[: hi - lo]
+                            rr[lo:hi] = np.asarray(r)[: hi - lo]
                         return list(zip(dd, rr))
 
-                    return collect
+                    dists, slots = ds.dispatch.submit(key, q, runner)
+            elif (
+                not cnf.TPU_DISABLE
+                and approx_ok
+                and n >= cnf.TPU_ANN_MIN_ROWS
+                and self.k * 4 <= n
+            ):
+                self.strategy = "ivf"
+                # snapshot first: device_view may compact dead slots, which
+                # renumbers the slot space and invalidates any trained IVF; the
+                # snapshot's rids list is tied to this matrix's numbering
+                matrix, mask, rids = mirror.device_snapshot()
+                ivf = mirror.ensure_ivf(matrix)
+                if ivf is None:
+                    # quantizer still training in the background: serve this
+                    # query exactly (no latency cliff, full recall)
+                    self.strategy = "exact-device(ivf-training)"
+                    key = ("knn-exact", id(matrix), metric, k)
 
-                dists, slots = ds.dispatch.submit(key, q, runner)
-            else:
-                self.strategy = (
-                    "exact-sharded(ivf-training)" if want_ivf else "exact-sharded"
-                )
-                key = ("knn-sharded", id(matrix), metric, k)
+                    def runner(qs):
+                        collect = _exact_device_launch(np.stack(qs), matrix, mask, metric, k)
 
-                def runner(qs):
-                    from surrealdb_tpu.parallel.mesh import sharded_knn
-                    from surrealdb_tpu.utils.num import dispatch_tile, pad_tail, tile_slices
+                        def finish():
+                            dd, rr = collect()
+                            return list(zip(dd, rr))
 
-                    qs_m = np.stack(qs)
-                    nq = qs_m.shape[0]
-                    tile = dispatch_tile(nq)
-                    dd = np.empty((nq, k), dtype=np.float32)
-                    rr = np.empty((nq, k), dtype=np.int64)
-                    for lo, hi in tile_slices(nq, tile):
-                        d, r = sharded_knn(
-                            mesh, matrix, mask_dev, pad_tail(qs_m[lo:hi], tile), k, metric
+                        return finish
+
+                    dists, slots = ds.dispatch.submit(key, q, runner)
+                else:
+                    from surrealdb_tpu.idx.ivf import default_nprobe
+
+                    ef = self.ef or self.ix["index"].get("efc")
+                    nprobe = default_nprobe(ivf.nlists, ef)
+                    # concurrent same-shape queries coalesce into one kernel
+                    # launch (dbs/dispatch.py — the cross-query PARALLEL seam).
+                    # Keyed by the matrix/ivf identities so a batch never mixes
+                    # slot numberings.
+                    key = ("knn-ivf", id(matrix), id(ivf), metric, k, nprobe)
+
+                    def runner(qs):
+                        collect = ivf.search_batch_launch(
+                            np.stack(qs), matrix, metric, k, nprobe
                         )
-                        dd[lo:hi] = np.asarray(d)[: hi - lo]
-                        rr[lo:hi] = np.asarray(r)[: hi - lo]
-                    return list(zip(dd, rr))
 
-                dists, slots = ds.dispatch.submit(key, q, runner)
-        elif (
-            not cnf.TPU_DISABLE
-            and approx_ok
-            and n >= cnf.TPU_ANN_MIN_ROWS
-            and self.k * 4 <= n
-        ):
-            self.strategy = "ivf"
-            # snapshot first: device_view may compact dead slots, which
-            # renumbers the slot space and invalidates any trained IVF; the
-            # snapshot's rids list is tied to this matrix's numbering
-            matrix, mask, rids = mirror.device_snapshot()
-            ivf = mirror.ensure_ivf(matrix)
-            if ivf is None:
-                # quantizer still training in the background: serve this
-                # query exactly (no latency cliff, full recall)
-                self.strategy = "exact-device(ivf-training)"
+                        def finish():
+                            dd, rr = collect()
+                            return list(zip(dd, rr))
+
+                        return finish
+
+                    dists, slots = ds.dispatch.submit(key, q, runner)
+            elif not cnf.TPU_DISABLE and n >= cnf.TPU_KNN_ONDEVICE_THRESHOLD:
+                self.strategy = "exact-device"
+                matrix, mask, rids = mirror.device_snapshot()
                 key = ("knn-exact", id(matrix), metric, k)
 
                 def runner(qs):
@@ -636,75 +684,49 @@ class KnnPlan(_KnnExecutorMixin):
 
                 dists, slots = ds.dispatch.submit(key, q, runner)
             else:
-                from surrealdb_tpu.idx.ivf import default_nprobe
+                # CPU serving path: an already-trained quantizer serves ANN on
+                # host too (probe + exact rerank, idx/ivf.py search_host) — the
+                # same sublinear contract as the device path, and the honest
+                # CPU-ANN baseline for the bench. Never trains here (training
+                # needs the device matrix); exact scan otherwise.
+                ivf = mirror.ivf
+                if (
+                    approx_ok
+                    and ivf is not None
+                    and not ivf.needs_retrain()
+                    and metric in ("euclidean", "cosine")
+                    and n >= cnf.TPU_ANN_MIN_ROWS
+                    and self.k * 4 <= n
+                ):
+                    from surrealdb_tpu.idx.ivf import default_nprobe
 
-                ef = self.ef or self.ix["index"].get("efc")
-                nprobe = default_nprobe(ivf.nlists, ef)
-                # concurrent same-shape queries coalesce into one kernel
-                # launch (dbs/dispatch.py — the cross-query PARALLEL seam).
-                # Keyed by the matrix/ivf identities so a batch never mixes
-                # slot numberings.
-                key = ("knn-ivf", id(matrix), id(ivf), metric, k, nprobe)
-
-                def runner(qs):
-                    collect = ivf.search_batch_launch(
-                        np.stack(qs), matrix, metric, k, nprobe
+                    self.strategy = "ivf-host"
+                    ef = self.ef or self.ix["index"].get("efc")
+                    data, alive, rids = mirror.host_view()
+                    dists, li = ivf.search_host(
+                        q[None, :], data, metric, k,
+                        default_nprobe(ivf.nlists, ef),
                     )
-
-                    def finish():
-                        dd, rr = collect()
-                        return list(zip(dd, rr))
-
-                    return finish
-
-                dists, slots = ds.dispatch.submit(key, q, runner)
-        elif not cnf.TPU_DISABLE and n >= cnf.TPU_KNN_ONDEVICE_THRESHOLD:
-            self.strategy = "exact-device"
-            matrix, mask, rids = mirror.device_snapshot()
-            key = ("knn-exact", id(matrix), metric, k)
-
-            def runner(qs):
-                collect = _exact_device_launch(np.stack(qs), matrix, mask, metric, k)
-
-                def finish():
-                    dd, rr = collect()
-                    return list(zip(dd, rr))
-
-                return finish
-
-            dists, slots = ds.dispatch.submit(key, q, runner)
-        else:
-            # CPU serving path: an already-trained quantizer serves ANN on
-            # host too (probe + exact rerank, idx/ivf.py search_host) — the
-            # same sublinear contract as the device path, and the honest
-            # CPU-ANN baseline for the bench. Never trains here (training
-            # needs the device matrix); exact scan otherwise.
-            ivf = mirror.ivf
-            if (
-                approx_ok
-                and ivf is not None
-                and not ivf.needs_retrain()
-                and metric in ("euclidean", "cosine")
-                and n >= cnf.TPU_ANN_MIN_ROWS
-                and self.k * 4 <= n
-            ):
-                from surrealdb_tpu.idx.ivf import default_nprobe
-
-                self.strategy = "ivf-host"
-                ef = self.ef or self.ix["index"].get("efc")
-                data, alive, rids = mirror.host_view()
-                dists, li = ivf.search_host(
-                    q[None, :], data, metric, k,
-                    default_nprobe(ivf.nlists, ef),
+                    dists, slots = dists[0], li[0]
+                else:
+                    self.strategy = "exact-host"
+                    data, norms, rids = mirror.host_search_view()
+                    dists, li = D.knn_search_host(
+                        q[None, :], data, metric, k, x_sq_norms=norms
+                    )
+                    dists, slots = dists[0], np.asarray(li)[0]
+        except BaseException as e:
+            _search_err = e
+            raise
+        finally:
+            dur = _time.perf_counter() - t_search
+            telemetry.observe("knn_search", dur, strategy=self.strategy)
+            if _trace_tok is not None:
+                tracing.pop(
+                    _trace_tok, "knn_search",
+                    {"strategy": self.strategy, "n": n, "k": k},
+                    t_search, dur, _search_err,
                 )
-                dists, slots = dists[0], li[0]
-            else:
-                self.strategy = "exact-host"
-                data, norms, rids = mirror.host_search_view()
-                dists, li = D.knn_search_host(
-                    q[None, :], data, metric, k, x_sq_norms=norms
-                )
-                dists, slots = dists[0], np.asarray(li)[0]
         self._count_strategy(n)
         for d, s in zip(np.asarray(dists), np.asarray(slots)):
             if not np.isfinite(d) or s < 0 or s >= len(rids):

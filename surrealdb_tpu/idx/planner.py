@@ -268,19 +268,20 @@ def plan_sources(ctx, stm, sources: List[Any]) -> List[Any]:
     from surrealdb_tpu import telemetry
 
     out: List[Any] = []
-    for s in sources:
-        if not isinstance(s, ITable):
-            out.append(s)
-            continue
-        plan = build_plan(ctx, stm, s.tb, with_)
-        if plan is None:
-            telemetry.inc("plan_strategy", strategy="TableScan")
-            out.append(s)
-        else:
-            strategy = type(plan).__name__
-            telemetry.inc("plan_strategy", strategy=strategy)
-            telemetry.note_plan({"table": s.tb, "plan": strategy})
-            out.append(IIndex(s.tb, plan))
+    with telemetry.span("plan"):
+        for s in sources:
+            if not isinstance(s, ITable):
+                out.append(s)
+                continue
+            plan = build_plan(ctx, stm, s.tb, with_)
+            if plan is None:
+                telemetry.inc("plan_strategy", strategy="TableScan")
+                out.append(s)
+            else:
+                strategy = type(plan).__name__
+                telemetry.inc("plan_strategy", strategy=strategy)
+                telemetry.note_plan({"table": s.tb, "plan": strategy})
+                out.append(IIndex(s.tb, plan))
     return out
 
 

@@ -89,12 +89,18 @@ class Datastore:
     ) -> List[dict]:
         """Parse and run a SurrealQL query string; returns a list of response
         dicts {status, result|error, time} (reference kvs/ds.rs:768)."""
+        from surrealdb_tpu import tracing
         from surrealdb_tpu.syn import parse_query
         from surrealdb_tpu.dbs.executor import Executor
         from surrealdb_tpu.dbs.session import Session
 
-        ast = parse_query(text)
-        return self.process(ast, session or Session.owner(), vars)
+        # the executor level of the span tree: a root trace for embedded
+        # callers (SDK/bench), a child span under an HTTP/WS/RPC ingress.
+        # The sql label is trace-only (tracing never feeds metric families,
+        # so truncated statement text can't mint unbounded series).
+        with tracing.request("execute", sql=text[:120]):
+            ast = parse_query(text)
+            return self.process(ast, session or Session.owner(), vars)
 
     def process(self, ast, session, vars: Optional[Dict[str, Any]] = None) -> List[dict]:
         from surrealdb_tpu.dbs.executor import Executor

@@ -80,21 +80,28 @@ class Transaction:
             pass  # interpreter shutdown: modules may already be torn down
 
     def commit(self) -> None:
-        self.complete_changes()
-        # backend commit + mirror-delta application must be one atomic unit
-        # across threads: without the datastore-level lock two committing
-        # transactions could apply their deltas in the opposite order of
-        # their backend commits and leave shared mirrors diverged from KV
-        if self._commit_lock is not None and (
-            self.graph_deltas
-            or self.vector_deltas
-            or self.ft_deltas
-            or self._on_commit
-        ):
-            with self._commit_lock:
+        from surrealdb_tpu import telemetry
+
+        # the kvs level of the request's span tree (+ a write-labeled
+        # duration histogram): commit-lock waits and mirror-delta
+        # application show up here when they stall a query
+        with telemetry.span("txn_commit", write=str(bool(self.write)).lower()):
+            self.complete_changes()
+            # backend commit + mirror-delta application must be one atomic
+            # unit across threads: without the datastore-level lock two
+            # committing transactions could apply their deltas in the
+            # opposite order of their backend commits and leave shared
+            # mirrors diverged from KV
+            if self._commit_lock is not None and (
+                self.graph_deltas
+                or self.vector_deltas
+                or self.ft_deltas
+                or self._on_commit
+            ):
+                with self._commit_lock:
+                    self._commit_and_apply()
+            else:
                 self._commit_and_apply()
-        else:
-            self._commit_and_apply()
 
     def _commit_and_apply(self) -> None:
         self.tr.commit()

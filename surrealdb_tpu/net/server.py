@@ -38,28 +38,62 @@ class BodyTooLarge(Exception):
 def _capped(fn):
     """Route wrapper (the per-request middleware seam, reference
     src/net/mod.rs:68-183 + net/tracer.rs): request-id assignment, client-ip
-    extraction, duration telemetry, and the oversized-body 413 guard."""
+    extraction, trace-context extraction (W3C `traceparent` or
+    `surreal-trace-id`), duration telemetry, and the oversized-body 413
+    guard. The root span of the request's trace opens here; `_send` echoes
+    the trace id so clients can fetch the tree via GET /trace/:id."""
 
     def inner(self):
         import time as _time
 
-        from surrealdb_tpu import telemetry
+        from surrealdb_tpu import telemetry, tracing
+        from surrealdb_tpu.dbs.capabilities import HTTP_ROUTES
 
+        seg = urlparse(self.path).path.split("/")[1] or "root"
+        route = seg if seg in HTTP_ROUTES or seg == "root" else "_other"
+        tid, parent = None, None
+        tp = self.headers.get("traceparent")
+        if tp:
+            parsed = tracing.parse_traceparent(tp)
+            if parsed is not None:
+                tid, parent = parsed
+        if tid is None and self.headers.get("surreal-trace-id"):
+            tid = self.headers.get("surreal-trace-id")
+        # a WS upgrade never gets a request-scoped trace: the handler runs
+        # the connection loop for the socket's whole lifetime, and each RPC
+        # frame mints its own trace — nesting those under one
+        # connection-long root would mis-scope (and never finalize) them
+        is_ws = (self.headers.get("Upgrade") or "").lower() == "websocket"
         t0 = _time.perf_counter()
         try:
-            return fn(self)
+            if is_ws:
+                return fn(self)
+            with tracing.request(
+                "http_request",
+                trace_id=tid,
+                parent_id=parent,
+                method=self.command or "?",
+                route=route,
+            ) as tr:
+                self._trace_id = tr.trace_id if tr is not None else None
+                return fn(self)
         except BodyTooLarge:
             return self._send(413, {"error": "request body too large"})
         finally:
-            from surrealdb_tpu.dbs.capabilities import HTTP_ROUTES
-
-            seg = urlparse(self.path).path.split("/")[1] or "root"
-            telemetry.observe(
-                "http_request_duration",
-                _time.perf_counter() - t0,
-                method=self.command or "?",
-                route=seg if seg in HTTP_ROUTES or seg == "root" else "_other",
-            )
+            if is_ws:
+                # fn() ran the connection loop until disconnect — that is a
+                # connection lifetime, not an HTTP request latency, and
+                # would blow out the request histogram's tail
+                telemetry.observe(
+                    "ws_connection_duration", _time.perf_counter() - t0
+                )
+            else:
+                telemetry.observe(
+                    "http_request_duration",
+                    _time.perf_counter() - t0,
+                    method=self.command or "?",
+                    route=route,
+                )
 
     return inner
 
@@ -79,6 +113,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
         # one handler instance serves many keep-alive requests
         self.__dict__.pop("_cached_body", None)
         self.__dict__.pop("_req_id", None)
+        self.__dict__.pop("_trace_id", None)
         return super().parse_request()
 
     def request_id(self) -> str:
@@ -179,6 +214,18 @@ class SurrealHandler(BaseHTTPRequestHandler):
         for k, v in self._cors_headers():
             self.send_header(k, v)
         self.send_header("x-request-id", self.request_id())
+        tid = self.__dict__.get("_trace_id")
+        if tid is not None:
+            # echo the request's trace context (inbound id honored, fresh
+            # ids discoverable); surreal-trace-id is ALWAYS the resolvable
+            # /trace/:id key — traceparent only accompanies it when the id
+            # is W3C-shaped (deriving one for an opaque id would name a
+            # second, unresolvable trace). Root span id is always 1.
+            from surrealdb_tpu import tracing
+
+            self.send_header("surreal-trace-id", tid)
+            if tracing.is_hex_trace_id(tid):
+                self.send_header("traceparent", tracing.format_traceparent(tid, 1))
         self.end_headers()
         self.wfile.write(body)
 
@@ -221,6 +268,19 @@ class SurrealHandler(BaseHTTPRequestHandler):
             raise InvalidAuthError()
         return sess
 
+    def _system_gate(self):
+        """Auth gate for debug surfaces that expose raw statement text
+        (/slow, /traces, /trace/:id): require a system user when auth is
+        enabled. Returns the session, or None after sending the 401."""
+        try:
+            sess = self._authorized_session()
+            if self.auth_enabled and sess.auth.level not in ("db", "ns", "root"):
+                raise InvalidAuthError()
+            return sess
+        except SurrealError as e:
+            self._send(401, {"error": str(e)})
+            return None
+
     def _route_allowed(self, route: str) -> bool:
         """HTTP-route capability gate (reference: RouteTarget allow/deny).
         Sends the 403 itself when denied."""
@@ -251,9 +311,32 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 return
             from surrealdb_tpu import telemetry
 
+            # refresh node runtime gauges (RSS, live queries, jit cache,
+            # device memory) so the scrape sees current values
+            telemetry.collect_node_metrics(self.ds)
             return self._send(
                 200, telemetry.render_prometheus().encode(), "text/plain"
             )
+        if path == "/traces" or path.startswith("/trace/"):
+            # span trees carry statement text in labels, so like /slow the
+            # endpoints need a system user, not just the route capability
+            if not self._route_allowed("traces" if path == "/traces" else "trace"):
+                return
+            if self._system_gate() is None:
+                return
+            from urllib.parse import parse_qs, unquote
+
+            from surrealdb_tpu import tracing
+
+            if path == "/traces":
+                return self._send(200, tracing.list_traces())
+            doc = tracing.get_trace(unquote(path.split("/", 2)[2]))
+            if doc is None:
+                return self._send(404, {"error": "trace not found"})
+            fmt = parse_qs(urlparse(self.path).query).get("format", [""])[0]
+            if fmt == "chrome":
+                return self._send(200, tracing.to_chrome(doc))
+            return self._send(200, dict(doc, tree=tracing.span_tree(doc)))
         if path == "/slow":
             # structured slow-query log (ring buffer; dbs/executor.py) — the
             # /metrics-adjacent debug endpoint. Entries carry raw statement
@@ -261,12 +344,8 @@ class SurrealHandler(BaseHTTPRequestHandler):
             # system user, not just the route capability
             if not self._route_allowed("slow"):
                 return
-            try:
-                sess = self._authorized_session()
-                if self.auth_enabled and sess.auth.level not in ("db", "ns", "root"):
-                    raise InvalidAuthError()
-            except SurrealError as e:
-                return self._send(401, {"error": str(e)})
+            if self._system_gate() is None:
+                return
             from surrealdb_tpu import telemetry
 
             return self._send(200, telemetry.slow_queries())
@@ -696,17 +775,41 @@ class SurrealHandler(BaseHTTPRequestHandler):
         }
 
         def handle(req: dict, binary: bool) -> None:
+            from surrealdb_tpu import tracing
+
             rid = req.get("id")
             method = req.get("method", "")
+            # per-frame trace context: a client-supplied `trace` field (a
+            # 32-hex trace id or a full W3C traceparent) is honored and
+            # echoed; every statement of a multi-statement `query` frame
+            # shares this one trace
+            t_field = req.get("trace")
+            tid, t_parent = None, None
+            if isinstance(t_field, str) and t_field:
+                parsed = tracing.parse_traceparent(t_field)
+                if parsed is not None:
+                    tid, t_parent = parsed
+                else:
+                    tid = t_field
             frame = None
+            tr = None
             try:
-                # same capability policy as HTTP /rpc; checked per message
-                # because signin/authenticate upgrade the session mid-stream
-                denied = self._rpc_denied(method, ctx.session)
-                if denied is not None:
-                    raise InvalidAuthError(denied)
-                result = ctx.execute(method, req.get("params") or [])
+                # the trace opens BEFORE the capability check so a denied
+                # request still yields a retrievable (errored, pinned)
+                # trace under the id the client supplied
+                with tracing.request(
+                    "ws_rpc", trace_id=tid, parent_id=t_parent, method=str(method)
+                ) as tr:
+                    # same capability policy as HTTP /rpc; checked per
+                    # message because signin/authenticate upgrade the
+                    # session mid-stream
+                    denied = self._rpc_denied(method, ctx.session)
+                    if denied is not None:
+                        raise InvalidAuthError(denied)
+                    result = ctx.execute(method, req.get("params") or [])
                 resp: Dict[str, Any] = {"id": rid, "result": result}
+                if tr is not None and tid is not None:
+                    resp["trace"] = tr.trace_id
                 # encode INSIDE the guard: an unserializable result must
                 # still produce an error frame, never a silent dropped id
                 if binary:
@@ -718,6 +821,10 @@ class SurrealHandler(BaseHTTPRequestHandler):
             except Exception as e:  # noqa: BLE001 — a worker must not die silently
                 msg = str(e) if isinstance(e, SurrealError) else f"Internal error: {e}"
                 resp = {"id": rid, "error": {"code": -32000, "message": msg}}
+                # echo the id the trace is actually STORED under (an opaque
+                # client id may have been sanitized) — never a derived one
+                if tid is not None and tr is not None:
+                    resp["trace"] = tr.trace_id
                 if binary:
                     frame = wsproto.encode_frame(wsproto.OP_BINARY, self._ws_encode(resp))
                 else:

@@ -167,10 +167,12 @@ class DaemonPool:
             item = self._q.get()
             if item is None:
                 return
-            fn, args, done, t_submit = item
+            fn, args, done, t_submit, cvctx = item
             telemetry.observe("ws_pool_queue_wait", _time.perf_counter() - t_submit)
             try:
-                fn(*args)
+                # run under the submitter's contextvars snapshot so trace
+                # context (tracing.py) survives the thread hand-off
+                cvctx.run(fn, *args)
             except Exception:  # noqa: BLE001 — tasks report their own errors
                 pass
             finally:
@@ -178,6 +180,7 @@ class DaemonPool:
                 telemetry.gauge_add("ws_inflight", -1)
 
     def submit(self, fn, *args):
+        import contextvars as _contextvars
         import threading as _threading
         import time as _time
 
@@ -185,7 +188,9 @@ class DaemonPool:
 
         telemetry.gauge_add("ws_inflight", 1)
         done = _threading.Event()
-        self._q.put((fn, args, done, _time.perf_counter()))
+        self._q.put(
+            (fn, args, done, _time.perf_counter(), _contextvars.copy_context())
+        )
         return done
 
     def shutdown(self) -> None:

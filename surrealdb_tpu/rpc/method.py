@@ -55,7 +55,7 @@ class RpcContext:
 
     # ------------------------------------------------------------ dispatch
     def execute(self, method: str, params: Optional[List[Any]] = None) -> Any:
-        from surrealdb_tpu import telemetry
+        from surrealdb_tpu import telemetry, tracing
 
         params = params or []
         m = method.lower()
@@ -66,10 +66,16 @@ class RpcContext:
             raise SurrealError(f"Method '{method}' not found")
 
         # one seam covers BOTH the HTTP /rpc route and the WS actor
-        # (reference: src/telemetry/metrics/ws/ rpc method instrumentation)
+        # (reference: src/telemetry/metrics/ws/ rpc method instrumentation).
+        # tracing.request mints the root trace for embedded SDK callers; under
+        # an HTTP/WS ingress the rpc_method span below is the nested node.
         telemetry.inc("rpc_requests", method=m)
         try:
-            with telemetry.span("rpc_method", method=m):
+            # nest=False: under an HTTP/WS/SDK ingress root the rpc_method
+            # span below IS the node; a second wrapper would only duplicate it
+            with tracing.request("rpc", method=m, nest=False), telemetry.span(
+                "rpc_method", method=m
+            ):
                 return getattr(self, f"_m_{m}")(params)
         except Exception as e:
             telemetry.inc("rpc_errors", method=m, error=telemetry.error_class(e))

@@ -28,7 +28,12 @@ class LocalEngine:
         self.rpc_ctx = RpcContext(self.ds, self.session)
 
     def rpc(self, method: str, params: List[Any]) -> Any:
-        return self.rpc_ctx.execute(method, params)
+        # SDK ingress: the embedded engine mints the request trace here so
+        # local calls get the same span trees as HTTP/WS ones (tracing.py)
+        from surrealdb_tpu import tracing
+
+        with tracing.request("sdk_rpc", method=method.lower()):
+            return self.rpc_ctx.execute(method, params)
 
     def next_notification(self, live_id: str, timeout: Optional[float]):
         hub = self.ds.notifications

@@ -149,15 +149,26 @@ def observe(name: str, seconds: float, **labels) -> None:
 
 @contextmanager
 def span(name: str, **labels: str):
-    """Timed span: always feeds the duration histograms; records the
-    individual span only while profiling is enabled (reference
-    #[instrument] spans)."""
+    """Timed span: always feeds the duration histograms; becomes a node in
+    the active request's span tree (tracing.py) when one exists; records
+    the flat profiling entry only while profiling is enabled (reference
+    #[instrument] spans). With no active trace and profiling off the extra
+    cost is one ContextVar read."""
+    from surrealdb_tpu import tracing
+
     t0 = time.perf_counter()
+    tok = tracing.push()
+    err = None
     try:
         yield
+    except BaseException as e:
+        err = e
+        raise
     finally:
         dur = time.perf_counter() - t0
         observe(name, dur, **labels)
+        if tok is not None:
+            tracing.pop(tok, name, labels, t0, dur, err)
         if _enabled:
             with _lock:
                 _spans.append((name, t0, dur))
@@ -175,6 +186,24 @@ def record_slow_query(entry: dict) -> None:
 def slow_queries() -> List[dict]:
     with _lock:
         return list(_slow)
+
+
+# ------------------------------------------------------------------ error log
+# Counters are label-bounded so they can't carry a trace_id; this bounded
+# ring is the joinable side of statement_errors: each entry cites the
+# request's trace_id + session info (ns/db/auth LEVEL — never tokens).
+_ERROR_LOG_SIZE = 256
+_errors: Deque[dict] = deque(maxlen=_ERROR_LOG_SIZE)
+
+
+def record_error(entry: dict) -> None:
+    with _lock:
+        _errors.append(entry)
+
+
+def recent_errors() -> List[dict]:
+    with _lock:
+        return list(_errors)
 
 
 # ------------------------------------------------------------------ plan notes
@@ -273,6 +302,7 @@ def snapshot() -> dict:
                 for labels, h in series.items()
             },
             "slow_queries": list(_slow),
+            "errors": list(_errors),
             "spans": [
                 {"name": n, "start": s, "dur_ms": round(dur * 1e3, 3)}
                 for n, s, dur in list(_spans)
@@ -290,6 +320,76 @@ def reset() -> None:
         _durations.clear()
         _spans.clear()
         _slow.clear()
+        _errors.clear()
+
+
+# ------------------------------------------------------------------ node metrics
+def _jit_cache_stats() -> Optional[Tuple[int, int, int]]:
+    """(hits, misses, size) of jax's jit tracing/compile cache — a cache
+    miss on the serving path means a fresh XLA compile (~seconds on a
+    tunneled chip). Best-effort across jax versions; None when no known
+    handle exposes cache_info()."""
+    import sys
+
+    if "jax" not in sys.modules:  # a /metrics scrape must not import jax
+        return None
+    try:
+        from jax._src import pjit as _pjit
+    except Exception:
+        return None
+    for name in ("_infer_params_cached", "_pjit_lower_cached", "_create_pjit_jaxpr"):
+        obj = getattr(_pjit, name, None)
+        if obj is None or not hasattr(obj, "cache_info"):
+            continue
+        try:
+            ci = obj.cache_info()
+            return int(ci.hits), int(ci.misses), int(ci.currsize)
+        except Exception:
+            continue
+    return None
+
+
+def collect_node_metrics(ds=None) -> None:
+    """Refresh process/node-level gauges (reference: the runtime metrics
+    the OTEL stack exports per node). Called by the /metrics handler right
+    before rendering, so scrapes see current values: process RSS, live
+    WS sessions (ws_connections gauge, maintained elsewhere), live-query
+    subscriptions, jit compile-cache hits/misses, and per-device memory
+    when the backend reports it (CPU returns None)."""
+    import sys
+
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        import os as _os
+
+        gauge_set(
+            "process_resident_memory_bytes", rss_pages * _os.sysconf("SC_PAGE_SIZE")
+        )
+    except (OSError, ValueError, IndexError):
+        pass
+    if ds is not None and getattr(ds, "notifications", None) is not None:
+        gauge_set("live_queries", ds.notifications.live_count())
+    jit = _jit_cache_stats()
+    if jit is not None:
+        hits, misses, size = jit
+        gauge_set("jit_cache_hits", hits)
+        gauge_set("jit_cache_misses", misses)
+        gauge_set("jit_cache_size", size)
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            for d in jax.local_devices():
+                ms = d.memory_stats()
+                if ms and "bytes_in_use" in ms:
+                    gauge_set(
+                        "device_memory_bytes_in_use",
+                        ms["bytes_in_use"],
+                        device=str(d.id),
+                    )
+        except Exception:  # noqa: BLE001 — metrics must never fail a scrape
+            pass
 
 
 # ------------------------------------------------------------------ exposition

@@ -275,9 +275,14 @@ def test_bench_artifact_validator(tmp_path):
         "config": "2", "errors": {"statements": 0}, "retries": 0,
         "strategy": {"ivf-device": 4},
         "batch": {"submitted": 8, "dispatches": 2, "batched": 6, "mean_width": 4.0},
+        "error_breakdown": {"dispatch_retries:UNAVAILABLE": 1},
+        "slowest_trace": {
+            "trace_id": "ab" * 16, "duration_ms": 12.5,
+            "spans": [{"id": 1, "parent": None, "name": "execute"}],
+        },
     }
     good = {
-        "schema": "surrealdb-tpu-bench/1", "scale": 0.02, "configs": ["2"],
+        "schema": "surrealdb-tpu-bench/2", "scale": 0.02, "configs": ["2"],
         "results": [
             line,
             {"metric": "north_star_knn", "value": 1.0, "unit": "qps", "vs_baseline": 2.0},
@@ -287,9 +292,17 @@ def test_bench_artifact_validator(tmp_path):
     p.write_text(json.dumps(good))
     assert validate(str(p)) == []
 
+    # a null slowest_trace is legal (a config may retain no trace)
+    p.write_text(json.dumps(dict(good, results=[dict(line, slowest_trace=None), good["results"][1]])))
+    assert validate(str(p)) == []
+
     bad = dict(good, results=[dict(line, config="9"), good["results"][1]])
     bad["results"][0].pop("retries")
+    bad["results"][0]["slowest_trace"] = {"trace_id": "x"}  # no spans
+    bad["results"][0]["error_breakdown"] = {"k": "not-an-int"}
     p.write_text(json.dumps(bad))
     problems = validate(str(p))
     assert any("retries" in x for x in problems)
     assert any("absent" in x for x in problems)
+    assert any("slowest_trace" in x for x in problems)
+    assert any("error_breakdown" in x for x in problems)
