@@ -64,13 +64,17 @@ import numpy as np
 
 SCALE = float(os.environ.get("SURREAL_BENCH_SCALE", "1.0"))
 CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5").split(","))
-ROUND = os.environ.get("SURREAL_BENCH_ROUND", "r06")
+ROUND = os.environ.get("SURREAL_BENCH_ROUND", "r07")
 OUT_PATH = os.environ.get(
     "SURREAL_BENCH_OUT",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), f"bench_results_{ROUND}.json"),
 )
 PROFILE = "--profile" in sys.argv[1:] or os.environ.get("SURREAL_PROFILE") == "1"
-SCHEMA = "surrealdb-tpu-bench/2"
+# schema/3 (r7): concurrent-pass lines carry per-query latency percentiles
+# (latency_ms) and per-config batch accounting carries the batch-width
+# distribution (batch.width_dist) + split/pipeline counters — a future
+# throughput collapse must be diagnosable from the artifact alone
+SCHEMA = "surrealdb-tpu-bench/3"
 
 D = 768
 NI = max(int(1_000_000 * SCALE), 1024)  # item corpus (configs 2/4/5)
@@ -141,6 +145,18 @@ def _error_classes() -> dict:
     return out
 
 
+def _pcts(times) -> dict:
+    """p50/p95/p99 (ms) of a per-query latency sample."""
+    if not times:
+        return {"p50": None, "p95": None, "p99": None}
+    ts = sorted(times)
+
+    def at(p):
+        return round(ts[min(int(len(ts) * p), len(ts) - 1)] * 1e3, 1)
+
+    return {"p50": at(0.50), "p95": at(0.95), "p99": at(0.99)}
+
+
 def _acct_begin(ds) -> dict:
     from surrealdb_tpu import tracing
 
@@ -149,12 +165,31 @@ def _acct_begin(ds) -> dict:
     # fill mid-window from prior configs' traces (bench owns the process)
     tracing.store_reset()
     return {
+        "t0": time.time(),
         "stats": ds.dispatch.stats(),
+        "widths": ds.dispatch.width_distribution(),
         "errors": _error_counts(),
         "strategy": _strategy_counts(),
         "classes": _error_classes(),
         "trace_ids": set(tracing.trace_ids()),
     }
+
+
+def _slow_in_window(t0: float):
+    """(records, truncated): slow-statement records from the telemetry ring
+    since t0 — logged per config (every config window runs AFTER its
+    ingest) and counted in the artifact, so 'no slow statement over 5s
+    after ingest' is checkable from either the log or the JSON. The ring
+    is a bounded FIFO: when it is full AND its oldest survivor is already
+    inside the window, earlier window records may have been evicted —
+    `truncated` flags that instead of letting eviction fabricate a zero."""
+    from surrealdb_tpu import telemetry
+
+    entries = telemetry.slow_queries()
+    inwin = [e for e in entries if e.get("ts", 0) >= t0]
+    cap = getattr(telemetry, "_SLOW_LOG_SIZE", 128)
+    truncated = len(entries) >= cap and bool(entries) and entries[0].get("ts", 0) >= t0
+    return inwin, truncated
 
 
 def _acct_delta(ds, before: dict) -> dict:
@@ -183,12 +218,18 @@ def _acct_delta(ds, before: dict) -> dict:
     # a full store at window close means FIFO eviction may have dropped
     # the true slowest — flag it instead of attributing to a survivor
     truncated = len(tracing.trace_ids()) >= _cnf.TRACE_STORE_SIZE
+    w0, w1 = before["widths"], ds.dispatch.width_distribution()
+    width_dist = {
+        str(w): n - w0.get(w, 0) for w, n in sorted(w1.items()) if n - w0.get(w, 0)
+    }
+    slow_entries, slow_truncated = _slow_in_window(before["t0"])
     return {
         "errors": {k: e1[k] - e0[k] for k in e1},
         "error_breakdown": {
             k: v - c0.get(k, 0) for k, v in c1.items() if v - c0.get(k, 0)
         },
         "retries": int(dd["retries"]),
+        "splits": int(dd["splits"]),
         "strategy": {k: v - s0.get(k, 0) for k, v in s1.items() if v - s0.get(k, 0)},
         "batch": {
             "submitted": int(dd["submitted"]),
@@ -197,11 +238,19 @@ def _acct_delta(ds, before: dict) -> dict:
             "mean_width": round(dd["submitted"] / dd["dispatches"], 3)
             if dd["dispatches"]
             else None,
+            "width_dist": width_dist,
             "launch_s": round(dd["launch_s"], 4),
             "collect_s": round(dd["collect_s"], 4),
+            "pipeline_wait_s": round(dd["pipeline_wait_s"], 4),
         },
         "slowest_trace": slowest,
         "trace_window_truncated": truncated,
+        "slow_over_5s": sum(
+            1 for e in slow_entries if e.get("duration_s", 0) > 5.0
+        ),
+        "slow_window_truncated": slow_truncated,
+        # private: run_cfg pops this for log replay (never serialized)
+        "_slow_entries": slow_entries,
     }
 
 
@@ -392,6 +441,11 @@ def bench_graph_3hop(ds, s, rng):
         edges_per_seed[seed] = tot
     cpu_mode(False)
 
+    # join the ingest-armed mirror build + count-kernel prewarm
+    # (idx/graph_csr.py): the timed pass must start on compiled shapes,
+    # not inside an XLA compile (the r5 84.8s/26.4s first-query stalls)
+    ds.graph_mirrors.wait_prewarm(timeout=300)
+
     # sequential pass: per-query latency (tunnel-RTT-bound)
     queries = [(f"SELECT count({chain}) AS c FROM person:{seed}", None) for seed in seeds]
     qps, p50, _ = timed_queries(ds, s, queries)
@@ -405,14 +459,17 @@ def bench_graph_3hop(ds, s, rng):
     nthreads, rounds = 32, 2
     conc_seeds = [seeds[i % len(seeds)] for i in range(nthreads * rounds)]
     errors = []
+    conc_times = []
     barrier = threading.Barrier(nthreads + 1)
 
     def client(i):
         barrier.wait()
         for r_ in range(rounds):
             seed = conc_seeds[i * rounds + r_]
+            tq = time.perf_counter()
             try:
                 run(ds, s, f"SELECT count({chain}) AS c FROM person:{seed}")
+                conc_times.append(time.perf_counter() - tq)
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
 
@@ -450,6 +507,7 @@ def bench_graph_3hop(ds, s, rng):
             "p50_ms": round(p50, 1),
             "seq_edges_per_s": round(seq_eps, 1),
             "concurrent_clients": nthreads,
+            "latency_ms": _pcts(conc_times),
             "dispatches_per_query": round(
                 dstats["dispatches"] / max(dstats["submitted"], 1), 3
             ),
@@ -548,17 +606,21 @@ def bench_knn(ds, s, corpus, rng):
         t.join()
 
     stats0 = ds.dispatch.stats()  # diff out the sequential passes
+    widths0 = ds.dispatch.width_distribution()
     nthreads, rounds = 32, 2
     cq = rng.integers(0, NI, size=nthreads * rounds)
     cqs = corpus[cq] + rng.standard_normal((len(cq), D)).astype(np.float32) * 0.05
     errors = []
+    conc_times = []  # per-query wall latency (list.append is GIL-atomic)
     barrier = threading.Barrier(nthreads + 1)
 
     def client(i):
         barrier.wait()
         for r_ in range(rounds):
+            tq = time.perf_counter()
             try:
                 run(ds, s, sql, {"q": cqs[i * rounds + r_].tolist()})
+                conc_times.append(time.perf_counter() - tq)
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
 
@@ -575,6 +637,10 @@ def bench_knn(ds, s, corpus, rng):
         log(f"knn: WARNING {len(errors)} concurrent queries failed; first: {errors[0]!r:.300}")
     d1 = ds.dispatch.stats()
     dstats = {k: d1[k] - stats0[k] for k in d1}
+    w1 = ds.dispatch.width_distribution()
+    conc_widths = {
+        str(w): n - widths0.get(w, 0) for w, n in sorted(w1.items()) if n - widths0.get(w, 0)
+    }
 
     log("knn: exact device pass")
     saved = cnf.TPU_ANN_MIN_ROWS
@@ -643,6 +709,8 @@ def bench_knn(ds, s, corpus, rng):
             "single_stream_qps": round(ivf_qps, 2),
             "p50_ms": round(ivf_p50, 1),
             "concurrent_clients": nthreads,
+            "latency_ms": _pcts(conc_times),
+            "conc_width_dist": conc_widths,
             "dispatches_per_query": round(
                 dstats["dispatches"] / max(dstats["submitted"], 1), 3
             ),
@@ -852,6 +920,11 @@ def main() -> None:
             _DEFER = False
             acct = _acct_delta(ds, acct0)
             acct["ann_training_overlap"] = training_overlap or _ann_training_active()
+            for e in acct.pop("_slow_entries"):
+                log(
+                    f"slow statement ({e.get('duration_s', 0):.3f}s): "
+                    f"{str(e.get('sql', ''))[:200]}"
+                )
             for i, line in enumerate(RESULTS[n0:]):
                 line["config"] = cfg
                 line.update(acct)

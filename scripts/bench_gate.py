@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Opt-in perf gate: smoke-scale concurrent-kNN must not collapse.
+
+Runs bench.py with ONLY config 2 (the north-star concurrent-kNN pass) at a
+smoke scale, then FAILS if the emitted line shows any errors, a concurrent
+qps below the committed floor, or recall@10 below its floor — the collapse
+signatures this gate exists to catch early (VERDICT r5 weak #1). Post-
+ingest statements over 5s are surfaced as a WARNING only: on accelerator-
+less CI containers jax-CPU compiles land mid-window and would trip a hard
+gate without any engine defect (inspect slowest_trace when it fires).
+
+Not part of tier-1 (it is a perf measurement, not a correctness suite):
+run it next to scripts/tier1.sh when touching the dispatch/kNN hot path:
+
+    python scripts/bench_gate.py
+
+Env knobs:
+    SURREAL_BENCH_GATE_SCALE    corpus scale for the smoke run (default 0.02)
+    SURREAL_BENCH_GATE_FLOOR    concurrent-kNN qps floor (default 3.0 — half
+                                the worst rate measured on the 2-core CI
+                                container; real hardware clears it by 10x+)
+    SURREAL_BENCH_GATE_RECALL   recall@10 floor (default 0.6 at smoke scale;
+                                tiny corpora probe fewer clustered lists)
+    SURREAL_BENCH_GATE_TIMEOUT  whole-run timeout seconds (default 1200)
+
+Exit code 0 = gate passed; 1 = gate failed (reasons on stderr).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+SCALE = os.environ.get("SURREAL_BENCH_GATE_SCALE", "0.02")
+FLOOR_QPS = float(os.environ.get("SURREAL_BENCH_GATE_FLOOR", "3.0"))
+FLOOR_RECALL = float(os.environ.get("SURREAL_BENCH_GATE_RECALL", "0.6"))
+TIMEOUT = int(os.environ.get("SURREAL_BENCH_GATE_TIMEOUT", "1200"))
+
+
+def main() -> int:
+    out = os.path.join(tempfile.mkdtemp(prefix="bench_gate_"), "bench_gate.json")
+    env = dict(os.environ)
+    env.update(
+        {
+            "SURREAL_BENCH_SCALE": SCALE,
+            "SURREAL_BENCH_CONFIGS": "2",
+            "SURREAL_BENCH_ROUND": "gate",
+            "SURREAL_BENCH_OUT": out,
+        }
+    )
+    print(f"bench_gate: scale={SCALE} floor={FLOOR_QPS}qps recall>={FLOOR_RECALL}")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env,
+            timeout=TIMEOUT,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench_gate: FAIL — bench run exceeded {TIMEOUT}s", file=sys.stderr)
+        return 1
+    tail = proc.stdout.decode(errors="replace")[-4000:]
+    if proc.returncode != 0:
+        print(tail, file=sys.stderr)
+        print(f"bench_gate: FAIL — bench exited rc={proc.returncode}", file=sys.stderr)
+        return 1
+
+    sys.path.insert(0, HERE)
+    from check_bench_artifact import validate
+
+    problems = validate(out)
+    if problems:
+        for p in problems:
+            print(f"bench_gate: artifact invalid: {p}", file=sys.stderr)
+        return 1
+
+    with open(out) as f:
+        art = json.load(f)
+    line = next(
+        (
+            r
+            for r in art["results"]
+            if str(r.get("config")) == "2" and str(r.get("metric", "")).startswith("knn_qps")
+        ),
+        None,
+    )
+    if line is None:
+        print("bench_gate: FAIL — no config-2 knn_qps line in artifact", file=sys.stderr)
+        return 1
+
+    failures = []
+    errs = line.get("errors") or {}
+    if any(errs.values()):
+        failures.append(f"errors != 0: {errs}")
+    qps = line.get("value") or 0.0
+    if qps < FLOOR_QPS:
+        failures.append(f"concurrent kNN qps {qps} < floor {FLOOR_QPS}")
+    recall = line.get("recall_at_10")
+    if recall is not None and recall < FLOOR_RECALL:
+        failures.append(f"recall@10 {recall} < floor {FLOOR_RECALL}")
+    if line.get("slow_over_5s"):
+        # warning only: on accelerator-less CI containers the jax-CPU
+        # compiles land mid-window and trip this without any engine defect
+        print(
+            f"bench_gate: WARN — {line['slow_over_5s']} post-ingest "
+            "statement(s) over 5s (see slowest_trace in the artifact)",
+            file=sys.stderr,
+        )
+
+    summary = {
+        "qps": qps,
+        "recall_at_10": recall,
+        "latency_ms": line.get("latency_ms"),
+        "errors": errs,
+        "retries": line.get("retries"),
+        "splits": line.get("splits"),
+        "width_dist": (line.get("batch") or {}).get("width_dist"),
+        "artifact": out,
+    }
+    print(f"bench_gate: {json.dumps(summary)}")
+    if failures:
+        for msg in failures:
+            print(f"bench_gate: FAIL — {msg}", file=sys.stderr)
+        return 1
+    print("bench_gate: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

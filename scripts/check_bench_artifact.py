@@ -23,9 +23,9 @@ import os
 import sys
 from typing import List
 
-SCHEMA = "surrealdb-tpu-bench/2"
+SCHEMA = "surrealdb-tpu-bench/3"
 # earlier rounds' committed artifacts stay validatable under their own rules
-KNOWN_SCHEMAS = ("surrealdb-tpu-bench/1", SCHEMA)
+KNOWN_SCHEMAS = ("surrealdb-tpu-bench/1", "surrealdb-tpu-bench/2", SCHEMA)
 
 # keys every emitted line must carry (bench.py `emit`)
 RESULT_KEYS = ("metric", "value", "unit", "vs_baseline")
@@ -34,7 +34,13 @@ CONFIG_KEYS = ("config", "errors", "retries", "strategy", "batch")
 # schema/2 adds the per-class error breakdown and the slowest query's
 # request-scoped span tree (tracing.py)
 CONFIG_KEYS_V2 = CONFIG_KEYS + ("error_breakdown", "slowest_trace")
+# schema/3 adds the split-retry counter; concurrent-pass lines must also
+# carry per-query latency percentiles and the batch-width distribution
+# (the fields that make a qps collapse diagnosable from the artifact)
+CONFIG_KEYS_V3 = CONFIG_KEYS_V2 + ("splits", "slow_over_5s")
 BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
+BATCH_KEYS_V3 = BATCH_KEYS + ("width_dist", "pipeline_wait_s")
+LATENCY_KEYS = ("p50", "p95", "p99")
 # a present (non-null) slowest_trace must be a real trace doc
 TRACE_KEYS = ("trace_id", "duration_ms", "spans")
 
@@ -51,7 +57,15 @@ def validate(path: str) -> List[str]:
         return [f"{path}: artifact must be a JSON object"]
     if art.get("schema") not in KNOWN_SCHEMAS:
         problems.append(f"schema is {art.get('schema')!r}, expected one of {KNOWN_SCHEMAS}")
-    config_keys = CONFIG_KEYS_V2 if art.get("schema") == SCHEMA else CONFIG_KEYS
+    schema = art.get("schema")
+    v3 = schema == SCHEMA
+    if v3:
+        config_keys = CONFIG_KEYS_V3
+    elif schema == "surrealdb-tpu-bench/2":
+        config_keys = CONFIG_KEYS_V2
+    else:
+        config_keys = CONFIG_KEYS
+    batch_keys = BATCH_KEYS_V3 if v3 else BATCH_KEYS
     for key in ("scale", "configs", "results"):
         if key not in art:
             problems.append(f"missing top-level key {key!r}")
@@ -83,11 +97,31 @@ def validate(path: str) -> List[str]:
                 problems.append(f"{where} ({metric}): missing {key!r}")
         batch = r.get("batch")
         if isinstance(batch, dict):
-            for key in BATCH_KEYS:
+            for key in batch_keys:
                 if key not in batch:
                     problems.append(f"{where} ({metric}): batch missing {key!r}")
+            wd = batch.get("width_dist")
+            if "width_dist" in batch and not (
+                isinstance(wd, dict)
+                and all(isinstance(v, int) for v in wd.values())
+            ):
+                problems.append(
+                    f"{where} ({metric}): batch.width_dist must map width -> int count"
+                )
         elif "batch" in r:
             problems.append(f"{where} ({metric}): batch must be an object")
+        if v3 and "concurrent_clients" in r:
+            lat = r.get("latency_ms")
+            if not isinstance(lat, dict):
+                problems.append(
+                    f"{where} ({metric}): concurrent pass missing latency_ms percentiles"
+                )
+            else:
+                for key in LATENCY_KEYS:
+                    if key not in lat:
+                        problems.append(
+                            f"{where} ({metric}): latency_ms missing {key!r}"
+                        )
         eb = r.get("error_breakdown")
         if "error_breakdown" in r and not (
             isinstance(eb, dict)

@@ -3,6 +3,9 @@
 # wrapped so CI and humans run the identical command. Exit code is
 # pytest's; the log lands in /tmp/_t1.log and a DOTS_PASSED recount is
 # printed (driver-proof pass counting independent of the summary line).
+#
+# Opt-in perf companion (run when touching the dispatch/kNN hot path):
+#   python scripts/bench_gate.py   # smoke-scale concurrent-kNN floor gate
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -81,6 +81,25 @@ TPU_GRAPH_DENSE_MAX = _env_int("SURREAL_TPU_GRAPH_DENSE_MAX", 16384)
 TPU_ANN_MIN_ROWS = _env_int("SURREAL_TPU_ANN_MIN_ROWS", 8192)
 TPU_DISABLE = _env_bool("SURREAL_TPU_DISABLE", False)
 
+# Dispatch pipelining (dbs/dispatch.py — the concurrent-query hot path).
+# Widest coalesced batch one leader may launch: capped at the largest
+# pre-warmed pow2 tile so an oversized queue dispatches as back-to-back
+# tiles that REUSE compiled shapes instead of minting a new one (every
+# distinct padded width is a separate XLA compile, seconds each on a
+# tunneled chip). Oversized queues chain: the remainder is handed to the
+# next leader immediately after this leader's launch phase.
+DISPATCH_MAX_WIDTH = _env_int("SURREAL_DISPATCH_MAX_WIDTH", 64)
+# batches allowed in flight per bucket (launched, not yet collected):
+# depth 2 = classic double buffering (batch N+1 uploads while batch N
+# computes/downloads); deeper pipelines help when collect dominates
+DISPATCH_PIPELINE_DEPTH = _env_int("SURREAL_DISPATCH_PIPELINE_DEPTH", 2)
+# memory-aware split-retry: a transiently-failed batch wider than this is
+# BISECTED and the halves retried (recursively) instead of re-executing
+# the full width — one oversized launch (RESOURCE_EXHAUSTED) can no
+# longer zero out every rider of a 32-wide batch. At or below the floor
+# the sub-batch is retried whole, once.
+DISPATCH_SPLIT_FLOOR = _env_int("SURREAL_DISPATCH_SPLIT_FLOOR", 4)
+
 # Changefeeds
 CHANGEFEED_GC_INTERVAL_SECS = _env_int("SURREAL_CHANGEFEED_GC_INTERVAL", 10)
 def _env_float(name: str, default: float) -> float:
@@ -92,6 +111,17 @@ def _env_float(name: str, default: float) -> float:
 
 # statements slower than this are counted + logged (slow-query reporting)
 SLOW_QUERY_THRESHOLD_SECS = _env_float("SURREAL_SLOW_QUERY_THRESHOLD", 1.0)
+
+# pause before a dispatch retry/split-retry re-execution (lets a
+# transiently-overloaded device drain; keep small — riders are blocked)
+DISPATCH_RETRY_BACKOFF_SECS = _env_float("SURREAL_DISPATCH_RETRY_BACKOFF", 0.2)
+
+# Graph count-kernel prewarm (idx/graph_csr.py): after RELATE ingest into a
+# not-yet-mirrored table quiesces for PREWARM_DELAY seconds, build the CSR
+# mirrors and background-compile the batched count kernels so the first
+# query after ingest doesn't pay the build + XLA-compile cliff.
+GRAPH_PREWARM = _env_bool("SURREAL_GRAPH_PREWARM", True)
+GRAPH_PREWARM_DELAY_SECS = _env_float("SURREAL_GRAPH_PREWARM_DELAY", 0.5)
 
 # Request-scoped tracing (tracing.py). Recording is on by default; the
 # bounded store retains every slow/errored/client-tagged trace and a

@@ -358,8 +358,25 @@ class GraphMirrors:
         self._building: Dict[Tuple[str, str, str], List[tuple]] = {}
         self._build_locks: Dict[Tuple[str, str, str], threading.Lock] = {}
         self._lock = threading.RLock()
+        # ingest-time prewarm (cnf.GRAPH_PREWARM): RELATE commits into a
+        # not-yet-mirrored table arm a debounced timer; when ingest
+        # quiesces, the mirror build + batched-count-kernel compiles run in
+        # the background so the FIRST query doesn't pay the multi-second
+        # (at scale, multi-minute) build + XLA-compile cliff
+        self._ds = None  # weakref to the owning Datastore (set by bind_ds)
+        self._prewarm_timers: Dict[Tuple[str, str, str], threading.Timer] = {}
+        self._prewarm_deadline: Dict[Tuple[str, str, str], float] = {}
+        self._prewarm_running: Set[Tuple[str, str, str]] = set()
+        self._warmed_pairs: Set[tuple] = set()
 
     # ------------------------------------------------------------ plumbing
+    def bind_ds(self, ds) -> None:
+        """Bind the owning Datastore (weakly): prewarm builds open their own
+        read transactions, which needs more than the commit-path hook has."""
+        import weakref
+
+        self._ds = weakref.ref(ds)
+
     def interner(self, ns: str, db: str) -> NodeInterner:
         with self._lock:
             it = self._interners.get((ns, db))
@@ -427,6 +444,11 @@ class GraphMirrors:
         and (b) the querying transaction's own uncommitted writes never
         leak into the shared mirror (they force the exact KV walk anyway)."""
         ns, db = ctx.ns_db()
+        self.build_table(ctx.ds(), ns, db, src_tb)
+
+    def build_table(self, ds, ns: str, db: str, src_tb: str) -> None:
+        """ensure_table's engine: also callable from the background prewarm
+        thread, which has a Datastore but no request context."""
         key3 = (ns, db, src_tb)
         with self._lock:
             if key3 in self._built:
@@ -440,7 +462,7 @@ class GraphMirrors:
             it = self.interner(ns, db)
             adjs: Dict[Tuple[bytes, str], Dict[int, List[int]]] = {}
             pre = keys.graph_prefix(ns, db, src_tb)
-            txn = ctx.ds().transaction(False)
+            txn = ds.transaction(False)
             try:
                 for chunk in txn.batch(pre, prefix_end(pre), 4096):
                     for k, _ in chunk:
@@ -471,8 +493,11 @@ class GraphMirrors:
         """Apply committed edge-pointer deltas to built (or mid-build)
         tables. Each delta: (ns, db, src_tb, dir, ft, src, dst, add).
         Unbuilt tables ignore deltas — their eventual build scan sees the
-        committed KV state anyway.
+        committed KV state anyway — but each such commit (re-)arms the
+        debounced prewarm so the build + kernel compiles happen in the
+        ingest→first-query gap instead of inside the first query.
         """
+        unbuilt: Set[Tuple[str, str, str]] = set()
         for delta in deltas:
             key3 = tuple(delta[:3])
             with self._lock:
@@ -480,8 +505,173 @@ class GraphMirrors:
                     self._building[key3].append(delta)
                     continue
                 if key3 not in self._built:
+                    unbuilt.add(key3)
                     continue
                 self._apply_one(delta)
+        if unbuilt:
+            self._schedule_prewarm(unbuilt)
+
+    # ------------------------------------------------------------ prewarm
+    def _arm_timer(self, key3: Tuple[str, str, str], delay: float) -> None:
+        """Start one self-identifying timer for key3 (caller holds _lock)."""
+        timer = threading.Timer(delay, self._prewarm, args=(key3, None))
+        timer.args = (key3, timer)  # the callback must recognise itself
+        timer.daemon = True
+        self._prewarm_timers[key3] = timer
+        timer.start()
+
+    def _schedule_prewarm(self, keys3: Set[Tuple[str, str, str]]) -> None:
+        """Debounce by DEADLINE, not by timer churn: each commit just moves
+        the key's deadline forward; at most ONE live timer exists per key
+        (it re-arms itself if it wakes early), so a million single-edge
+        commits cost a million dict writes, not a million thread spawns."""
+        import time as _time
+
+        from surrealdb_tpu import cnf
+
+        if not cnf.GRAPH_PREWARM or self._ds is None:
+            return
+        delay = cnf.GRAPH_PREWARM_DELAY_SECS
+        now = _time.monotonic()
+        with self._lock:
+            for key3 in keys3:
+                self._prewarm_deadline[key3] = now + delay
+                if key3 not in self._prewarm_timers:
+                    self._arm_timer(key3, delay)
+
+    def _prewarm(self, key3: Tuple[str, str, str], timer) -> None:
+        """Timer body (background thread): build the table's mirrors, then
+        compile the batched count kernels its chains will hit. Best-effort —
+        any failure leaves the lazy first-query path fully intact."""
+        import time as _time
+
+        from surrealdb_tpu import telemetry
+
+        ns, db, tb = key3
+        with self._lock:
+            if self._prewarm_timers.get(key3) is not timer:
+                return  # superseded — the newer timer owns this key
+            remaining = self._prewarm_deadline.get(key3, 0.0) - _time.monotonic()
+            if remaining > 0.001:
+                # woke before the (commit-advanced) deadline: re-arm
+                self._arm_timer(key3, remaining)
+                return
+            del self._prewarm_timers[key3]
+            self._prewarm_deadline.pop(key3, None)
+            self._prewarm_running.add(key3)
+        try:
+            ds = self._ds() if self._ds is not None else None
+            if ds is None:
+                return
+            telemetry.inc("graph_prewarm", stage="build")
+            self.build_table(ds, ns, db, tb)
+            self.warm_count_kernels(ns, db)
+        except Exception:
+            pass
+        finally:
+            with self._lock:
+                self._prewarm_running.discard(key3)
+
+    def wait_prewarm(self, timeout: float = 30.0) -> bool:
+        """Block until no prewarm timer or build is pending (test/bench
+        determinism helper, never used on the query path)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            with self._lock:
+                if not self._prewarm_timers and not self._prewarm_running:
+                    return True
+            _time.sleep(0.01)
+        return False
+
+    def warm_count_kernels(self, ns: str, db: str) -> None:
+        """Compile the batched count kernels for every composable
+        `->edge->node` OUT-pair over built mirrors, at the lane counts and
+        frontier pad the serving runners use — so a post-ingest burst of
+        count-chain queries starts on pre-compiled shapes (the r6 scale-1.0
+        log showed 84.8s/26.4s first-query stalls that were exactly these
+        compiles). Results are discarded; zero-weight lanes are harmless."""
+        from surrealdb_tpu import cnf, telemetry
+
+        if cnf.TPU_DISABLE:
+            return
+        import jax.numpy as jnp
+
+        _kernels()
+        dense_kernel = _JITTED["dense_count_batch"]
+        csc_kernel = _JITTED["chain_count_batch"]
+        with self._lock:
+            mkeys = [k for k in self._m if k[0] == ns and k[1] == db]
+        pairs = [
+            (tb, ft, ft2)
+            for (_, _, tb, d, ft) in mkeys
+            if d == keys.DIR_OUT
+            for (_, _, tb2, d2, ft2) in mkeys
+            if tb2 == ft and d2 == keys.DIR_OUT
+        ]
+        fsz = _next_pow2(max(1, cnf.TPU_GRAPH_FRONTIER_PAD))
+        # every lane count the serving runners can pad to: bp =
+        # max(_next_pow2(B), LANES) with B capped by the dispatcher width,
+        # so the shape set is {LANES, ..., pow2(DISPATCH_MAX_WIDTH)}
+        lane_set = []
+        b = max(cnf.TPU_GRAPH_BATCH_LANES, 1)
+        top = max(_next_pow2(cnf.DISPATCH_MAX_WIDTH), b)
+        while b <= top:
+            lane_set.append(b)
+            b *= 2
+        for tb, et, dt_ in pairs:
+            pkey = (ns, db, tb, et, dt_)
+            with self._lock:
+                if pkey in self._warmed_pairs:
+                    continue
+                self._warmed_pairs.add(pkey)
+            spec1 = ([tb], [keys.DIR_OUT], [et])
+            spec2 = ([et], [keys.DIR_OUT], [dt_])
+            # chains self-compose only when the pair loops back to its
+            # source table (person->knows->person); otherwise warm 1 pair
+            max_pairs = 3 if dt_ == tb else 1
+            telemetry.inc("graph_prewarm", stage="kernels")
+            try:
+                op = self._dense_pair(ns, db, spec1, spec2)
+            except Exception:
+                op = None
+            if op is not None:
+                n0 = op["ns_pad"]
+                for lanes in lane_set:
+                    frs = jnp.asarray(np.full((lanes, fsz), n0, dtype=np.int32))
+                    cws = jnp.asarray(np.zeros((lanes, fsz), dtype=np.int32))
+                    for c in range(1, max_pairs + 1):
+                        try:
+                            dense_kernel(
+                                (op["A"],) * (c - 1), op["outdeg"], frs, cws, n0=n0
+                            )
+                        except Exception:
+                            pass
+                continue
+            # dense doesn't fit (oversized tables / fat multiplicities):
+            # warm the CSC cumsum form the serving path will use instead
+            try:
+                m1 = self._hop_mirrors(ns, db, spec1)
+                m2 = self._hop_mirrors(ns, db, spec2)
+                if len(m1) != 1 or len(m2) != 1:
+                    continue
+                n_cap = _next_pow2(len(self.interner(ns, db)))
+                csc1, csc2 = m1[0].device_csc(), m2[0].device_csc()
+                ptr2 = m2[0].device_arrays()[0]
+                for lanes in lane_set:
+                    frs = jnp.asarray(np.full((lanes, fsz), n_cap, dtype=np.int32))
+                    cws = jnp.asarray(np.zeros((lanes, fsz), dtype=np.int32))
+                    for hops in range(1, max_pairs + 1):
+                        # `->et->tb` repeated `hops` times = 2*hops specs;
+                        # the final spec is a degree reduction (no CSC)
+                        csc_hops = tuple(
+                            ((csc1,) if i % 2 == 0 else (csc2,))
+                            for i in range(2 * hops - 1)
+                        )
+                        csc_kernel(csc_hops, ((ptr2,),), frs, cws, n_cap=n_cap)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------ traversal
     def _hop_mirrors(self, ns, db, spec) -> List[PointerCsr]:

@@ -281,46 +281,80 @@ class IvfState:
             raise ValueError(f"search_host supports euclidean/cosine, not {metric!r}")
         import time as _time
 
+        from surrealdb_tpu import telemetry
+
         _t_probe = _time.perf_counter()
         qs = np.asarray(qs, dtype=np.float32)
+        nq = qs.shape[0]
         cents = self.centroids
         cn = (cents**2).sum(1)
         nprobe = min(nprobe, self.nlists)
-        out_d = np.full((qs.shape[0], k), np.inf, dtype=np.float32)
-        out_i = np.full((qs.shape[0], k), -1, dtype=np.int64)
-        for qi, q in enumerate(qs):
-            d2c = cn - 2.0 * (cents @ q)  # + |q|^2 constant: ordering is equal
-            probe = np.argpartition(d2c, nprobe - 1)[:nprobe]
-            cand_lists = [self.lists[int(p)] for p in probe]
-            total = sum(len(l) for l in cand_lists)
-            if total == 0:
-                continue
-            cand = np.fromiter(
-                (s for l in cand_lists for s in l), dtype=np.int64, count=total
+        out_d = np.full((nq, k), np.inf, dtype=np.float32)
+        out_i = np.full((nq, k), -1, dtype=np.int64)
+        # one BLAS call probes every query at once: [Q, C] + |q|^2 constant,
+        # so the ordering equals true euclidean distance per row
+        d2c = cn[None, :] - 2.0 * (qs @ cents.T)
+        probes = np.argpartition(d2c, nprobe - 1, axis=1)[:, :nprobe]
+        # concatenate every query's probed lists into ONE flat candidate
+        # array with owner segments — the rerank then runs as a handful of
+        # vectorized numpy calls over all queries together instead of a
+        # per-query python loop (GIL thrash under concurrent clients was a
+        # measured contributor to the scale-1.0 concurrent-kNN collapse)
+        cand_per_q: List[np.ndarray] = []
+        for qi in range(nq):
+            cl = [self.lists[int(p)] for p in probes[qi]]
+            total = sum(len(l) for l in cl)
+            cand_per_q.append(
+                np.fromiter((s for l in cl for s in l), dtype=np.int64, count=total)
             )
-            from surrealdb_tpu import telemetry
-
             telemetry.observe_hist(
                 "ivf_candidates", total, buckets=telemetry.COUNT_BUCKETS, path="host"
             )
-            x = data[cand]
+        counts = np.array([c.size for c in cand_per_q], dtype=np.int64)
+        q2 = (qs**2).sum(1)
+        qn = np.maximum(np.sqrt(q2), 1e-30)
+        # bound the gather: query blocks capped at ~128k candidate rows, so
+        # a wide batch over a big corpus can't materialize a multi-GB
+        # [T, D] temporary (the per-query peak stays what the old loop had)
+        cand_block = 1 << 17
+        qi0 = 0
+        while qi0 < nq:
+            qi1 = qi0 + 1
+            tot = int(counts[qi0])
+            while qi1 < nq and tot + int(counts[qi1]) <= cand_block:
+                tot += int(counts[qi1])
+                qi1 += 1
+            if tot == 0:
+                qi0 = qi1
+                continue
+            cand_all = np.concatenate(cand_per_q[qi0:qi1])
+            owner = np.repeat(np.arange(qi0, qi1), counts[qi0:qi1])
+            x = data[cand_all]  # [T, D] gather, one fancy-index per block
+            dots = np.einsum("ij,ij->i", x, qs[owner])
+            xn2 = np.einsum("ij,ij->i", x, x)
             if metric == "cosine":
-                xn = np.maximum(np.sqrt((x**2).sum(1)), 1e-30)
-                qn = max(float(np.sqrt((q**2).sum())), 1e-30)
-                d = 1.0 - (x @ q) / (xn * qn)
-                final = d
+                xn = np.maximum(np.sqrt(xn2), 1e-30)
+                d = 1.0 - dots / (xn * qn[owner])
             else:
-                d = (x**2).sum(1) - 2.0 * (x @ q)
-                final = None  # sqrt applied after top-k below
-            kk = min(k, total)
-            sel = np.argpartition(d, kk - 1)[:kk] if kk < total else np.arange(total)
-            order = np.argsort(d[sel])
-            sel = sel[order]
-            if final is None:
-                out_d[qi, :kk] = np.sqrt(np.maximum(d[sel] + (q**2).sum(), 0.0))
-            else:
-                out_d[qi, :kk] = final[sel]
-            out_i[qi, :kk] = cand[sel]
+                d = xn2 - 2.0 * dots  # + |q|^2 applied after top-k below
+            # per-query top-k over its segment: the remaining python loop
+            # does only O(T_q) selection work, no distance math
+            off = 0
+            for qi in range(qi0, qi1):
+                t = int(counts[qi])
+                if t == 0:
+                    continue
+                seg = d[off : off + t]
+                kk = min(k, t)
+                sel = np.argpartition(seg, kk - 1)[:kk] if kk < t else np.arange(t)
+                sel = sel[np.argsort(seg[sel])]
+                if metric == "cosine":
+                    out_d[qi, :kk] = seg[sel]
+                else:
+                    out_d[qi, :kk] = np.sqrt(np.maximum(seg[sel] + q2[qi], 0.0))
+                out_i[qi, :kk] = cand_all[off + sel]
+                off += t
+            qi0 = qi1
         # probe-level node under the active request's knn_search span + a
         # path-labeled duration histogram (host twin of the device probe)
         from surrealdb_tpu import telemetry, tracing
@@ -346,7 +380,8 @@ class IvfState:
         return d[0], r[0]
 
     def search_batch_launch(
-        self, qs: np.ndarray, matrix, metric: str, k: int, nprobe: int, tile: int = 64
+        self, qs: np.ndarray, matrix, metric: str, k: int, nprobe: int,
+        tile: Optional[int] = None,
     ):
         """Async probe+rerank: enqueue every tile's kernel + start the
         device→host copies, return a collect() closure that blocks on the
@@ -408,8 +443,10 @@ class IvfState:
         same kernel carry no correctness risk — results are discarded."""
         import threading
 
+        from surrealdb_tpu.utils.num import warm_tile_sizes
+
         todo = []
-        for t in (1, 8, 64):
+        for t in warm_tile_sizes():
             key = (t, k, nprobe, metric)
             if t != served_tile and key not in self._warmed:
                 self._warmed.add(key)
@@ -434,7 +471,8 @@ class IvfState:
         threading.Thread(target=warm, daemon=True).start()
 
     def search_batch(
-        self, qs: np.ndarray, matrix, metric: str, k: int, nprobe: int, tile: int = 64
+        self, qs: np.ndarray, matrix, metric: str, k: int, nprobe: int,
+        tile: Optional[int] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Batched probe+rerank: qs [Q, D] → (dists [Q, k], slots [Q, k]).
 
@@ -487,7 +525,7 @@ class IvfState:
 
     def search_batch_sharded(
         self, qs: np.ndarray, mesh, matrix, metric: str, k: int, nprobe: int,
-        tile: int = 64,
+        tile: Optional[int] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Batched sharded probe+rerank over a mesh-sharded mirror matrix.
         Same contract as search_batch; misses surface as +inf/-1."""

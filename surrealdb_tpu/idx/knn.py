@@ -418,9 +418,13 @@ _EXACT_WARMED: set = set()
 
 def _warm_exact_tiles(dim, matrix, mask_j, metric, k, served_tile) -> None:
     """Background-compile the other dispatch tile shapes of the exact fused
-    kernel (same rationale as IvfState._warm_tiles)."""
+    kernel (same rationale as IvfState._warm_tiles). The warm set tracks
+    the dispatcher's width cap, so every width the coalescer can hand a
+    runner has a compiled shape waiting."""
+    from surrealdb_tpu.utils.num import warm_tile_sizes
+
     todo = []
-    for t in (1, 8, 64):
+    for t in warm_tile_sizes():
         key = (t, id(matrix), metric, k)
         if t != served_tile and key not in _EXACT_WARMED:
             _EXACT_WARMED.add(key)

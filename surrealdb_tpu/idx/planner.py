@@ -280,7 +280,19 @@ def plan_sources(ctx, stm, sources: List[Any]) -> List[Any]:
             else:
                 strategy = type(plan).__name__
                 telemetry.inc("plan_strategy", strategy=strategy)
-                telemetry.note_plan({"table": s.tb, "plan": strategy})
+                note = {"table": s.tb, "plan": strategy}
+                if isinstance(plan, KnnPlan):
+                    # a kNN statement's latency is governed by the dispatch
+                    # pipeline: pin the active knobs into the plan note so a
+                    # slow-query record names the width/depth it ran under
+                    from surrealdb_tpu import cnf as _cnf
+
+                    note["dispatch"] = {
+                        "max_width": _cnf.DISPATCH_MAX_WIDTH,
+                        "pipeline_depth": _cnf.DISPATCH_PIPELINE_DEPTH,
+                        "split_floor": _cnf.DISPATCH_SPLIT_FLOOR,
+                    }
+                telemetry.note_plan(note)
                 out.append(IIndex(s.tb, plan))
     return out
 

@@ -35,6 +35,9 @@ class Datastore:
 
         self.index_stores = IndexStores()
         self.graph_mirrors = GraphMirrors()
+        # ingest-time mirror builds + count-kernel prewarm need a Datastore
+        # to open scan transactions from the background timer thread
+        self.graph_mirrors.bind_ds(self)
         # cross-query device dispatch coalescing (dbs/dispatch.py)
         self.dispatch = DispatchQueue()
         # background index builds (DEFINE INDEX ... CONCURRENTLY)

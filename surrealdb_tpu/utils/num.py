@@ -26,14 +26,29 @@ def pad_tail(arr, tile: int):
     return np.concatenate([arr, pad], axis=0)
 
 
-def dispatch_tile(nq: int, cap: int = 64) -> int:
+def dispatch_tile(nq: int, cap: int = None) -> int:
     """Query-batch tile size with a SMALL shape vocabulary {1, 8, cap}: a
     coalesced batch can arrive at any size, and every distinct padded shape
     is a separate XLA compile (~seconds on a tunneled chip) — three shapes
     keep the compile cache tiny while bounding padding waste at 8x only for
-    2..7-query batches whose kernels are small anyway."""
+    2..7-query batches whose kernels are small anyway. `cap` defaults to the
+    dispatcher's width cap (cnf.DISPATCH_MAX_WIDTH), so the widest batch the
+    coalescer can hand a runner is exactly the largest pre-warmed tile."""
+    if cap is None:
+        from surrealdb_tpu import cnf
+
+        cap = cnf.DISPATCH_MAX_WIDTH
     if nq <= 1:
         return 1
-    if nq <= 8:
-        return 8
-    return cap
+    t = 8 if nq <= 8 else cap
+    return max(1, min(t, cap))
+
+
+def warm_tile_sizes(cap: int = None):
+    """The tile vocabulary background shape-warming should pre-compile:
+    every size dispatch_tile can return for the current width cap."""
+    if cap is None:
+        from surrealdb_tpu import cnf
+
+        cap = cnf.DISPATCH_MAX_WIDTH
+    return (1, 8, cap) if cap > 8 else ((1, cap) if cap > 1 else (1,))

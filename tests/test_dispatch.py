@@ -349,3 +349,229 @@ def test_persistent_failure_still_fails():
 
     with _pytest.raises(RuntimeError, match="always broken"):
         q.submit("k", 1, runner)
+
+
+# ------------------------------------------------- split-retry / pipelining
+class ResourceExhaustedRunner:
+    """Fake device with a hard batch-width capacity: any launch wider than
+    `cap` fails like an oversized allocation on a real chip. Records every
+    attempted launch width."""
+
+    def __init__(self, cap: int, mul: int = 10):
+        self.cap = cap
+        self.mul = mul
+        self.launches: list = []
+        self._lock = threading.Lock()
+
+    def __call__(self, payloads):
+        with self._lock:
+            self.launches.append(len(payloads))
+        if len(payloads) > self.cap:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating scratch")
+        return [p * self.mul for p in payloads]
+
+
+def _coalesce_batch(q, n, runner, key="k"):
+    """Build one n-wide coalesced batch behind a blocked width-1 leader;
+    returns ({i: result}, {i: error}) for riders 1..n."""
+    release, started = threading.Event(), threading.Event()
+
+    def slow_ok(xs):
+        started.set()
+        release.wait(5)
+        return [("lead", x) for x in xs]
+
+    results, errors = {}, {}
+
+    def submit(i, r):
+        try:
+            results[i] = q.submit(key, i, r)
+        except Exception as e:  # noqa: BLE001
+            errors[i] = e
+
+    lead = threading.Thread(target=submit, args=(0, slow_ok))
+    lead.start()
+    assert started.wait(5)
+    riders = [threading.Thread(target=submit, args=(i, runner)) for i in range(1, n + 1)]
+    for t in riders:
+        t.start()
+    while q.stats()["submitted"] < n + 1:
+        time.sleep(0.005)
+    release.set()
+    lead.join(10)
+    for t in riders:
+        t.join(10)
+    assert results.pop(0) == ("lead", 0)
+    return results, errors
+
+
+def test_split_retry_bisects_oversized_batch(monkeypatch):
+    """A RESOURCE_EXHAUSTED full batch is bisected down to widths the
+    device can serve — never re-executed at the width that just failed —
+    and EVERY rider ends with its own result."""
+    monkeypatch.setattr(cnf, "DISPATCH_RETRY_BACKOFF_SECS", 0.0)
+    from surrealdb_tpu.dbs.dispatch import DispatchQueue
+
+    q = DispatchQueue(split_floor=1, pipeline_depth=1)
+    fake = ResourceExhaustedRunner(cap=2)
+    results, errors = _coalesce_batch(q, 8, fake)
+
+    assert errors == {}
+    assert results == {i: i * 10 for i in range(1, 9)}
+    # the full width fails once; afterwards the dispatcher only shrinks
+    assert fake.launches[0] in (1, 8)  # leader's own width-1 batch uses slow_ok
+    wide = [w for w in fake.launches if w == 8]
+    assert len(wide) == 1, f"full width re-executed: {fake.launches}"
+    assert sorted(fake.launches) == [2, 2, 2, 2, 4, 4, 8]
+    st = q.stats()
+    assert st["splits"] == 3  # 8 -> 4+4 -> (2+2)x2
+    assert st["failures"] == 0
+
+
+def test_split_retry_floor_retries_whole(monkeypatch):
+    """At or below the split floor a transiently-failed batch retries
+    whole, once — no pointless bisection of narrow batches."""
+    monkeypatch.setattr(cnf, "DISPATCH_RETRY_BACKOFF_SECS", 0.0)
+    from surrealdb_tpu.dbs.dispatch import DispatchQueue
+
+    q = DispatchQueue(split_floor=8, pipeline_depth=1)
+    calls = {"n": 0}
+
+    def flaky(payloads):
+        calls["n"] += 1
+        if calls["n"] == 1 and len(payloads) > 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: transient")
+        return [p * 10 for p in payloads]
+
+    results, errors = _coalesce_batch(q, 6, flaky)
+    assert errors == {} and results == {i: i * 10 for i in range(1, 7)}
+    st = q.stats()
+    assert st["splits"] == 0 and st["retries"] == 1
+
+
+def test_split_retry_deterministic_half_not_reexecuted(monkeypatch):
+    """During a split-retry, a half that fails DETERMINISTICALLY fails its
+    own riders immediately (no further re-execution); the other half still
+    succeeds independently."""
+    monkeypatch.setattr(cnf, "DISPATCH_RETRY_BACKOFF_SECS", 0.0)
+    from surrealdb_tpu.dbs.dispatch import DispatchQueue
+
+    q = DispatchQueue(split_floor=1, pipeline_depth=1)
+    widths = []
+
+    def runner(payloads):
+        widths.append(len(payloads))
+        if len(payloads) == 4:
+            raise RuntimeError("RESOURCE_EXHAUSTED: oversized")
+        # payloads 1..2 land in the first half after the bisect
+        if any(p == 1 for p in payloads):
+            raise ValueError("bad shape")  # deterministic
+        return [p * 10 for p in payloads]
+
+    results, errors = _coalesce_batch(q, 4, runner)
+    assert results == {3: 30, 4: 40}
+    assert set(errors) == {1, 2}
+    assert all(isinstance(e, ValueError) for e in errors.values())
+    assert widths.count(4) == 1  # the failed width never re-ran
+    st = q.stats()
+    assert st["splits"] == 1 and st["failures"] == 1
+
+
+def test_deterministic_wide_batch_fails_without_reexecution():
+    """A deterministic error on a WIDE batch must not trigger the split
+    path at all — the batch fails once, every rider sees the error."""
+    from surrealdb_tpu.dbs.dispatch import DispatchQueue
+
+    q = DispatchQueue(split_floor=1, pipeline_depth=1)
+    calls = {"n": 0}
+
+    def broken(payloads):
+        calls["n"] += 1
+        raise ValueError("engine bug")
+
+    results, errors = _coalesce_batch(q, 6, broken)
+    assert results == {} and set(errors) == set(range(1, 7))
+    assert calls["n"] == 1
+    assert q.stats()["splits"] == 0
+
+
+def test_width_cap_chains_batches():
+    """An oversized queue dispatches as back-to-back width-capped batches
+    (compiled-shape reuse), in FIFO order, with every rider served."""
+    from surrealdb_tpu.dbs.dispatch import DispatchQueue
+
+    q = DispatchQueue(max_width=4)
+    results, errors = _coalesce_batch(q, 10, lambda xs: [x * 10 for x in xs])
+    assert errors == {} and results == {i: i * 10 for i in range(1, 11)}
+    widths = q.width_distribution()
+    assert widths == {1: 1, 4: 2, 2: 1}  # leader, then 4+4+2 chained
+    st = q.stats()
+    assert st["dispatches"] == 4 and st["batched"] == 7
+
+
+def test_pipeline_depth_bounds_inflight_batches():
+    """At most `pipeline_depth` batches are launched-but-uncollected per
+    bucket: the depth+1'th leader blocks on the semaphore until a collect
+    completes — and proceeds as soon as one does."""
+    from surrealdb_tpu.dbs.dispatch import DispatchQueue
+
+    q = DispatchQueue(max_width=1, pipeline_depth=2)
+    launched = {i: threading.Event() for i in (1, 2, 3)}
+    release = {i: threading.Event() for i in (1, 2, 3)}
+
+    def make_runner(i):
+        def runner(xs):
+            launched[i].set()
+
+            def collect():
+                assert release[i].wait(10)
+                return [x * 10 for x in xs]
+
+            return collect
+
+        return runner
+
+    results = {}
+    ts = []
+    for i in (1, 2, 3):
+        t = threading.Thread(
+            target=lambda i=i: results.__setitem__(i, q.submit("k", i, make_runner(i)))
+        )
+        t.start()
+        ts.append(t)
+        if i < 3:
+            assert launched[i].wait(5)  # serialize arrival order
+    # batches 1 and 2 are in flight (collect pending); batch 3 must wait
+    assert not launched[3].wait(0.3)
+    release[1].set()  # finish batch 1's collect -> slot frees
+    assert launched[3].wait(5)
+    release[2].set()
+    release[3].set()
+    for t in ts:
+        t.join(10)
+    assert results == {1: 10, 2: 20, 3: 30}
+    assert q.stats()["pipeline_wait_s"] > 0
+
+
+def test_collect_phase_transient_failure_split_retried(monkeypatch):
+    """A transient failure in the COLLECT phase of a wide two-phase batch
+    goes through the same bisection as a launch failure."""
+    monkeypatch.setattr(cnf, "DISPATCH_RETRY_BACKOFF_SECS", 0.0)
+    from surrealdb_tpu.dbs.dispatch import DispatchQueue
+
+    q = DispatchQueue(split_floor=1, pipeline_depth=1)
+    state = {"first": True}
+
+    def runner(payloads):
+        if state["first"] and len(payloads) == 4:
+            state["first"] = False
+
+            def bad_collect():
+                raise RuntimeError("RESOURCE_EXHAUSTED: transfer failed")
+
+            return bad_collect
+        return [p * 10 for p in payloads]
+
+    results, errors = _coalesce_batch(q, 4, runner)
+    assert errors == {} and results == {i: i * 10 for i in range(1, 5)}
+    assert q.stats()["splits"] == 1
