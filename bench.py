@@ -63,18 +63,20 @@ import time
 import numpy as np
 
 SCALE = float(os.environ.get("SURREAL_BENCH_SCALE", "1.0"))
-CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5").split(","))
-ROUND = os.environ.get("SURREAL_BENCH_ROUND", "r07")
+CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6").split(","))
+ROUND = os.environ.get("SURREAL_BENCH_ROUND", "r08")
 OUT_PATH = os.environ.get(
     "SURREAL_BENCH_OUT",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), f"bench_results_{ROUND}.json"),
 )
 PROFILE = "--profile" in sys.argv[1:] or os.environ.get("SURREAL_PROFILE") == "1"
-# schema/3 (r7): concurrent-pass lines carry per-query latency percentiles
-# (latency_ms) and per-config batch accounting carries the batch-width
-# distribution (batch.width_dist) + split/pipeline counters — a future
-# throughput collapse must be diagnosable from the artifact alone
-SCHEMA = "surrealdb-tpu-bench/3"
+# schema/4 (r8): adds config 6 — filtered SELECT through the columnar scan
+# path vs the row path on the SAME data (its line carries `scan` accounting:
+# columnar/row strategy counts, lowered/fallback predicate counters,
+# fallback-row totals) — and per-phase timing on the hybrid config
+# (`phases`: knn / filter / expand p50s) so config 4's round-to-round
+# swings are attributable to a phase instead of a guess
+SCHEMA = "surrealdb-tpu-bench/4"
 
 D = 768
 NI = max(int(1_000_000 * SCALE), 1024)  # item corpus (configs 2/4/5)
@@ -126,6 +128,21 @@ def _error_counts() -> dict:
     }
 
 
+def _scan_counts() -> dict:
+    """Columnar-scan path accounting: strategy counts + predicate
+    compile outcomes (idx/column_mirror.py, ops/predicates.py)."""
+    from surrealdb_tpu import telemetry
+
+    out: dict = {}
+    for labels, v in telemetry.counters_matching("scan_strategy").items():
+        out[f"strategy:{dict(labels).get('strategy', '?')}"] = int(v)
+    for labels, v in telemetry.counters_matching("predicate_compile_outcome").items():
+        out[f"predicate:{dict(labels).get('outcome', '?')}"] = int(v)
+    for labels, v in telemetry.counters_matching("knn_prefilter").items():
+        out[f"knn_prefilter:{dict(labels).get('outcome', '?')}"] = int(v)
+    return out
+
+
 def _error_classes() -> dict:
     """Per-class error/retry totals across every error-counter family —
     `{family:class: count}` (the r5 action item: an anomalous config must
@@ -171,6 +188,7 @@ def _acct_begin(ds) -> dict:
         "errors": _error_counts(),
         "strategy": _strategy_counts(),
         "classes": _error_classes(),
+        "scan": _scan_counts(),
         "trace_ids": set(tracing.trace_ids()),
     }
 
@@ -222,9 +240,11 @@ def _acct_delta(ds, before: dict) -> dict:
     width_dist = {
         str(w): n - w0.get(w, 0) for w, n in sorted(w1.items()) if n - w0.get(w, 0)
     }
+    sc0, sc1 = before["scan"], _scan_counts()
     slow_entries, slow_truncated = _slow_in_window(before["t0"])
     return {
         "errors": {k: e1[k] - e0[k] for k in e1},
+        "scan": {k: v - sc0.get(k, 0) for k, v in sc1.items() if v - sc0.get(k, 0)},
         "error_breakdown": {
             k: v - c0.get(k, 0) for k, v in c1.items() if v - c0.get(k, 0)
         },
@@ -302,7 +322,8 @@ def vec_rows(vecs, ids, flag_every=0):
     # no tolist()/asarray round trip per row
     rows = []
     for j, i in enumerate(ids):
-        r = {"id": int(i), "emb": vecs[j]}
+        # `val` feeds config 6's selective filtered-SELECT predicate
+        r = {"id": int(i), "emb": vecs[j], "val": int(i) % 1000}
         if flag_every:
             r["flag"] = bool(i % flag_every == 0)
         rows.append(r)
@@ -772,6 +793,29 @@ def bench_hybrid(ds, s, corpus, rng):
     queries = [(sql, {"q": qs[i].tolist()}) for i in range(nq)]
     qps, p50, _ = timed_queries(ds, s, queries, warmup=1)
 
+    # phase attribution (the config-4 variance ROADMAP item): time the
+    # statement's knn / +filter / +expand prefixes per query, so a
+    # round-to-round swing names its phase instead of staying a mystery.
+    # filter_ms/expand_ms are deltas between successive prefixes (same
+    # engine path each adds one clause).
+    sql_knn = "SELECT id FROM item WHERE emb <|16,64|> $q"
+    sql_filt = "SELECT id FROM item WHERE emb <|16,64|> $q AND flag = true"
+    t_knn, t_filt, t_full = [], [], []
+    for i in range(nq):
+        v = {"q": qs[i].tolist()}
+        t0 = time.perf_counter(); run(ds, s, sql_knn, v); t_knn.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); run(ds, s, sql_filt, v); t_filt.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); run(ds, s, sql, v); t_full.append(time.perf_counter() - t0)
+
+    def p50_of(ts):
+        return sorted(ts)[len(ts) // 2] * 1e3
+
+    phases = {
+        "knn_ms": round(p50_of(t_knn), 2),
+        "filter_ms": round(max(p50_of(t_filt) - p50_of(t_knn), 0.0), 2),
+        "expand_ms": round(max(p50_of(t_full) - p50_of(t_filt), 0.0), 2),
+    }
+
     cpu_mode(True)
     t0 = time.perf_counter()
     for sql_, v in queries[:2]:
@@ -786,10 +830,66 @@ def bench_hybrid(ds, s, corpus, rng):
             "unit": "qps",
             "vs_baseline": round(qps / cpu_qps, 2) if cpu_qps else None,
             "p50_ms": round(p50, 1),
+            "phases": phases,
             "cpu_qps": round(cpu_qps, 3),
         }
     )
     return qps / cpu_qps if cpu_qps else None
+
+
+def bench_filtered_scan(ds, s):
+    """Config 6: filtered SELECT over the mirrored item table — the
+    vectorized columnar WHERE vs the per-row path on the SAME statement and
+    data. Results are asserted identical; value = columnar qps,
+    vs_baseline = speedup over the row path."""
+    from surrealdb_tpu import cnf as _cnf
+
+    # selective predicate (~0.25% of rows): flag cuts 4x, val < 10 cuts 100x
+    sql = "SELECT VALUE id FROM item WHERE flag = true AND val < 10"
+    nq = 12
+
+    def ids(res):
+        return sorted(str(x) for x in res)
+
+    # row-path baseline first (mirror build then can't hide in the timed
+    # columnar pass; the first columnar query below pays it visibly)
+    saved_mirror = _cnf.COLUMN_MIRROR
+    _cnf.COLUMN_MIRROR = False
+    t0 = time.perf_counter()
+    row_res = run(ds, s, sql)[-1]["result"]
+    row_n = 3
+    for _ in range(row_n - 1):
+        run(ds, s, sql)
+    row_qps = row_n / (time.perf_counter() - t0)
+    _cnf.COLUMN_MIRROR = saved_mirror
+
+    col_qps, col_p50, col_results = timed_queries(
+        ds, s, [(sql, None) for _ in range(nq)], warmup=1
+    )
+    same = ids(col_results[0]) == ids(row_res)
+
+    # count-only twin: the mask popcount path never touches a document
+    csql = "SELECT count() FROM item WHERE flag = true AND val < 10 GROUP ALL"
+    t0 = time.perf_counter()
+    cnt = run(ds, s, csql)[-1]["result"]
+    count_ms = (time.perf_counter() - t0) * 1e3
+
+    ratio = col_qps / row_qps if row_qps else None
+    emit(
+        {
+            "metric": f"filtered_scan_{NI}rows",
+            "value": round(col_qps, 2),
+            "unit": "qps",
+            "vs_baseline": round(ratio, 2) if ratio else None,
+            "p50_ms": round(col_p50, 2),
+            "row_path_qps": round(row_qps, 3),
+            "same_results": same,
+            "rows_matched": len(ids(col_results[0])),
+            "count_only_ms": round(count_ms, 2),
+            "count_result": cnt[0]["count"] if cnt else 0,
+        }
+    )
+    return ratio
 
 
 def bench_ml_scan(ds, s, rng):
@@ -940,10 +1040,12 @@ def main() -> None:
     if "3" in CONFIGS:
         ingest_docs(ds, s, rng)
         run_cfg("3", lambda: bench_bm25(ds, s, rng))
-    if CONFIGS & {"2", "4", "5"}:
+    if CONFIGS & {"2", "4", "5", "6"}:
         need_corpus()
     if "5" in CONFIGS:
         run_cfg("5", lambda: bench_ml_scan(ds, s, rng))
+    if "6" in CONFIGS:
+        run_cfg("6", lambda: bench_filtered_scan(ds, s))
     if "4" in CONFIGS:
         ingest_hybrid_edges(ds, s, rng)
         wait_ann_ready(ds)

@@ -1,27 +1,34 @@
 #!/usr/bin/env python
-"""Opt-in perf gate: smoke-scale concurrent-kNN must not collapse.
+"""Opt-in perf gate: smoke-scale concurrent-kNN and filtered-SELECT floors.
 
-Runs bench.py with ONLY config 2 (the north-star concurrent-kNN pass) at a
-smoke scale, then FAILS if the emitted line shows any errors, a concurrent
-qps below the committed floor, or recall@10 below its floor — the collapse
-signatures this gate exists to catch early (VERDICT r5 weak #1). Post-
-ingest statements over 5s are surfaced as a WARNING only: on accelerator-
-less CI containers jax-CPU compiles land mid-window and would trip a hard
-gate without any engine defect (inspect slowest_trace when it fires).
+Runs bench.py with configs 2 and 6 (the north-star concurrent-kNN pass and
+the columnar filtered-SELECT scan) at a smoke scale, then FAILS if:
+  - config 2 shows any errors, concurrent qps below the committed floor,
+    or recall@10 below its floor (the collapse signatures, VERDICT r5);
+  - config 6 shows columnar output diverging from the row path, columnar
+    qps below its floor, or a columnar/row speedup below the ratio floor
+    (the columnar scan path regressing back to per-row work).
+Post-ingest statements over 5s are surfaced as a WARNING only: on
+accelerator-less CI containers jax-CPU compiles land mid-window and would
+trip a hard gate without any engine defect (inspect slowest_trace).
 
 Not part of tier-1 (it is a perf measurement, not a correctness suite):
-run it next to scripts/tier1.sh when touching the dispatch/kNN hot path:
+run it next to scripts/tier1.sh when touching the dispatch/kNN/scan path:
 
     python scripts/bench_gate.py
 
 Env knobs:
-    SURREAL_BENCH_GATE_SCALE    corpus scale for the smoke run (default 0.02)
-    SURREAL_BENCH_GATE_FLOOR    concurrent-kNN qps floor (default 3.0 — half
-                                the worst rate measured on the 2-core CI
-                                container; real hardware clears it by 10x+)
-    SURREAL_BENCH_GATE_RECALL   recall@10 floor (default 0.6 at smoke scale;
-                                tiny corpora probe fewer clustered lists)
-    SURREAL_BENCH_GATE_TIMEOUT  whole-run timeout seconds (default 1200)
+    SURREAL_BENCH_GATE_SCALE       corpus scale for the smoke run (default 0.02)
+    SURREAL_BENCH_GATE_FLOOR       concurrent-kNN qps floor (default 3.0 — half
+                                   the worst rate measured on the 2-core CI
+                                   container; real hardware clears it by 10x+)
+    SURREAL_BENCH_GATE_RECALL      recall@10 floor (default 0.6 at smoke scale;
+                                   tiny corpora probe fewer clustered lists)
+    SURREAL_BENCH_GATE_SCAN_FLOOR  filtered-SELECT columnar qps floor
+                                   (default 20.0)
+    SURREAL_BENCH_GATE_SCAN_RATIO  columnar vs row-path speedup floor
+                                   (default 5.0 — the ISSUE 4 acceptance bar)
+    SURREAL_BENCH_GATE_TIMEOUT     whole-run timeout seconds (default 1200)
 
 Exit code 0 = gate passed; 1 = gate failed (reasons on stderr).
 """
@@ -40,6 +47,8 @@ REPO = os.path.dirname(HERE)
 SCALE = os.environ.get("SURREAL_BENCH_GATE_SCALE", "0.02")
 FLOOR_QPS = float(os.environ.get("SURREAL_BENCH_GATE_FLOOR", "3.0"))
 FLOOR_RECALL = float(os.environ.get("SURREAL_BENCH_GATE_RECALL", "0.6"))
+FLOOR_SCAN_QPS = float(os.environ.get("SURREAL_BENCH_GATE_SCAN_FLOOR", "20.0"))
+FLOOR_SCAN_RATIO = float(os.environ.get("SURREAL_BENCH_GATE_SCAN_RATIO", "5.0"))
 TIMEOUT = int(os.environ.get("SURREAL_BENCH_GATE_TIMEOUT", "1200"))
 
 
@@ -49,12 +58,15 @@ def main() -> int:
     env.update(
         {
             "SURREAL_BENCH_SCALE": SCALE,
-            "SURREAL_BENCH_CONFIGS": "2",
+            "SURREAL_BENCH_CONFIGS": "2,6",
             "SURREAL_BENCH_ROUND": "gate",
             "SURREAL_BENCH_OUT": out,
         }
     )
-    print(f"bench_gate: scale={SCALE} floor={FLOOR_QPS}qps recall>={FLOOR_RECALL}")
+    print(
+        f"bench_gate: scale={SCALE} floor={FLOOR_QPS}qps recall>={FLOOR_RECALL} "
+        f"scan>={FLOOR_SCAN_QPS}qps scan_ratio>={FLOOR_SCAN_RATIO}x"
+    )
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
@@ -114,6 +126,40 @@ def main() -> int:
             file=sys.stderr,
         )
 
+    # ---- config 6: columnar filtered-SELECT floor --------------------
+    scan_line = next(
+        (
+            r
+            for r in art["results"]
+            if str(r.get("config")) == "6"
+            and str(r.get("metric", "")).startswith("filtered_scan")
+        ),
+        None,
+    )
+    scan_summary = None
+    if scan_line is None:
+        failures.append("no config-6 filtered_scan line in artifact")
+    else:
+        if scan_line.get("same_results") is not True:
+            failures.append("filtered_scan: columnar results diverged from row path")
+        sqps = scan_line.get("value") or 0.0
+        if sqps < FLOOR_SCAN_QPS:
+            failures.append(f"filtered_scan qps {sqps} < floor {FLOOR_SCAN_QPS}")
+        ratio = scan_line.get("vs_baseline")
+        if ratio is not None and ratio < FLOOR_SCAN_RATIO:
+            failures.append(
+                f"filtered_scan columnar/row speedup {ratio}x < floor {FLOOR_SCAN_RATIO}x"
+            )
+        serrs = scan_line.get("errors") or {}
+        if any(serrs.values()):
+            failures.append(f"filtered_scan errors != 0: {serrs}")
+        scan_summary = {
+            "qps": sqps,
+            "ratio": ratio,
+            "rows_matched": scan_line.get("rows_matched"),
+            "scan": scan_line.get("scan"),
+        }
+
     summary = {
         "qps": qps,
         "recall_at_10": recall,
@@ -122,6 +168,7 @@ def main() -> int:
         "retries": line.get("retries"),
         "splits": line.get("splits"),
         "width_dist": (line.get("batch") or {}).get("width_dist"),
+        "filtered_scan": scan_summary,
         "artifact": out,
     }
     print(f"bench_gate: {json.dumps(summary)}")

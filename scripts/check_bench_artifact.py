@@ -23,9 +23,14 @@ import os
 import sys
 from typing import List
 
-SCHEMA = "surrealdb-tpu-bench/3"
+SCHEMA = "surrealdb-tpu-bench/4"
 # earlier rounds' committed artifacts stay validatable under their own rules
-KNOWN_SCHEMAS = ("surrealdb-tpu-bench/1", "surrealdb-tpu-bench/2", SCHEMA)
+KNOWN_SCHEMAS = (
+    "surrealdb-tpu-bench/1",
+    "surrealdb-tpu-bench/2",
+    "surrealdb-tpu-bench/3",
+    SCHEMA,
+)
 
 # keys every emitted line must carry (bench.py `emit`)
 RESULT_KEYS = ("metric", "value", "unit", "vs_baseline")
@@ -38,9 +43,15 @@ CONFIG_KEYS_V2 = CONFIG_KEYS + ("error_breakdown", "slowest_trace")
 # carry per-query latency percentiles and the batch-width distribution
 # (the fields that make a qps collapse diagnosable from the artifact)
 CONFIG_KEYS_V3 = CONFIG_KEYS_V2 + ("splits", "slow_over_5s")
+# schema/4 adds per-config columnar-scan accounting; the filtered-scan
+# config line must prove result parity + carry the row-path baseline, and
+# the hybrid line must carry per-phase (knn/filter/expand) timing
+CONFIG_KEYS_V4 = CONFIG_KEYS_V3 + ("scan",)
 BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
 BATCH_KEYS_V3 = BATCH_KEYS + ("width_dist", "pipeline_wait_s")
 LATENCY_KEYS = ("p50", "p95", "p99")
+PHASE_KEYS = ("knn_ms", "filter_ms", "expand_ms")
+FILTERED_SCAN_KEYS = ("row_path_qps", "same_results", "rows_matched")
 # a present (non-null) slowest_trace must be a real trace doc
 TRACE_KEYS = ("trace_id", "duration_ms", "spans")
 
@@ -58,8 +69,11 @@ def validate(path: str) -> List[str]:
     if art.get("schema") not in KNOWN_SCHEMAS:
         problems.append(f"schema is {art.get('schema')!r}, expected one of {KNOWN_SCHEMAS}")
     schema = art.get("schema")
-    v3 = schema == SCHEMA
-    if v3:
+    v4 = schema == SCHEMA
+    v3 = v4 or schema == "surrealdb-tpu-bench/3"
+    if v4:
+        config_keys = CONFIG_KEYS_V4
+    elif v3:
         config_keys = CONFIG_KEYS_V3
     elif schema == "surrealdb-tpu-bench/2":
         config_keys = CONFIG_KEYS_V2
@@ -122,6 +136,25 @@ def validate(path: str) -> List[str]:
                         problems.append(
                             f"{where} ({metric}): latency_ms missing {key!r}"
                         )
+        if v4 and metric.startswith("filtered_scan"):
+            for key in FILTERED_SCAN_KEYS:
+                if key not in r:
+                    problems.append(f"{where} ({metric}): missing {key!r}")
+            if r.get("same_results") is not True:
+                problems.append(
+                    f"{where} ({metric}): same_results must be true "
+                    "(columnar output diverged from the row path)"
+                )
+        if v4 and metric.startswith("hybrid"):
+            ph = r.get("phases")
+            if not isinstance(ph, dict):
+                problems.append(f"{where} ({metric}): missing per-phase timing 'phases'")
+            else:
+                for key in PHASE_KEYS:
+                    if key not in ph:
+                        problems.append(f"{where} ({metric}): phases missing {key!r}")
+        if v4 and "scan" in r and not isinstance(r.get("scan"), dict):
+            problems.append(f"{where} ({metric}): scan accounting must be an object")
         eb = r.get("error_breakdown")
         if "error_breakdown" in r and not (
             isinstance(eb, dict)

@@ -23,6 +23,13 @@ def _env_bool(name: str, default: bool) -> bool:
     return v.lower() in ("1", "true", "yes", "on")
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
 # Execution limits
 MAX_COMPUTATION_DEPTH = _env_int("SURREAL_MAX_COMPUTATION_DEPTH", 120)
 MAX_CONCURRENT_TASKS = _env_int("SURREAL_MAX_CONCURRENT_TASKS", 64)
@@ -100,14 +107,37 @@ DISPATCH_PIPELINE_DEPTH = _env_int("SURREAL_DISPATCH_PIPELINE_DEPTH", 2)
 # the sub-batch is retried whole, once.
 DISPATCH_SPLIT_FLOOR = _env_int("SURREAL_DISPATCH_SPLIT_FLOOR", 4)
 
+# Columnar scan path (idx/column_mirror.py + ops/predicates.py): hot tables'
+# scalar fields are mirrored into typed column arrays so a simple WHERE is
+# ONE vectorized mask evaluation instead of a per-row cond.compute loop.
+COLUMN_MIRROR = _env_bool("SURREAL_COLUMN_MIRROR", True)
+# tables below this row count keep the row path (mirror bookkeeping would
+# cost more than the scan it replaces)
+COLUMN_MIRROR_MIN_ROWS = _env_int("SURREAL_COLUMN_MIRROR_MIN_ROWS", 64)
+# widest field set materialized per table; wider tables mirror the first
+# N fields seen and predicates on the rest fall back per-row
+COLUMN_MIRROR_MAX_FIELDS = _env_int("SURREAL_COLUMN_MIRROR_MAX_FIELDS", 64)
+# nested-path materialization depth (`a.b` = 2); deeper lookups fall back
+COLUMN_MIRROR_MAX_DEPTH = _env_int("SURREAL_COLUMN_MIRROR_MAX_DEPTH", 2)
+# surviving-row block size: docs are fetched and deadlines checked per block
+COLUMN_BLOCK_SIZE = _env_int("SURREAL_COLUMN_BLOCK_SIZE", 4096)
+# ingest-time debounced rebuild (pattern of GRAPH_PREWARM): a commit into a
+# mirrored table arms a timer; when writes quiesce the mirror rebuilds in
+# the background so the next query starts fresh. Query-time rebuilds are
+# rate-limited by the same window (stale + inside the window = row path).
+COLUMN_REBUILD_DEBOUNCE_SECS = _env_float("SURREAL_COLUMN_REBUILD_DEBOUNCE", 0.5)
+# lowerable residual WHERE conjuncts of a kNN statement prefilter the exact
+# search strategies (top-k among matching rows — the reference's condition-
+# checker semantics); IVF strategies keep post-filtering
+KNN_COLUMN_PREFILTER = _env_bool("SURREAL_KNN_COLUMN_PREFILTER", True)
+
+# Row-scan deadline amortization: scan_table/scan_range check the statement
+# deadline every N rows instead of every row (a monotonic clock read per row
+# is measurable GIL-held work on a million-row scan)
+SCAN_DEADLINE_INTERVAL = _env_int("SURREAL_SCAN_DEADLINE_INTERVAL", 256)
+
 # Changefeeds
 CHANGEFEED_GC_INTERVAL_SECS = _env_int("SURREAL_CHANGEFEED_GC_INTERVAL", 10)
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
-
 
 # statements slower than this are counted + logged (slow-query reporting)
 SLOW_QUERY_THRESHOLD_SECS = _env_float("SURREAL_SLOW_QUERY_THRESHOLD", 1.0)

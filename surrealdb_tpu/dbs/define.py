@@ -389,11 +389,13 @@ def remove_compute(ctx, stm) -> Any:
         txn.del_ns(name)
         pre = keys._ns(name)
         txn.delr(pre, prefix_end(pre))
+        txn.touch_scope((name,))
         ds = ctx.ds()
         from surrealdb_tpu.ml.exec import invalidate_ns as _ml_invalidate_ns
 
         txn.on_commit(lambda: ds.graph_mirrors.drop_ns(name))
         txn.on_commit(lambda: ds.index_stores.remove_ns(name))
+        txn.on_commit(lambda: ds.column_mirrors.drop_ns(name))
         txn.on_commit(lambda: _ml_invalidate_ns(ds, name))
         return NONE
     if kind == "database":
@@ -405,11 +407,13 @@ def remove_compute(ctx, stm) -> Any:
         txn.del_db(ns, name)
         pre = keys._db(ns, name)
         txn.delr(pre, prefix_end(pre))
+        txn.touch_scope((ns, name))
         ds = ctx.ds()
         from surrealdb_tpu.ml.exec import invalidate_db as _ml_invalidate_db
 
         txn.on_commit(lambda: ds.graph_mirrors.drop_db(ns, name))
         txn.on_commit(lambda: ds.index_stores.remove_db(ns, name))
+        txn.on_commit(lambda: ds.column_mirrors.drop_db(ns, name))
         txn.on_commit(lambda: _ml_invalidate_db(ds, ns, name))
         return NONE
     if kind == "table":
@@ -421,9 +425,11 @@ def remove_compute(ctx, stm) -> Any:
         txn.del_tb(ns, db, name)
         pre = keys.table_all_prefix(ns, db, name)
         txn.delr(pre, prefix_end(pre))
+        txn.touch_scope((ns, db, name))
         ds = ctx.ds()
         txn.on_commit(lambda: ds.index_stores.remove_table(ns, db, name))
         txn.on_commit(lambda: ds.graph_mirrors.drop_table(ns, db, name))
+        txn.on_commit(lambda: ds.column_mirrors.drop_table(ns, db, name))
         return NONE
     if kind == "field":
         ns, db = ctx.ns_db()

@@ -33,7 +33,7 @@ def info_compute(ctx, stm) -> Any:
             "users": fmt(txn.all_root_users(), _r_user),
             "accesses": fmt(txn.all_accesses(()), _r_access),
             "nodes": {},
-            "system": {},
+            "system": _system_info(),
         }
     if level == "ns":
         ns = ctx.session.ns
@@ -89,6 +89,22 @@ def info_compute(ctx, stm) -> Any:
             raise SurrealError(f"The root user '{user}' does not exist")
         return d if structure else _r_user(d)
     raise SurrealError(f"INFO FOR {level} is not supported")
+
+
+def _system_info() -> Dict[str, Any]:
+    """Embedded-user access to the slow-query ring, error ring, and trace
+    store (ROADMAP item: these were HTTP-only — GET /slow, /traces — which
+    left SDK/embedded deployments blind). INFO FOR ROOT is already gated to
+    root-level users, the same bar as the HTTP endpoints. Traces are the
+    bounded store's summaries; fetch one in full by id via `traces` ->
+    tracing.get_trace (or GET /trace/:id on a server)."""
+    from surrealdb_tpu import telemetry, tracing
+
+    return {
+        "slow_queries": telemetry.slow_queries(),
+        "errors": telemetry.recent_errors(),
+        "traces": tracing.list_traces(limit=50),
+    }
 
 
 # ------------------------------------------------------------------ renderers

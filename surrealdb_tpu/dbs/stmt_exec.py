@@ -72,6 +72,14 @@ def select_compute(ctx, stm) -> Any:
         if fast is not None:
             return _only(stm, fast)
 
+        # filtered count over a mirrored table: one mask popcount, no
+        # documents (idx/column_mirror.py; exact per-row fallback inside)
+        from surrealdb_tpu.idx.column_mirror import try_columnar_count
+
+        fast = try_columnar_count(c, stm, sources)
+        if fast is not None:
+            return _only(stm, fast)
+
         from surrealdb_tpu.idx.planner import plan_sources
 
         sources = plan_sources(c, stm, sources)

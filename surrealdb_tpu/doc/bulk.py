@@ -138,6 +138,9 @@ def _insert_table_batch(ctx, plan: _TablePlan, batch, relation, ignore, out_kind
     txn = ctx.txn()
     ns, db = ctx.ns_db()
     tb = plan.tb
+    # record keyspace written with raw sets below — register the table for
+    # columnar-mirror invalidation (set_record would have done this)
+    txn.touch_table(ns, db, tb)
     # Edge batches re-reference the same endpoint Things E/N times; memoize
     # their msgpack ext encoding so the record serializer packs each endpoint
     # once per batch instead of once per edge (a nested packb call per Thing).

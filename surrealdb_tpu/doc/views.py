@@ -299,6 +299,7 @@ def materialize_view(ctx, view_name: str, sel) -> None:
     txn = ctx.txn()
     pre = keys.thing_prefix(ns, db, view_name)
     txn.delr(pre, prefix_end(pre))
+    txn.touch_table(ns, db, view_name)  # raw range delete of record keys
     txn.ensure_tb(ns, db, view_name)
 
     from surrealdb_tpu.dbs.iterator import scan_table

@@ -506,6 +506,39 @@ class KnnPlan(_KnnExecutorMixin):
         self.target = _target_vector(target)
         self.result = _KnnResult()
         self.strategy = "?"
+        # residual-WHERE mask lowered onto the table's column mirror
+        # (set by the planner): exact strategies prefilter with it
+        self.prefilter = None
+
+    def _prefilter_slot_mask(self, ctx, rids, cap):
+        """(mask over vector-mirror slots, coalescing key tag) — or None
+        when the column mirror can't serve this reader exactly. The mask
+        marks slots whose record satisfies the residual WHERE, so the
+        kernel's top-k is computed among matching rows only."""
+        from surrealdb_tpu import telemetry
+        from surrealdb_tpu.idx.column_mirror import columnar_mask
+
+        res = columnar_mask(ctx, self.tb, self.prefilter)
+        if res is None:
+            telemetry.inc("knn_prefilter", outcome="unavailable")
+            return None
+        mask, needs_row, col = res
+        if needs_row.any():
+            # the mask abstained on mixed-type rows: post-filter semantics
+            # stay (dropping those rows from the search would be wrong)
+            telemetry.inc("knn_prefilter", outcome="mixed_rows")
+            return None
+        perm = col.slot_permutation(rids, cap)
+        ok = perm >= 0
+        out = np.zeros(cap, dtype=bool)
+        out[ok] = mask[perm[ok]]
+        telemetry.inc("knn_prefilter", outcome="applied")
+        # key the dispatch batch by MASK CONTENT, not predicate text: the
+        # same SQL with different $param bindings lowers to different masks,
+        # and a rider must never be served through a leader's tighter mask.
+        # Identical masks (same predicate+constants, same column build)
+        # still coalesce into one launch.
+        return out, (hash(out.tobytes()), id(col))
 
     def explain(self) -> dict:
         idx = self.ix["index"]
@@ -638,6 +671,11 @@ class KnnPlan(_KnnExecutorMixin):
                     # query exactly (no latency cliff, full recall)
                     self.strategy = "exact-device(ivf-training)"
                     key = ("knn-exact", id(matrix), metric, k)
+                    if self.prefilter is not None:
+                        pre = self._prefilter_slot_mask(ctx, rids, len(mask))
+                        if pre is not None:
+                            mask = mask & pre[0]
+                            key = key + pre[1]
 
                     def runner(qs):
                         collect = _exact_device_launch(np.stack(qs), matrix, mask, metric, k)
@@ -676,6 +714,11 @@ class KnnPlan(_KnnExecutorMixin):
                 self.strategy = "exact-device"
                 matrix, mask, rids = mirror.device_snapshot()
                 key = ("knn-exact", id(matrix), metric, k)
+                if self.prefilter is not None:
+                    pre = self._prefilter_slot_mask(ctx, rids, len(mask))
+                    if pre is not None:
+                        mask = mask & pre[0]
+                        key = key + pre[1]
 
                 def runner(qs):
                     collect = _exact_device_launch(np.stack(qs), matrix, mask, metric, k)
@@ -715,6 +758,15 @@ class KnnPlan(_KnnExecutorMixin):
                 else:
                     self.strategy = "exact-host"
                     data, norms, rids = mirror.host_search_view()
+                    if self.prefilter is not None:
+                        pre = self._prefilter_slot_mask(ctx, rids, len(rids))
+                        if pre is not None:
+                            sel = np.nonzero(pre[0])[0]
+                            if sel.size == 0:
+                                return
+                            data, norms = data[sel], norms[sel]
+                            rids = [rids[int(i)] for i in sel]
+                            k = min(k, sel.size)
                     dists, li = D.knn_search_host(
                         q[None, :], data, metric, k, x_sq_norms=norms
                     )

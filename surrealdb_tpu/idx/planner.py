@@ -281,6 +281,9 @@ def plan_sources(ctx, stm, sources: List[Any]) -> List[Any]:
                 strategy = type(plan).__name__
                 telemetry.inc("plan_strategy", strategy=strategy)
                 note = {"table": s.tb, "plan": strategy}
+                if strategy == "ColumnScanPlan":
+                    # a slow columnar statement must name what was lowered
+                    note["predicate"] = plan.compiled.source
                 if isinstance(plan, KnnPlan):
                     # a kNN statement's latency is governed by the dispatch
                     # pipeline: pin the active knobs into the plan note so a
@@ -298,6 +301,17 @@ def plan_sources(ctx, stm, sources: List[Any]) -> List[Any]:
 
 
 def build_plan(ctx, stm, tb: str, with_) -> Optional[Any]:
+    plan = _build_index_plan(ctx, stm, tb, with_)
+    if plan is not None:
+        return plan
+    # no servable index shape: a simple WHERE can still leave the per-row
+    # path for the vectorized columnar scan (idx/column_mirror.py)
+    from surrealdb_tpu.idx.column_mirror import column_scan_plan
+
+    return column_scan_plan(ctx, stm, tb)
+
+
+def _build_index_plan(ctx, stm, tb: str, with_) -> Optional[Any]:
     ns, db = ctx.ns_db()
     txn = ctx.txn()
     indexes = txn.all_tb_indexes(ns, db, tb)
@@ -315,6 +329,8 @@ def build_plan(ctx, stm, tb: str, with_) -> Optional[Any]:
     if knn is not None:
         plan = _plan_knn(ctx, tb, indexes, knn)
         if plan is not None:
+            if isinstance(plan, KnnPlan):
+                _attach_knn_prefilter(ctx, plan, cond, knn)
             return plan
     matches = _find_operator(cond, MatchesOp)
     if matches is not None:
@@ -362,6 +378,42 @@ def _static_limit(ctx, stm) -> Optional[int]:
     except (TypeError, ValueError):
         return None
     return (limit + start) if limit is not None else None
+
+
+def _attach_knn_prefilter(ctx, plan, cond, knn) -> None:
+    """Lower the WHERE conjuncts AROUND the kNN operator onto the table's
+    column mirror: the exact search strategies then mask non-matching rows
+    out BEFORE top-k (the reference's condition-checker semantics — k
+    results that all match — instead of post-filtering the top-k down)."""
+    from surrealdb_tpu import cnf as _cnf
+
+    if not (_cnf.KNN_COLUMN_PREFILTER and _cnf.COLUMN_MIRROR):
+        return
+    residual = _strip_operator(cond, knn)
+    if residual is None:
+        return
+    from surrealdb_tpu.iam.check import perms_apply
+
+    if perms_apply(ctx):
+        return
+    from surrealdb_tpu.ops.predicates import compile_where
+
+    plan.prefilter = compile_where(ctx, residual)
+
+
+def _strip_operator(expr, op_node):
+    """The condition tree minus one operator reachable through ANDs."""
+    if expr is op_node:
+        return None
+    if isinstance(expr, BinaryOp) and expr.op in ("&&", "AND"):
+        l = _strip_operator(expr.l, op_node)
+        r = _strip_operator(expr.r, op_node)
+        if l is None:
+            return r
+        if r is None:
+            return l
+        return BinaryOp(expr.op, l, r)
+    return expr
 
 
 def _find_operator(expr, klass):

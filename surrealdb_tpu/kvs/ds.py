@@ -38,6 +38,12 @@ class Datastore:
         # ingest-time mirror builds + count-kernel prewarm need a Datastore
         # to open scan transactions from the background timer thread
         self.graph_mirrors.bind_ds(self)
+        # columnar table mirrors backing the vectorized WHERE/projection
+        # scan path (idx/column_mirror.py)
+        from surrealdb_tpu.idx.column_mirror import ColumnMirrors
+
+        self.column_mirrors = ColumnMirrors()
+        self.column_mirrors.bind_ds(self)
         # cross-query device dispatch coalescing (dbs/dispatch.py)
         self.dispatch = DispatchQueue()
         # background index builds (DEFINE INDEX ... CONCURRENTLY)
@@ -73,6 +79,7 @@ class Datastore:
             self.backend.transaction(write), self.oracle, self.clock, self.graph_mirrors
         )
         txn._index_stores = self.index_stores
+        txn._column_mirrors = self.column_mirrors
         txn._commit_lock = self.commit_lock
         return txn
 

@@ -35,7 +35,14 @@ class Transaction:
         self.graph_deltas: List[tuple] = []
         self.vector_deltas: List[tuple] = []
         self.ft_deltas: List[tuple] = []
+        # tables whose RECORD keyspace this txn wrote (set_record/del_record/
+        # bulk ingest) + coarser dropped scopes (REMOVE ns/db/table): at
+        # commit these bump the columnar-mirror version counters so a stale
+        # column mask can never serve (idx/column_mirror.py protocol)
+        self.touched_tables: set = set()
+        self.touched_scopes: set = set()
         self._graph_mirrors = graph_mirrors
+        self._column_mirrors = None  # set by Datastore.transaction
         self._index_stores = None  # set by Datastore.transaction
         # callbacks run strictly after a successful commit (mirror drops on
         # REMOVE …— running them at statement time would let a concurrent
@@ -97,6 +104,8 @@ class Transaction:
                 or self.vector_deltas
                 or self.ft_deltas
                 or self._on_commit
+                or self.touched_tables
+                or self.touched_scopes
             ):
                 with self._commit_lock:
                     self._commit_and_apply()
@@ -104,7 +113,17 @@ class Transaction:
                 self._commit_and_apply()
 
     def _commit_and_apply(self) -> None:
+        cm = self._column_mirrors
+        if cm is not None and (self.touched_tables or self.touched_scopes):
+            # BEFORE the backend commit (and under the datastore commit
+            # lock, see commit()): any reader whose snapshot will include
+            # these writes then provably sees the bumped version too
+            cm.invalidate(self.touched_tables, self.touched_scopes)
         self.tr.commit()
+        if cm is not None and self.touched_tables:
+            cm.schedule_rebuild(self.touched_tables)
+        self.touched_tables = set()
+        self.touched_scopes = set()
         if self.graph_deltas and self._graph_mirrors is not None:
             self._graph_mirrors.apply_deltas(self.graph_deltas)
             self.graph_deltas = []
@@ -598,14 +617,26 @@ class Transaction:
         return keys.db_access(level[0], level[1], ac)
 
     # ------------------------------------------------------------ records
+    def touch_table(self, ns: str, db: str, tb: str) -> None:
+        """Mark a table's record keyspace as written by this transaction
+        (columnar-mirror invalidation; raw-write paths like the bulk ingest
+        call this explicitly)."""
+        self.touched_tables.add((ns, db, tb))
+
+    def touch_scope(self, scope: tuple) -> None:
+        """Coarse invalidation for REMOVE NAMESPACE/DATABASE/TABLE."""
+        self.touched_scopes.add(tuple(scope))
+
     def get_record(self, ns: str, db: str, tb: str, id_: Any) -> Optional[dict]:
         raw = self.tr.get(keys.thing(ns, db, tb, id_))
         return None if raw is None else unpack(raw)
 
     def set_record(self, ns: str, db: str, tb: str, id_: Any, doc: dict) -> None:
+        self.touched_tables.add((ns, db, tb))
         self.tr.set(keys.thing(ns, db, tb, id_), pack(doc))
 
     def del_record(self, ns: str, db: str, tb: str, id_: Any) -> None:
+        self.touched_tables.add((ns, db, tb))
         self.tr.delete(keys.thing(ns, db, tb, id_))
 
     def record_exists(self, ns: str, db: str, tb: str, id_: Any) -> bool:
