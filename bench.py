@@ -64,19 +64,21 @@ import numpy as np
 
 SCALE = float(os.environ.get("SURREAL_BENCH_SCALE", "1.0"))
 CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6").split(","))
-ROUND = os.environ.get("SURREAL_BENCH_ROUND", "r08")
+ROUND = os.environ.get("SURREAL_BENCH_ROUND", "r09")
 OUT_PATH = os.environ.get(
     "SURREAL_BENCH_OUT",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), f"bench_results_{ROUND}.json"),
 )
 PROFILE = "--profile" in sys.argv[1:] or os.environ.get("SURREAL_PROFILE") == "1"
-# schema/4 (r8): adds config 6 — filtered SELECT through the columnar scan
-# path vs the row path on the SAME data (its line carries `scan` accounting:
-# columnar/row strategy counts, lowered/fallback predicate counters,
-# fallback-row totals) — and per-phase timing on the hybrid config
-# (`phases`: knn / filter / expand p50s) so config 4's round-to-round
-# swings are attributable to a phase instead of a guess
-SCHEMA = "surrealdb-tpu-bench/4"
+# schema/5 (r9, the flight recorder): every per-config line carries
+# `bg_tasks` — registry-derived overlap accounting (WHICH background task
+# kinds ran inside the measurement window, with overlap durations and
+# stall flags; replaces the ad-hoc ann_training_overlap boolean) — and
+# `compiles` — the XLA compile events in the window, each attributed
+# prewarm vs on-demand (with the owning trace id). The artifact also
+# embeds a full debug bundle (bundle.py) so a perf number always ships
+# with the engine state that produced it.
+SCHEMA = "surrealdb-tpu-bench/5"
 
 D = 768
 NI = max(int(1_000_000 * SCALE), 1024)  # item corpus (configs 2/4/5)
@@ -242,7 +244,41 @@ def _acct_delta(ds, before: dict) -> dict:
     }
     sc0, sc1 = before["scan"], _scan_counts()
     slow_entries, slow_truncated = _slow_in_window(before["t0"])
+    # flight-recorder overlap accounting (structural, replaces the r6
+    # ann_training_overlap flag): which background tasks ran inside this
+    # window, per kind with overlap durations; plus every XLA compile in
+    # the window with its prewarm/on-demand attribution
+    from surrealdb_tpu import bg, compile_log
+
+    t1 = time.time()
+    win_tasks = bg.window(before["t0"], t1)
+    kinds: dict = {}
+    for t in win_tasks:
+        k = kinds.setdefault(
+            t["kind"], {"count": 0, "overlap_s": 0.0, "stalled": 0}
+        )
+        k["count"] += 1
+        k["overlap_s"] = round(k["overlap_s"] + t.get("overlap_s", 0.0), 4)
+        k["stalled"] += 1 if t["stalled"] else 0
+    win_compiles = [e for e in compile_log.events(since=before["t0"]) if e["ts"] <= t1]
     return {
+        "bg_tasks": {
+            "kinds": kinds,
+            "tasks": [
+                {
+                    "kind": t["kind"], "target": t["target"], "state": t["state"],
+                    "overlap_s": t.get("overlap_s"), "stalled": t["stalled"],
+                    "trace_id": t["trace_id"],
+                }
+                for t in win_tasks[:20]
+            ],
+        },
+        "compiles": {
+            "on_demand": sum(1 for e in win_compiles if e["mode"] == "on_demand"),
+            "prewarm": sum(1 for e in win_compiles if e["mode"] == "prewarm"),
+            "startup": sum(1 for e in win_compiles if e["mode"] == "startup"),
+            "events": win_compiles[:20],
+        },
         "errors": {k: e1[k] - e0[k] for k in e1},
         "scan": {k: v - sc0.get(k, 0) for k, v in sc1.items() if v - sc0.get(k, 0)},
         "error_breakdown": {
@@ -965,13 +1001,6 @@ def main() -> None:
     knn_qps, knn_recall = None, None
     state = {"corpus": None, "warm": None}
 
-    def _ann_training_active() -> bool:
-        """True while the item mirror's background IVF training is running —
-        its dispatches land in whatever per-config accounting window is
-        open, so such windows are flagged in the artifact."""
-        mirror = ds.index_stores.get("bench", "bench", "item", "iemb")
-        return mirror is not None and bool(getattr(mirror, "_ivf_building", False))
-
     # Schedule: least-measured configs first, each config's ingest lazily
     # right before it, and IVF training overlapped with ingest/configs that
     # do not need it (kicked right after the item corpus lands).
@@ -998,10 +1027,10 @@ def main() -> None:
                 log("profiler: unavailable, skipping trace capture")
         # the warmup thread's one kNN query must not leak into this config's
         # accounting window (background IVF training can't be joined without
-        # serializing the schedule — overlap is flagged below instead)
+        # serializing the schedule — any overlap lands STRUCTURALLY in the
+        # window's bg_tasks accounting via the flight recorder)
         if state["warm"] is not None and state["warm"].is_alive():
             state["warm"].join(timeout=120)
-        training_overlap = _ann_training_active()
         acct0 = _acct_begin(ds)
         n0 = len(RESULTS)
         _DEFER = True  # buffer this config's lines so they print enriched
@@ -1019,7 +1048,6 @@ def main() -> None:
         finally:
             _DEFER = False
             acct = _acct_delta(ds, acct0)
-            acct["ann_training_overlap"] = training_overlap or _ann_training_active()
             for e in acct.pop("_slow_entries"):
                 log(
                     f"slow statement ({e.get('duration_s', 0):.3f}s): "
@@ -1079,6 +1107,8 @@ def main() -> None:
     print("=== bench emit block (full replay) ===", flush=True)
     for line in RESULTS:
         print(json.dumps(line), flush=True)
+    from surrealdb_tpu.bundle import debug_bundle
+
     artifact = {
         "schema": SCHEMA,
         "round": ROUND,
@@ -1087,6 +1117,9 @@ def main() -> None:
         "rtt_ms": round(rtt * 1e3, 1),
         "profile_trace": trace_dir if traces else None,
         "results": RESULTS,
+        # the engine state that produced these numbers — task registry,
+        # compile log, mirror staleness, dispatch counters (bundle.py)
+        "bundle": debug_bundle(ds),
     }
     with open(OUT_PATH, "w") as f:
         json.dump(artifact, f, indent=1)

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Compare two bench artifacts and flag per-config / per-phase regressions.
+
+The config-4 (hybrid) vs_baseline number swings round-to-round; since
+schema /4 the hybrid line carries per-phase knn/filter/expand p50s, and
+since /5 every line carries structural background-task overlap + compile
+attribution. This tool turns two artifacts into a culprit list:
+
+    python scripts/bench_diff.py bench_results_r08.json bench_results_r09.json
+    python scripts/bench_diff.py OLD NEW --threshold 0.3
+
+For every config present in both artifacts it reports the headline value
+delta, the latency percentile deltas, and (hybrid) the per-phase deltas —
+naming the phase that moved most. Deltas beyond --threshold (default 0.25
+= 25%) are FLAGGED; when the newer artifact is schema /5 each flagged
+config also cites the background tasks and on-demand compiles that ran in
+its window (the usual suspects). Exit code 1 when anything was flagged,
+0 otherwise (pipe-friendly: use `|| true` where the diff is informational).
+
+Also importable: `diff(old_art, new_art, threshold) -> list[dict]`.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _per_config(art: dict) -> Dict[str, dict]:
+    """First metric line per config (the headline line of its window)."""
+    out: Dict[str, dict] = {}
+    for r in art.get("results") or []:
+        cfg = r.get("config")
+        if cfg is not None and str(cfg) not in out and r.get("value") is not None:
+            out[str(cfg)] = r
+    return out
+
+
+def _rel(old: Optional[float], new: Optional[float]) -> Optional[float]:
+    """Relative delta (new-old)/old, None when not comparable."""
+    try:
+        if old is None or new is None or float(old) == 0.0:
+            return None
+        return (float(new) - float(old)) / abs(float(old))
+    except (TypeError, ValueError):
+        return None
+
+
+def _suspects(line: dict) -> List[str]:
+    """Schema-/5 window evidence for a flagged config: overlapping
+    background tasks and on-demand compiles."""
+    out: List[str] = []
+    bt = line.get("bg_tasks") or {}
+    for kind, agg in (bt.get("kinds") or {}).items():
+        note = f"bg:{kind} x{agg.get('count')} ({agg.get('overlap_s')}s overlap)"
+        if agg.get("stalled"):
+            note += f" [{agg['stalled']} STALLED]"
+        out.append(note)
+    comp = line.get("compiles") or {}
+    if comp.get("on_demand"):
+        out.append(f"{comp['on_demand']} on-demand XLA compile(s) in window")
+    return out
+
+
+def diff(old: dict, new: dict, threshold: float = 0.25) -> List[dict]:
+    """Per-config comparison records; entry["flags"] non-empty = regression
+    beyond threshold. `value` deltas are signed so a qps DROP is negative
+    (durations/latencies flag on increase instead)."""
+    rows: List[dict] = []
+    oc, nc = _per_config(old), _per_config(new)
+    for cfg in sorted(oc.keys() & nc.keys()):
+        o, n = oc[cfg], nc[cfg]
+        entry: Dict[str, Any] = {
+            "config": cfg,
+            "metric": n.get("metric"),
+            "old_value": o.get("value"),
+            "new_value": n.get("value"),
+            "unit": n.get("unit"),
+            "flags": [],
+            "deltas": {},
+        }
+        dv = _rel(o.get("value"), n.get("value"))
+        entry["deltas"]["value"] = dv
+        # higher is better for every headline unit bench emits
+        # (qps / edges/s / rows/s): flag drops
+        if dv is not None and dv < -threshold:
+            entry["flags"].append(f"value dropped {dv * 100:.1f}%")
+        lo, ln = o.get("latency_ms") or {}, n.get("latency_ms") or {}
+        for p in ("p50", "p95", "p99"):
+            dp = _rel(lo.get(p), ln.get(p))
+            if dp is None:
+                continue
+            entry["deltas"][f"latency_{p}"] = dp
+            if dp > threshold:
+                entry["flags"].append(f"latency {p} grew {dp * 100:.1f}%")
+        # per-phase attribution (hybrid): name the culprit phase
+        po, pn = o.get("phases") or {}, n.get("phases") or {}
+        worst: Optional[tuple] = None
+        for ph in ("knn_ms", "filter_ms", "expand_ms"):
+            dp = _rel(po.get(ph), pn.get(ph))
+            if dp is None:
+                continue
+            entry["deltas"][f"phase_{ph}"] = dp
+            if worst is None or dp > worst[1]:
+                worst = (ph, dp)
+            if dp > threshold:
+                entry["flags"].append(f"phase {ph} grew {dp * 100:.1f}%")
+        if worst is not None:
+            entry["culprit_phase"] = worst[0]
+        for counter in ("errors", "retries", "splits"):
+            ov, nv = o.get(counter), n.get(counter)
+            ot = sum(ov.values()) if isinstance(ov, dict) else ov
+            nt = sum(nv.values()) if isinstance(nv, dict) else nv
+            if isinstance(ot, (int, float)) and isinstance(nt, (int, float)) and nt > ot:
+                entry["flags"].append(f"{counter} rose {int(ot)} -> {int(nt)}")
+        if entry["flags"]:
+            entry["suspects"] = _suspects(n)
+        rows.append(entry)
+    return rows
+
+
+def main(argv: List[str]) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="bench_diff.py",
+        description="Compare two bench artifacts; flag per-config/per-phase regressions.",
+    )
+    ap.add_argument("old", help="baseline bench_results_*.json")
+    ap.add_argument("new", help="candidate bench_results_*.json")
+    ap.add_argument(
+        "--threshold", type=float, default=0.25,
+        help="relative delta that flags (default 0.25 = 25%%)",
+    )
+    try:
+        ns = ap.parse_args(argv)
+    except SystemExit:
+        return 2
+    threshold = ns.threshold
+    try:
+        with open(ns.old) as f:
+            old = json.load(f)
+        with open(ns.new) as f:
+            new = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"unreadable artifact: {e}", file=sys.stderr)
+        return 2
+    rows = diff(old, new, threshold)
+    if not rows:
+        print("no comparable configs between the two artifacts", file=sys.stderr)
+        return 2
+    flagged = 0
+    for r in rows:
+        head = (
+            f"config {r['config']} ({r['metric']}): "
+            f"{r['old_value']} -> {r['new_value']} {r['unit']}"
+        )
+        dv = r["deltas"].get("value")
+        if dv is not None:
+            head += f" ({dv * 100:+.1f}%)"
+        if r.get("culprit_phase"):
+            head += f"  culprit phase: {r['culprit_phase']}"
+        print(("FLAG  " if r["flags"] else "ok    ") + head)
+        for fl in r["flags"]:
+            print(f"      - {fl}")
+        for s in r.get("suspects", []):
+            print(f"      suspect: {s}")
+        flagged += bool(r["flags"])
+    print(f"{flagged}/{len(rows)} config(s) flagged (threshold {threshold * 100:.0f}%)")
+    return 1 if flagged else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

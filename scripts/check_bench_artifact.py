@@ -23,12 +23,13 @@ import os
 import sys
 from typing import List
 
-SCHEMA = "surrealdb-tpu-bench/4"
+SCHEMA = "surrealdb-tpu-bench/5"
 # earlier rounds' committed artifacts stay validatable under their own rules
 KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/1",
     "surrealdb-tpu-bench/2",
     "surrealdb-tpu-bench/3",
+    "surrealdb-tpu-bench/4",
     SCHEMA,
 )
 
@@ -47,6 +48,15 @@ CONFIG_KEYS_V3 = CONFIG_KEYS_V2 + ("splits", "slow_over_5s")
 # config line must prove result parity + carry the row-path baseline, and
 # the hybrid line must carry per-phase (knn/filter/expand) timing
 CONFIG_KEYS_V4 = CONFIG_KEYS_V3 + ("scan",)
+# schema/5 (flight recorder): every config line carries structural
+# background-task overlap accounting (`bg_tasks`: which task kinds ran in
+# the window, overlap durations, stall flags) and the window's attributed
+# XLA compile events (`compiles`: on_demand/prewarm counts + events) —
+# the ad-hoc ann_training_overlap flag is gone; the artifact embeds a
+# debug bundle with the six flight-recorder sections
+CONFIG_KEYS_V5 = CONFIG_KEYS_V4 + ("bg_tasks", "compiles")
+BUNDLE_SECTIONS = ("traces", "slow_queries", "errors", "tasks", "compiles", "engine")
+COMPILES_KEYS = ("on_demand", "prewarm", "events")
 BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
 BATCH_KEYS_V3 = BATCH_KEYS + ("width_dist", "pipeline_wait_s")
 LATENCY_KEYS = ("p50", "p95", "p99")
@@ -69,9 +79,12 @@ def validate(path: str) -> List[str]:
     if art.get("schema") not in KNOWN_SCHEMAS:
         problems.append(f"schema is {art.get('schema')!r}, expected one of {KNOWN_SCHEMAS}")
     schema = art.get("schema")
-    v4 = schema == SCHEMA
+    v5 = schema == SCHEMA
+    v4 = v5 or schema == "surrealdb-tpu-bench/4"
     v3 = v4 or schema == "surrealdb-tpu-bench/3"
-    if v4:
+    if v5:
+        config_keys = CONFIG_KEYS_V5
+    elif v4:
         config_keys = CONFIG_KEYS_V4
     elif v3:
         config_keys = CONFIG_KEYS_V3
@@ -80,6 +93,14 @@ def validate(path: str) -> List[str]:
     else:
         config_keys = CONFIG_KEYS
     batch_keys = BATCH_KEYS_V3 if v3 else BATCH_KEYS
+    if v5:
+        bundle = art.get("bundle")
+        if not isinstance(bundle, dict):
+            problems.append("schema/5 artifact missing the embedded debug bundle")
+        else:
+            for sec in BUNDLE_SECTIONS:
+                if sec not in bundle:
+                    problems.append(f"bundle: missing section {sec!r}")
     for key in ("scale", "configs", "results"):
         if key not in art:
             problems.append(f"missing top-level key {key!r}")
@@ -155,6 +176,32 @@ def validate(path: str) -> List[str]:
                         problems.append(f"{where} ({metric}): phases missing {key!r}")
         if v4 and "scan" in r and not isinstance(r.get("scan"), dict):
             problems.append(f"{where} ({metric}): scan accounting must be an object")
+        if v5:
+            bt = r.get("bg_tasks")
+            if not (
+                isinstance(bt, dict)
+                and isinstance(bt.get("kinds"), dict)
+                and isinstance(bt.get("tasks"), list)
+            ):
+                problems.append(
+                    f"{where} ({metric}): bg_tasks must carry 'kinds' + 'tasks'"
+                )
+            comp = r.get("compiles")
+            if not isinstance(comp, dict):
+                problems.append(f"{where} ({metric}): compiles must be an object")
+            else:
+                for key in COMPILES_KEYS:
+                    if key not in comp:
+                        problems.append(f"{where} ({metric}): compiles missing {key!r}")
+                for j, e in enumerate(comp.get("events") or []):
+                    # the acceptance bar: an on-demand compile with no owning
+                    # trace is exactly the unexplained latency swing the
+                    # flight recorder exists to eliminate
+                    if e.get("mode") == "on_demand" and not e.get("trace_id"):
+                        problems.append(
+                            f"{where} ({metric}): compiles.events[{j}] is "
+                            "on_demand but cites no trace_id"
+                        )
         eb = r.get("error_breakdown")
         if "error_breakdown" in r and not (
             isinstance(eb, dict)

@@ -4,13 +4,28 @@
 # pytest's; the log lands in /tmp/_t1.log and a DOTS_PASSED recount is
 # printed (driver-proof pass counting independent of the summary line).
 #
+# On a non-zero exit the suite dumps a flight-recorder bundle (task
+# registry, compile log, slow/error rings, traces) to /tmp/_t1_bundle.json
+# via the conftest sessionfinish hook, so failed runs carry their own
+# diagnostics. If the process died before the hook could run, a skeleton
+# bundle is captured from a fresh interpreter as a fallback.
+#
 # Opt-in perf companion (run when touching the dispatch/kNN hot path):
 #   python scripts/bench_gate.py   # smoke-scale concurrent-kNN floor gate
 set -o pipefail
-rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+rm -f /tmp/_t1.log /tmp/_t1_bundle.json
+timeout -k 10 870 env JAX_PLATFORMS=cpu SURREAL_T1_BUNDLE=/tmp/_t1_bundle.json \
+  python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+if [ "$rc" -ne 0 ]; then
+  if [ ! -s /tmp/_t1_bundle.json ]; then
+    # the hook never ran (hard crash / timeout): best-effort skeleton dump
+    python -c "from surrealdb_tpu.bundle import write_bundle; write_bundle('/tmp/_t1_bundle.json')" \
+      2>/dev/null || true
+  fi
+  [ -s /tmp/_t1_bundle.json ] && echo "flight-recorder bundle: /tmp/_t1_bundle.json"
+fi
 exit $rc

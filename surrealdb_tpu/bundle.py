@@ -1,0 +1,169 @@
+"""One-shot debug bundle: the engine's whole observability state as JSON.
+
+`debug_bundle(ds)` snapshots every flight-recorder surface into a single
+versioned document — the artifact you attach to any perf report:
+
+1. `traces`        — trace-store summaries + the newest full span trees;
+2. `slow_queries`  — the structured slow-statement ring;
+3. `errors`        — the bounded error ring (trace-id joined);
+4. `tasks`         — the background-task registry (bg.py): live, recent,
+                     stalled counts, watchdog state;
+5. `compiles`      — the XLA compile-event log (compile_log.py):
+                     prewarm vs on-demand, per-shape cache hits;
+6. `engine`        — dispatch stats + width distribution, column-mirror /
+                     graph-CSR / vector-mirror staleness states, and
+                     per-subsystem mirror memory watermarks.
+
+Served by `GET /debug/bundle` (system-user-gated) and embedded via
+`INFO FOR ROOT` (`system.bundle`); bench.py embeds one per artifact so a
+perf number always ships with the engine state that produced it. Works
+with `ds=None` too (global registries only) — the tier-1 failure hook
+uses that to dump diagnostics from a dying test process.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+BUNDLE_SCHEMA = "surrealdb-tpu-bundle/1"
+
+# the six sections every consumer may rely on
+SECTIONS = ("traces", "slow_queries", "errors", "tasks", "compiles", "engine")
+
+
+def debug_bundle(
+    ds=None, trace_limit: int = 50, full_traces: int = 10
+) -> Dict[str, Any]:
+    from surrealdb_tpu import bg, compile_log, telemetry, tracing
+
+    ids = tracing.trace_ids()
+    docs = []
+    # NB: full_traces=0 must mean "no docs" — a bare ids[-0:] is the WHOLE list
+    for tid in ids[-full_traces:] if full_traces > 0 else ():
+        doc = tracing.get_trace(tid)
+        if doc is not None:
+            docs.append(doc)
+    out: Dict[str, Any] = {
+        "schema": BUNDLE_SCHEMA,
+        "ts": time.time(),
+        "node_id": str(ds.node_id) if ds is not None else None,
+        "traces": {
+            "summaries": tracing.list_traces(limit=trace_limit),
+            "docs": docs,
+        },
+        "slow_queries": telemetry.slow_queries(),
+        "errors": telemetry.recent_errors(),
+        "tasks": bg.snapshot(),
+        "compiles": compile_log.snapshot(),
+        "engine": _engine_state(ds),
+    }
+    return out
+
+
+def _engine_state(ds) -> Dict[str, Any]:
+    """Dispatch + mirror section: the state that decides whether the next
+    query pays a build/compile cliff or serves warm."""
+    from surrealdb_tpu import telemetry
+
+    if ds is None:
+        return {"dispatch": None, "column_mirrors": {}, "graph": {},
+                "vector_indexes": {}, "memory_bytes": {}}
+    out: Dict[str, Any] = {
+        "dispatch": {
+            "stats": ds.dispatch.stats(),
+            "width_distribution": {
+                str(w): n for w, n in sorted(ds.dispatch.width_distribution().items())
+            },
+        },
+        "column_mirrors": _column_state(ds),
+        "graph": _graph_state(ds),
+        "vector_indexes": _vector_state(ds),
+    }
+    try:
+        out["memory_bytes"] = telemetry.mirror_memory_bytes(ds)
+    except Exception:  # noqa: BLE001 — a bundle must never fail its caller
+        out["memory_bytes"] = {}
+    return out
+
+
+def _column_state(ds) -> Dict[str, Any]:
+    cm = getattr(ds, "column_mirrors", None)
+    if cm is None:
+        return {}
+    now = time.monotonic()
+    out: Dict[str, Any] = {}
+    with cm._lock:  # noqa: SLF001 — read-only snapshot within the package
+        mirrors = dict(cm._mirrors)  # noqa: SLF001
+        versions = dict(cm.versions)
+        pending = set(cm._timers)  # noqa: SLF001
+    for key3, m in mirrors.items():
+        cur = versions.get(key3, 0)
+        out[".".join(key3)] = {
+            "rows": m.n,
+            "columns": len(m.columns),
+            "built_version": m.built_version,
+            "current_version": cur,
+            "stale": m.built_version != cur,
+            "rebuild_armed": key3 in pending,
+            "age_s": round(now - m.build_time, 3) if m.build_time else None,
+        }
+    return out
+
+
+def _graph_state(ds) -> Dict[str, Any]:
+    gm = getattr(ds, "graph_mirrors", None)
+    if gm is None:
+        return {}
+    with gm._lock:  # noqa: SLF001
+        built = sorted(".".join(k) for k in gm._built)  # noqa: SLF001
+        prewarm_pending = sorted(
+            ".".join(k) for k in gm._prewarm_timers  # noqa: SLF001
+        )
+        mirrors = {
+            f"{k[2]}:{k[3].decode() if isinstance(k[3], bytes) else k[3]}:{k[4]}": {
+                "edges": m.edge_count,
+                "dirty": m.dirty,
+                "max_degree": m.max_degree,
+            }
+            for k, m in gm._m.items()  # noqa: SLF001
+        }
+    return {
+        "built_tables": built,
+        "prewarm_pending": prewarm_pending,
+        "mirrors": mirrors,
+    }
+
+
+def _vector_state(ds) -> Dict[str, Any]:
+    stores = getattr(ds, "index_stores", None)
+    if stores is None:
+        return {}
+    with stores._lock:  # noqa: SLF001
+        items = list(stores._stores.items())  # noqa: SLF001
+    out: Dict[str, Any] = {}
+    for key, m in items:
+        if not hasattr(m, "ivf_status"):
+            continue
+        entry: Dict[str, Any] = {"rows": m.count() if hasattr(m, "count") else None}
+        try:
+            entry["ann"] = m.ivf_status()
+        except Exception:  # noqa: BLE001
+            pass
+        out[".".join(key)] = entry
+    return out
+
+
+def write_bundle(path: str, ds=None) -> Optional[str]:
+    """Dump a bundle to `path` (JSON, default=str for stray types); returns
+    the path, or None when the dump failed. Used by the tier-1 failure
+    hook — diagnostics capture must never raise inside a dying process."""
+    import json
+
+    try:
+        with open(path, "w") as f:
+            json.dump(debug_bundle(ds), f, indent=1, default=str)
+            f.write("\n")
+        return path
+    except Exception:  # noqa: BLE001
+        return None

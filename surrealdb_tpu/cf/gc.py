@@ -15,27 +15,40 @@ from surrealdb_tpu.utils.ser import unpack
 
 
 def gc_all(ds) -> int:
-    """One GC sweep over every database; returns entries deleted."""
+    """One GC sweep over every database; returns entries deleted. Each
+    sweep is a flight-recorder task (bg.py): the server tick loop runs it
+    unsupervised, so a wedged sweep must surface as `stalled`, not as an
+    unexplained commit-lock stall."""
+    from surrealdb_tpu import bg
+
+    task_id = bg.register(
+        "changefeed_gc", target=ds.path, owner=id(ds), trace_id=None
+    )
     deleted = 0
-    txn = ds.transaction(write=True)
-    try:
-        now = ds.clock.now_nanos()
-        for ns_def in txn.all_ns():
-            ns = ns_def["name"]
-            for db_def in txn.all_db(ns):
-                db = db_def["name"]
-                retention = _max_retention(txn, ns, db, db_def)
-                if retention is None:
-                    continue
-                watermark = now - retention
-                deleted += _gc_db(txn, ns, db, watermark)
-        if deleted:
-            txn.commit()
-        else:
+    with bg.run(task_id, rename_thread=False):
+        txn = ds.transaction(write=True)
+        try:
+            now = ds.clock.now_nanos()
+            for ns_def in txn.all_ns():
+                ns = ns_def["name"]
+                for db_def in txn.all_db(ns):
+                    db = db_def["name"]
+                    retention = _max_retention(txn, ns, db, db_def)
+                    if retention is None:
+                        continue
+                    watermark = now - retention
+                    deleted += _gc_db(txn, ns, db, watermark)
+            if deleted:
+                txn.commit()
+            else:
+                txn.cancel()
+        except BaseException:
             txn.cancel()
-    except BaseException:
-        txn.cancel()
-        raise
+            raise
+    if not deleted:
+        # an uneventful sweep (the overwhelmingly common case on the 10s
+        # tick) must not flood the bounded finished-task ring
+        bg.forget(task_id)
     return deleted
 
 

@@ -161,6 +161,15 @@ TRACE_SAMPLE = _env_float("SURREAL_TRACE_SAMPLE", 0.02)
 TRACE_STORE_SIZE = _env_int("SURREAL_TRACE_STORE_SIZE", 512)
 TRACE_MAX_SPANS = _env_int("SURREAL_TRACE_MAX_SPANS", 512)
 
+# Flight recorder (bg.py + compile_log.py): background-task registry with
+# a watchdog that flips tasks to `stalled` past a per-kind deadline, and a
+# bounded XLA compile-event log (prewarm vs on-demand attribution).
+BG_WATCHDOG = _env_bool("SURREAL_BG_WATCHDOG", True)
+BG_WATCHDOG_INTERVAL_SECS = _env_float("SURREAL_BG_WATCHDOG_INTERVAL", 1.0)
+BG_WATCHDOG_DEADLINE_SECS = _env_float("SURREAL_BG_WATCHDOG_DEADLINE", 120.0)
+BG_REGISTRY_CAP = _env_int("SURREAL_BG_REGISTRY_CAP", 512)
+COMPILE_LOG_CAP = _env_int("SURREAL_COMPILE_LOG_CAP", 512)
+
 # Websocket / server
 # largest accepted HTTP request body (model imports carry inline weights)
 HTTP_MAX_BODY_SIZE = _env_int("SURREAL_HTTP_MAX_BODY_SIZE", 64 * 1024 * 1024)

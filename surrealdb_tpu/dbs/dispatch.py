@@ -294,10 +294,17 @@ class DispatchQueue:
                 r.trace_ctx, "dispatch_queue_wait", {"batch": len(batch)},
                 r.t_submit, t0 - r.t_submit,
             )
+        from surrealdb_tpu import compile_log
+
         try:
             # detached: the leader thread's own trace must not swallow the
-            # kernel spans — they are stamped onto every rider below
-            with tracing.detached(), telemetry.span(
+            # kernel spans — they are stamped onto every rider below. An
+            # on-demand XLA compile inside the launch is attributed to the
+            # FIRST rider's trace (compile_log.attribution): exactly one
+            # trace carries the compile span, the rest see a cache hit.
+            with tracing.detached(), compile_log.attribution(
+                batch[0].trace_ctx
+            ), telemetry.span(
                 "dispatch_launch"
             ), telemetry.trace_annotation("dispatch_launch"):
                 res = runner(payloads)
@@ -326,7 +333,9 @@ class DispatchQueue:
         def collect() -> None:
             t1 = _time.perf_counter()
             try:
-                with tracing.detached(), telemetry.span(
+                with tracing.detached(), compile_log.attribution(
+                    batch[0].trace_ctx
+                ), telemetry.span(
                     "dispatch_collect"
                 ), telemetry.trace_annotation("dispatch_collect"):
                     results = res()
@@ -351,10 +360,10 @@ class DispatchQueue:
     # ------------------------------------------------------------ retry
     def _run_whole(self, sub: List[_Req]) -> Sequence[Any]:
         """One full re-execution (launch + collect) of a sub-batch."""
-        from surrealdb_tpu import tracing
+        from surrealdb_tpu import compile_log, tracing
 
         payloads = [r.payload for r in sub]
-        with tracing.detached():
+        with tracing.detached(), compile_log.attribution(sub[0].trace_ctx):
             res = sub[0].runner(payloads)
             return res() if callable(res) else res
 

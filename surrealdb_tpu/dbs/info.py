@@ -33,7 +33,7 @@ def info_compute(ctx, stm) -> Any:
             "users": fmt(txn.all_root_users(), _r_user),
             "accesses": fmt(txn.all_accesses(()), _r_access),
             "nodes": {},
-            "system": _system_info(),
+            "system": _system_info(ctx.ds()),
         }
     if level == "ns":
         ns = ctx.session.ns
@@ -91,19 +91,26 @@ def info_compute(ctx, stm) -> Any:
     raise SurrealError(f"INFO FOR {level} is not supported")
 
 
-def _system_info() -> Dict[str, Any]:
-    """Embedded-user access to the slow-query ring, error ring, and trace
-    store (ROADMAP item: these were HTTP-only — GET /slow, /traces — which
-    left SDK/embedded deployments blind). INFO FOR ROOT is already gated to
-    root-level users, the same bar as the HTTP endpoints. Traces are the
-    bounded store's summaries; fetch one in full by id via `traces` ->
+def _system_info(ds=None) -> Dict[str, Any]:
+    """Embedded-user access to the slow-query ring, error ring, trace
+    store, and the full flight-recorder bundle (these were HTTP-only —
+    GET /slow, /traces, /debug/bundle — which left SDK/embedded
+    deployments blind). INFO FOR ROOT is already gated to root-level
+    users, the same bar as the HTTP endpoints. Traces are the bounded
+    store's summaries; fetch one in full by id via `traces` ->
     tracing.get_trace (or GET /trace/:id on a server)."""
     from surrealdb_tpu import telemetry, tracing
+    from surrealdb_tpu.bundle import debug_bundle
 
     return {
         "slow_queries": telemetry.slow_queries(),
         "errors": telemetry.recent_errors(),
         "traces": tracing.list_traces(limit=50),
+        # the flight-recorder bundle for embedded users. full_traces=0: the
+        # rings/summaries above already cover them, and re-materializing the
+        # newest full span trees would double this (routine, root-gated)
+        # statement's serialization cost; fetch a tree by id via `traces`.
+        "bundle": debug_bundle(ds, full_traces=0),
     }
 
 

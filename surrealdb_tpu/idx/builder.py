@@ -50,8 +50,15 @@ class IndexBuilder:
             if self._status.get(key, {}).get("status") in ("started", "indexing"):
                 return  # already building
             self._status[key] = {"status": "started", "count": 0}
+        from surrealdb_tpu import bg
+
+        task_id = bg.register(
+            "index_build", target=f"{tb}.{ix['name']}", owner=id(self.ds)
+        )
         t = threading.Thread(
-            target=self._run, args=(key, ns, db, tb, ix, session), daemon=True
+            target=self._run, args=(key, ns, db, tb, ix, session, task_id),
+            name=f"bg:index_build:{tb}.{ix['name']}",
+            daemon=True,
         )
         t.start()
 
@@ -86,7 +93,15 @@ class IndexBuilder:
                 txn.cancel()
                 raise
 
-    def _run(self, key, ns, db, tb, ix, session) -> None:
+    def _run(self, key, ns, db, tb, ix, session, task_id=None) -> None:
+        from surrealdb_tpu import bg
+
+        if task_id is None:
+            task_id = bg.register("index_build", target=f"{tb}.{ix['name']}")
+        with bg.run(task_id):
+            self._run_inner(key, ns, db, tb, ix, session)
+
+    def _run_inner(self, key, ns, db, tb, ix, session) -> None:
         from surrealdb_tpu.idx.index import extract_index_values, _apply
 
         name = ix["name"]

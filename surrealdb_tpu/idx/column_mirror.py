@@ -247,12 +247,16 @@ class ColumnMirrors:
         self._timers: Dict[Tuple[str, str, str], threading.Timer] = {}
         self._deadlines: Dict[Tuple[str, str, str], float] = {}
         self._running: Set[Tuple[str, str, str]] = set()
+        # flight-recorder task ids of armed rebuilds (bg.py lifecycle)
+        self._task_ids: Dict[Tuple[str, str, str], int] = {}
+        self._owner: Optional[int] = None  # id(ds), for bg teardown scoping
 
     # ------------------------------------------------------------ plumbing
     def bind_ds(self, ds) -> None:
         import weakref
 
         self._ds = weakref.ref(ds)
+        self._owner = id(ds)
 
     def get(self, key3) -> Optional[ColumnMirror]:
         with self._lock:
@@ -298,6 +302,8 @@ class ColumnMirrors:
     def schedule_rebuild(self, tables) -> None:
         """Debounced background rebuild for committed-into mirrored tables
         (deadline-advance debounce, the GraphMirrors prewarm pattern)."""
+        from surrealdb_tpu import bg
+
         if self._ds is None:
             return
         delay = cnf.COLUMN_REBUILD_DEBOUNCE_SECS
@@ -310,17 +316,29 @@ class ColumnMirrors:
                 self._deadlines[key3] = now + delay
                 if key3 not in self._timers:
                     armed.append(key3)
+                else:
+                    tid = self._task_ids.get(key3)
+                    if tid is not None:
+                        bg.touch(tid)  # debounce deadline advanced
             for key3 in armed:
+                # flight-recorder record: scheduled now, running when the
+                # debounce fires, linked to the committing request's trace
+                self._task_ids[key3] = bg.register(
+                    "column_mirror", target=".".join(key3), owner=self._owner
+                )
                 self._arm_timer(key3, delay)
 
     def _arm_timer(self, key3, delay: float) -> None:
         timer = threading.Timer(delay, self._rebuild_cb, args=(key3, None))
         timer.args = (key3, timer)
         timer.daemon = True
+        timer.name = f"bg:column_mirror:{key3[2]}"
         self._timers[key3] = timer
         timer.start()
 
     def _rebuild_cb(self, key3, timer) -> None:
+        from surrealdb_tpu import bg
+
         with self._lock:
             if self._timers.get(key3) is not timer:
                 return
@@ -331,13 +349,20 @@ class ColumnMirrors:
             del self._timers[key3]
             self._deadlines.pop(key3, None)
             self._running.add(key3)
+            task_id = self._task_ids.pop(key3, None)
+        if task_id is None:
+            task_id = bg.register(
+                "column_mirror", target=".".join(key3), owner=self._owner,
+                trace_id=None,
+            )
         try:
-            ds = self._ds() if self._ds is not None else None
-            if ds is not None:
-                from surrealdb_tpu import telemetry
+            with bg.run(task_id):
+                ds = self._ds() if self._ds is not None else None
+                if ds is not None:
+                    from surrealdb_tpu import telemetry
 
-                telemetry.inc("column_mirror_rebuilds", cause="ingest_prewarm")
-                self.build(ds, *key3)
+                    telemetry.inc("column_mirror_rebuilds", cause="ingest_prewarm")
+                    self.build(ds, *key3)
         except Exception:
             pass  # best-effort: the lazy query-time path stays intact
         finally:
@@ -354,6 +379,24 @@ class ColumnMirrors:
                     return True
             _time.sleep(0.01)
         return False
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        """Teardown on Datastore.close(): cancel armed timers (resolving
+        their flight-recorder records) and wait out in-flight builds, so
+        no rebuild thread outlives its datastore."""
+        from surrealdb_tpu import bg
+
+        with self._lock:
+            timers = list(self._timers.values())
+            self._timers.clear()
+            self._deadlines.clear()
+            task_ids = list(self._task_ids.values())
+            self._task_ids.clear()
+        for t in timers:
+            t.cancel()
+        for tid in task_ids:
+            bg.cancel(tid, "cancelled: datastore closed")
+        self.wait_rebuild(timeout)
 
     # ------------------------------------------------------------ serve
     def serveable(self, ctx, key3) -> Optional[ColumnMirror]:

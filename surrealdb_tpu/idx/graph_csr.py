@@ -179,6 +179,22 @@ class PointerCsr:
 _JITTED: dict = {}
 
 
+def _dense_shape_key(lanes: int, fsz: int, n0: int, As) -> tuple:
+    """Compile-cache key of the dense count kernel: lane count, frontier
+    pad, source space + each operator's padded dims (what XLA keys on)."""
+    return (lanes, fsz, n0, tuple(tuple(int(d) for d in a.shape) for a in As))
+
+
+def _csc_shape_key(lanes: int, fsz: int, n_cap: int, csc_hops, last_hop) -> tuple:
+    """Compile-cache key of the batched CSC count kernel: per-hop array
+    paddings decide the executable shape."""
+    return (
+        lanes, fsz, n_cap,
+        tuple(int(a.shape[0]) for hop in csc_hops for pair in hop for a in pair),
+        tuple(int(p.shape[0]) for (p,) in last_hop),
+    )
+
+
 def _kernels():
     """Lazily build the jitted hop kernels (keeps jax off the import path).
 
@@ -368,6 +384,9 @@ class GraphMirrors:
         self._prewarm_deadline: Dict[Tuple[str, str, str], float] = {}
         self._prewarm_running: Set[Tuple[str, str, str]] = set()
         self._warmed_pairs: Set[tuple] = set()
+        # flight-recorder task ids of armed prewarms (bg.py lifecycle)
+        self._task_ids: Dict[Tuple[str, str, str], int] = {}
+        self._owner = None  # id(ds), for bg teardown scoping
 
     # ------------------------------------------------------------ plumbing
     def bind_ds(self, ds) -> None:
@@ -376,6 +395,7 @@ class GraphMirrors:
         import weakref
 
         self._ds = weakref.ref(ds)
+        self._owner = id(ds)
 
     def interner(self, ns: str, db: str) -> NodeInterner:
         with self._lock:
@@ -517,6 +537,7 @@ class GraphMirrors:
         timer = threading.Timer(delay, self._prewarm, args=(key3, None))
         timer.args = (key3, timer)  # the callback must recognise itself
         timer.daemon = True
+        timer.name = f"bg:graph_prewarm:{key3[2]}"
         self._prewarm_timers[key3] = timer
         timer.start()
 
@@ -529,6 +550,8 @@ class GraphMirrors:
 
         from surrealdb_tpu import cnf
 
+        from surrealdb_tpu import bg
+
         if not cnf.GRAPH_PREWARM or self._ds is None:
             return
         delay = cnf.GRAPH_PREWARM_DELAY_SECS
@@ -537,7 +560,16 @@ class GraphMirrors:
             for key3 in keys3:
                 self._prewarm_deadline[key3] = now + delay
                 if key3 not in self._prewarm_timers:
+                    # flight-recorder record: scheduled now, running when
+                    # ingest quiesces and the build + kernel compiles start
+                    self._task_ids[key3] = bg.register(
+                        "graph_prewarm", target=".".join(key3), owner=self._owner
+                    )
                     self._arm_timer(key3, delay)
+                else:
+                    tid = self._task_ids.get(key3)
+                    if tid is not None:
+                        bg.touch(tid)
 
     def _prewarm(self, key3: Tuple[str, str, str], timer) -> None:
         """Timer body (background thread): build the table's mirrors, then
@@ -559,13 +591,22 @@ class GraphMirrors:
             del self._prewarm_timers[key3]
             self._prewarm_deadline.pop(key3, None)
             self._prewarm_running.add(key3)
+            task_id = self._task_ids.pop(key3, None)
+        from surrealdb_tpu import bg
+
+        if task_id is None:
+            task_id = bg.register(
+                "graph_prewarm", target=".".join(key3), owner=self._owner,
+                trace_id=None,
+            )
         try:
-            ds = self._ds() if self._ds is not None else None
-            if ds is None:
-                return
-            telemetry.inc("graph_prewarm", stage="build")
-            self.build_table(ds, ns, db, tb)
-            self.warm_count_kernels(ns, db)
+            with bg.run(task_id):
+                ds = self._ds() if self._ds is not None else None
+                if ds is None:
+                    return
+                telemetry.inc("graph_prewarm", stage="build")
+                self.build_table(ds, ns, db, tb)
+                self.warm_count_kernels(ns, db)
         except Exception:
             pass
         finally:
@@ -584,6 +625,24 @@ class GraphMirrors:
                     return True
             _time.sleep(0.01)
         return False
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        """Teardown on Datastore.close(): cancel armed prewarm timers
+        (resolving their flight-recorder records) and wait out in-flight
+        builds, so no prewarm thread outlives its datastore."""
+        from surrealdb_tpu import bg
+
+        with self._lock:
+            timers = list(self._prewarm_timers.values())
+            self._prewarm_timers.clear()
+            self._prewarm_deadline.clear()
+            task_ids = list(self._task_ids.values())
+            self._task_ids.clear()
+        for t in timers:
+            t.cancel()
+        for tid in task_ids:
+            bg.cancel(tid, "cancelled: datastore closed")
+        self.wait_prewarm(timeout)
 
     def warm_count_kernels(self, ns: str, db: str) -> None:
         """Compile the batched count kernels for every composable
@@ -637,15 +696,21 @@ class GraphMirrors:
             except Exception:
                 op = None
             if op is not None:
+                from surrealdb_tpu import compile_log
+
                 n0 = op["ns_pad"]
                 for lanes in lane_set:
                     frs = jnp.asarray(np.full((lanes, fsz), n0, dtype=np.int32))
                     cws = jnp.asarray(np.zeros((lanes, fsz), dtype=np.int32))
                     for c in range(1, max_pairs + 1):
                         try:
-                            dense_kernel(
-                                (op["A"],) * (c - 1), op["outdeg"], frs, cws, n0=n0
-                            )
+                            As = (op["A"],) * (c - 1)
+                            with compile_log.tracked(
+                                "graph_dense",
+                                _dense_shape_key(lanes, fsz, n0, As),
+                                prewarmed=True,
+                            ):
+                                dense_kernel(As, op["outdeg"], frs, cws, n0=n0)
                         except Exception:
                             pass
                 continue
@@ -659,6 +724,8 @@ class GraphMirrors:
                 n_cap = _next_pow2(len(self.interner(ns, db)))
                 csc1, csc2 = m1[0].device_csc(), m2[0].device_csc()
                 ptr2 = m2[0].device_arrays()[0]
+                from surrealdb_tpu import compile_log
+
                 for lanes in lane_set:
                     frs = jnp.asarray(np.full((lanes, fsz), n_cap, dtype=np.int32))
                     cws = jnp.asarray(np.zeros((lanes, fsz), dtype=np.int32))
@@ -669,7 +736,12 @@ class GraphMirrors:
                             ((csc1,) if i % 2 == 0 else (csc2,))
                             for i in range(2 * hops - 1)
                         )
-                        csc_kernel(csc_hops, ((ptr2,),), frs, cws, n_cap=n_cap)
+                        with compile_log.tracked(
+                            "graph_csc",
+                            _csc_shape_key(lanes, fsz, n_cap, csc_hops, ((ptr2,),)),
+                            prewarmed=True,
+                        ):
+                            csc_kernel(csc_hops, ((ptr2,),), frs, cws, n_cap=n_cap)
             except Exception:
                 pass
 
@@ -875,6 +947,8 @@ class GraphMirrors:
         )
 
         def runner(payloads):
+            from surrealdb_tpu import compile_log
+
             B = len(payloads)
             bp = max(_next_pow2(B), cnf.TPU_GRAPH_BATCH_LANES)
             frs = np.full((bp, fsz), n0, dtype=np.int32)
@@ -882,9 +956,12 @@ class GraphMirrors:
             for i, (f, c) in enumerate(payloads):
                 frs[i] = f
                 cws[i] = c
-            out = kernel(
-                As, outdeg, jnp.asarray(frs), jnp.asarray(cws), n0=n0
-            )
+            with compile_log.tracked(
+                "graph_dense", _dense_shape_key(bp, fsz, n0, As)
+            ):
+                out = kernel(
+                    As, outdeg, jnp.asarray(frs), jnp.asarray(cws), n0=n0
+                )
 
             def collect():
                 vals = np.asarray(out)
@@ -955,6 +1032,8 @@ class GraphMirrors:
             )
 
             def runner(payloads):
+                from surrealdb_tpu import compile_log
+
                 B = len(payloads)
                 # fixed lane count: a batch of 1 and a batch of 32 share the
                 # same compiled executable (padding lanes carry zero weights
@@ -965,11 +1044,14 @@ class GraphMirrors:
                 for i, (f, c) in enumerate(payloads):
                     frs[i] = f
                     cws[i] = c
-                out = batch_kernel(
-                    csc_hops, last_hop,
-                    jnp.asarray(frs), jnp.asarray(cws),
-                    n_cap=n_cap,
-                )
+                with compile_log.tracked(
+                    "graph_csc", _csc_shape_key(bp, fsz, n_cap, csc_hops, last_hop)
+                ):
+                    out = batch_kernel(
+                        csc_hops, last_hop,
+                        jnp.asarray(frs), jnp.asarray(cws),
+                        n_cap=n_cap,
+                    )
 
                 def collect():
                     vals = np.asarray(out)
@@ -978,11 +1060,16 @@ class GraphMirrors:
                 return collect
 
             return dispatch.submit(key, (fr, cw), runner)
-        out = chain_kernel(
-            hops, jnp.asarray(fr), jnp.asarray(cw),
-            mds=mds, n_cap=n_cap, out_sizes=out_sizes,
-            count_only=count_only,
-        )
+        from surrealdb_tpu import compile_log
+
+        with compile_log.tracked(
+            "graph_chain", (fsz, n_cap, mds, out_sizes, bool(count_only))
+        ):
+            out = chain_kernel(
+                hops, jnp.asarray(fr), jnp.asarray(cw),
+                mds=mds, n_cap=n_cap, out_sizes=out_sizes,
+                count_only=count_only,
+            )
         if count_only:
             return int(out)
         u = np.asarray(out[0])

@@ -42,6 +42,16 @@ def _start_host_copy(*arrs) -> None:
             pass
 
 
+def _ivf_shape_key(tile, cents, list_rows, matrix, metric, probe_metric, k, nprobe):
+    """Compile-cache key of the fused probe+rerank kernel: every static dim
+    XLA keys its executable cache on (compile_log attribution)."""
+    return (
+        tile, int(matrix.shape[1]), int(matrix.shape[0]), str(matrix.dtype),
+        int(cents.shape[0]), int(list_rows.shape[1]), metric, probe_metric,
+        k, nprobe,
+    )
+
+
 def default_nlists(n: int) -> int:
     """C ≈ sqrt(N), pow2-clamped to [8, 4096]."""
     return min(max(_next_pow2(int(math.sqrt(max(n, 1)))), 8), 4096)
@@ -381,7 +391,7 @@ class IvfState:
 
     def search_batch_launch(
         self, qs: np.ndarray, matrix, metric: str, k: int, nprobe: int,
-        tile: Optional[int] = None,
+        tile: Optional[int] = None, owner=None,
     ):
         """Async probe+rerank: enqueue every tile's kernel + start the
         device→host copies, return a collect() closure that blocks on the
@@ -412,15 +422,21 @@ class IvfState:
             buckets=telemetry.COUNT_BUCKETS,
             path="device",
         )
+        from surrealdb_tpu import compile_log
+
         pending = []
-        for lo, hi in tile_slices(nq, tile):
-            d, r = _ivf_search(
-                jnp.asarray(pad_tail(qs[lo:hi], tile)), cents, list_rows,
-                list_mask, matrix,
-                metric=metric, probe_metric=probe_metric, k=k, nprobe=nprobe,
-            )
-            _start_host_copy(d, r)
-            pending.append((lo, hi, d, r))
+        with compile_log.tracked(
+            "ivf",
+            _ivf_shape_key(tile, cents, list_rows, matrix, metric, probe_metric, k, nprobe),
+        ):
+            for lo, hi in tile_slices(nq, tile):
+                d, r = _ivf_search(
+                    jnp.asarray(pad_tail(qs[lo:hi], tile)), cents, list_rows,
+                    list_mask, matrix,
+                    metric=metric, probe_metric=probe_metric, k=k, nprobe=nprobe,
+                )
+                _start_host_copy(d, r)
+                pending.append((lo, hi, d, r))
 
         def collect() -> Tuple[np.ndarray, np.ndarray]:
             dd = np.empty((nq, k), dtype=np.float32)
@@ -431,18 +447,16 @@ class IvfState:
             return dd, rr
 
         self._warm_tiles(qs.shape[1], cents, list_rows, list_mask, matrix,
-                         metric, probe_metric, k, nprobe, tile)
+                         metric, probe_metric, k, nprobe, tile, owner)
         return collect
 
     def _warm_tiles(self, dim, cents, list_rows, list_mask, matrix,
-                    metric, probe_metric, k, nprobe, served_tile) -> None:
+                    metric, probe_metric, k, nprobe, served_tile, owner=None) -> None:
         """Background-compile the OTHER dispatch tile shapes for these query
         params: a burst of concurrent queries coalesces into 8/64-wide
         batches whose first dispatch would otherwise stall seconds on XLA
         compilation (the r3 concurrent-qps killer). Zero-queries through the
         same kernel carry no correctness risk — results are discarded."""
-        import threading
-
         from surrealdb_tpu.utils.num import warm_tile_sizes
 
         todo = []
@@ -458,17 +472,30 @@ class IvfState:
         def warm():
             import jax.numpy as jnp
 
+            from surrealdb_tpu import compile_log
+
             for t in todo:
                 try:
-                    _ivf_search(
-                        jnp.zeros((t, dim), jnp.float32), cents, list_rows,
-                        list_mask, matrix,
-                        metric=metric, probe_metric=probe_metric, k=k, nprobe=nprobe,
-                    )
+                    with compile_log.tracked(
+                        "ivf",
+                        _ivf_shape_key(
+                            t, cents, list_rows, matrix, metric, probe_metric,
+                            k, nprobe,
+                        ),
+                        prewarmed=True,
+                    ):
+                        _ivf_search(
+                            jnp.zeros((t, dim), jnp.float32), cents, list_rows,
+                            list_mask, matrix,
+                            metric=metric, probe_metric=probe_metric, k=k,
+                            nprobe=nprobe,
+                        )
                 except Exception:
                     pass
 
-        threading.Thread(target=warm, daemon=True).start()
+        from surrealdb_tpu import bg
+
+        bg.spawn("shape_warm", f"ivf:k{k}:p{nprobe}", warm, owner=owner)
 
     def search_batch(
         self, qs: np.ndarray, matrix, metric: str, k: int, nprobe: int,

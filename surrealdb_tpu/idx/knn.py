@@ -78,6 +78,8 @@ class VectorMirror:
         self._host_cache = None  # (contig data, sq-norms, rids) for host search
         self._lock = threading.RLock()
         self._build_lock = threading.Lock()
+        self.label = ""  # "<table>.<index>", set on build (task attribution)
+        self._owner = None  # id(ds), for bg teardown scoping
 
     # ------------------------------------------------------------ build
     def ensure_built(self, ctx, ix: dict) -> None:
@@ -97,6 +99,8 @@ class VectorMirror:
                 self._pending = []
             ns, db = ctx.ns_db()
             tb, name = ix["table"], ix["name"]
+            self.label = f"{tb}.{name}"
+            self._owner = id(ctx.ds())
             txn = ctx.ds().transaction(False)
             try:
                 rids, rows = [], []
@@ -313,16 +317,30 @@ class VectorMirror:
             alive = self.alive[: self.n_slots].copy()
             data = self.data
             renum0 = self._renumber
-        threading.Thread(
-            target=self._train_ivf, args=(data, alive, matrix, renum0), daemon=True
-        ).start()
+        from surrealdb_tpu import bg
+
+        # flight-recorder record: the multi-second training cliff is now an
+        # attributable task (linked to the query that kicked it), named so
+        # stack dumps say WHICH index is training
+        task_id = bg.register("ivf_train", target=self.label, owner=self._owner)
+        t = threading.Thread(
+            target=self._train_ivf,
+            args=(data, alive, matrix, renum0, task_id),
+            name=f"bg:ivf_train:{self.label}" if self.label else "bg:ivf_train",
+            daemon=True,
+        )
+        t.start()
         return ivf
 
-    def _train_ivf(self, data, alive, matrix, renum0: int) -> None:
+    def _train_ivf(self, data, alive, matrix, renum0: int, task_id=None) -> None:
+        from surrealdb_tpu import bg
         from surrealdb_tpu.idx.ivf import IvfState
 
         try:
-            new = IvfState.train(data[: alive.size], alive, matrix=matrix)
+            if task_id is None:
+                task_id = bg.register("ivf_train", target=self.label, trace_id=None)
+            with bg.run(task_id):
+                new = IvfState.train(data[: alive.size], alive, matrix=matrix)
         except BaseException:
             with self._lock:
                 self._ivf_building = False
@@ -383,7 +401,7 @@ class VectorMirror:
 
 
 
-def _exact_device_launch(qs: np.ndarray, matrix, mask, metric: str, k: int):
+def _exact_device_launch(qs: np.ndarray, matrix, mask, metric: str, k: int, owner=None):
     """Async fused exact distance+top-k over a [Q, D] query batch, Q padded
     to a pow2 tile (≤64) so coalesced batches of any size reuse one compiled
     kernel shape. Returns a collect() closure (two-phase dispatch)."""
@@ -392,14 +410,20 @@ def _exact_device_launch(qs: np.ndarray, matrix, mask, metric: str, k: int):
     from surrealdb_tpu.idx.ivf import _start_host_copy
     from surrealdb_tpu.utils.num import dispatch_tile, pad_tail, tile_slices
 
+    from surrealdb_tpu import compile_log
+
     nq = qs.shape[0]
     tile = dispatch_tile(nq)
     mj = jnp.asarray(mask)
     pending = []
-    for lo, hi in tile_slices(nq, tile):
-        d, r = D.knn_search(pad_tail(qs[lo:hi], tile), matrix, mj, metric, k)
-        _start_host_copy(d, r)
-        pending.append((lo, hi, d, r))
+    # every distinct (tile, dim, cap, k, metric) is one XLA executable: the
+    # first call through a new shape IS the compile — record + attribute it
+    shape_key = _exact_shape_key(tile, matrix, metric, k)
+    with compile_log.tracked("knn_exact", shape_key):
+        for lo, hi in tile_slices(nq, tile):
+            d, r = D.knn_search(pad_tail(qs[lo:hi], tile), matrix, mj, metric, k)
+            _start_host_copy(d, r)
+            pending.append((lo, hi, d, r))
 
     def collect():
         dd = np.empty((nq, k), dtype=np.float32)
@@ -409,14 +433,20 @@ def _exact_device_launch(qs: np.ndarray, matrix, mask, metric: str, k: int):
             rr[lo:hi] = np.asarray(r)[: hi - lo]
         return dd, rr
 
-    _warm_exact_tiles(qs.shape[1], matrix, mj, metric, k, tile)
+    _warm_exact_tiles(qs.shape[1], matrix, mj, metric, k, tile, owner)
     return collect
 
 
 _EXACT_WARMED: set = set()
 
 
-def _warm_exact_tiles(dim, matrix, mask_j, metric, k, served_tile) -> None:
+def _exact_shape_key(tile: int, matrix, metric: str, k: int):
+    """Compile-cache key of the exact fused kernel: the static dims XLA
+    keys its own executable cache on."""
+    return (tile, int(matrix.shape[1]), int(matrix.shape[0]), str(matrix.dtype), metric, k)
+
+
+def _warm_exact_tiles(dim, matrix, mask_j, metric, k, served_tile, owner=None) -> None:
     """Background-compile the other dispatch tile shapes of the exact fused
     kernel (same rationale as IvfState._warm_tiles). The warm set tracks
     the dispatcher's width cap, so every width the coalescer can hand a
@@ -436,13 +466,23 @@ def _warm_exact_tiles(dim, matrix, mask_j, metric, k, served_tile) -> None:
     def warm():
         import jax.numpy as jnp
 
+        from surrealdb_tpu import compile_log
+
         for t in todo:
             try:
-                D.knn_search(jnp.zeros((t, dim), jnp.float32), matrix, mask_j, metric, k)
+                with compile_log.tracked(
+                    "knn_exact", _exact_shape_key(t, matrix, metric, k),
+                    prewarmed=True,
+                ):
+                    D.knn_search(
+                        jnp.zeros((t, dim), jnp.float32), matrix, mask_j, metric, k
+                    )
             except Exception:
                 pass
 
-    threading.Thread(target=warm, daemon=True).start()
+    from surrealdb_tpu import bg
+
+    bg.spawn("shape_warm", f"knn_exact:k{k}", warm, owner=owner)
 
 
 def _exact_device_batch(qs: np.ndarray, matrix, mask, metric: str, k: int):
@@ -678,7 +718,10 @@ class KnnPlan(_KnnExecutorMixin):
                             key = key + pre[1]
 
                     def runner(qs):
-                        collect = _exact_device_launch(np.stack(qs), matrix, mask, metric, k)
+                        collect = _exact_device_launch(
+                            np.stack(qs), matrix, mask, metric, k,
+                            owner=mirror._owner,
+                        )
 
                         def finish():
                             dd, rr = collect()
@@ -700,7 +743,8 @@ class KnnPlan(_KnnExecutorMixin):
 
                     def runner(qs):
                         collect = ivf.search_batch_launch(
-                            np.stack(qs), matrix, metric, k, nprobe
+                            np.stack(qs), matrix, metric, k, nprobe,
+                            owner=mirror._owner,
                         )
 
                         def finish():
@@ -721,7 +765,10 @@ class KnnPlan(_KnnExecutorMixin):
                         key = key + pre[1]
 
                 def runner(qs):
-                    collect = _exact_device_launch(np.stack(qs), matrix, mask, metric, k)
+                    collect = _exact_device_launch(
+                        np.stack(qs), matrix, mask, metric, k,
+                        owner=mirror._owner,
+                    )
 
                     def finish():
                         dd, rr = collect()

@@ -171,4 +171,16 @@ class Datastore:
         _node.bootstrap(self)
 
     def close(self) -> None:
+        """Close the backend AND tear down this datastore's background
+        machinery: cancel armed mirror-rebuild/prewarm timers, join running
+        tasks, and (when the whole registry goes idle) park the flight-
+        recorder watchdog — no daemon-thread leaks under pytest."""
+        from surrealdb_tpu import bg
+
+        try:
+            self.column_mirrors.shutdown()
+            self.graph_mirrors.shutdown()
+            bg.shutdown(owner=id(self))
+        except Exception:  # noqa: BLE001 — teardown must never mask close()
+            pass
         self.backend.close()

@@ -337,6 +337,18 @@ class SurrealHandler(BaseHTTPRequestHandler):
             if fmt == "chrome":
                 return self._send(200, tracing.to_chrome(doc))
             return self._send(200, dict(doc, tree=tracing.span_tree(doc)))
+        if path == "/debug/bundle":
+            # one-shot flight-recorder bundle (bundle.py): traces + slow/
+            # error rings + task registry + compile log + dispatch/mirror
+            # state. Carries raw statement text, so system-user-gated like
+            # /slow and /traces.
+            if not self._route_allowed("debug"):
+                return
+            if self._system_gate() is None:
+                return
+            from surrealdb_tpu.bundle import debug_bundle
+
+            return self._send(200, debug_bundle(self.ds))
         if path == "/slow":
             # structured slow-query log (ring buffer; dbs/executor.py) — the
             # /metrics-adjacent debug endpoint. Entries carry raw statement
@@ -934,7 +946,9 @@ class Server:
                 except Exception:  # noqa: BLE001 — maintenance must not die
                     pass
 
-        self._ticker = threading.Thread(target=tick_loop, daemon=True)
+        self._ticker = threading.Thread(
+            target=tick_loop, name="bg:tick", daemon=True
+        )
         self._ticker.start()
 
     @property

@@ -46,6 +46,13 @@ class LocalEngine:
         except queue.Empty:
             return None
 
+    def debug_bundle(self) -> dict:
+        """Embedded flight-recorder bundle (the GET /debug/bundle payload)
+        without standing up a server — attach it to any perf report."""
+        from surrealdb_tpu.bundle import debug_bundle
+
+        return debug_bundle(self.ds)
+
     def export(self) -> str:
         from surrealdb_tpu.kvs.export import export_database
 

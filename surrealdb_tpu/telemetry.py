@@ -106,6 +106,12 @@ def gauge_set(name: str, value: float, **labels) -> None:
         _gauges[(name, _key(labels))] = float(value)
 
 
+def gauges_matching(name: str) -> Dict[_LabelKey, float]:
+    """All label-series of one gauge family: {labels_tuple: value}."""
+    with _lock:
+        return {labels: v for (n, labels), v in _gauges.items() if n == name}
+
+
 # ------------------------------------------------------------------ histograms
 def _hist_observe(family: str, buckets: Tuple[float, ...], value: float, labels: Dict) -> None:
     lk = _key(labels)
@@ -370,6 +376,20 @@ def collect_node_metrics(ds=None) -> None:
         pass
     if ds is not None and getattr(ds, "notifications", None) is not None:
         gauge_set("live_queries", ds.notifications.live_count())
+    # flight recorder: live background-task gauges + per-subsystem memory
+    # watermarks for the engine's device-bound mirrors
+    try:
+        from surrealdb_tpu import bg
+
+        bg.export_gauges()
+    except Exception:  # noqa: BLE001 — metrics must never fail a scrape
+        pass
+    if ds is not None:
+        try:
+            for subsystem, nbytes in mirror_memory_bytes(ds).items():
+                gauge_set("mirror_memory_bytes", nbytes, subsystem=subsystem)
+        except Exception:  # noqa: BLE001
+            pass
     jit = _jit_cache_stats()
     if jit is not None:
         hits, misses, size = jit
@@ -390,6 +410,45 @@ def collect_node_metrics(ds=None) -> None:
                     )
         except Exception:  # noqa: BLE001 — metrics must never fail a scrape
             pass
+
+
+def mirror_memory_bytes(ds) -> Dict[str, int]:
+    """Host-array bytes held per mirror subsystem (vector matrices, IVF
+    list tables, graph CSR arrays, column mirrors) — the per-subsystem
+    memory watermark the flight recorder attributes device pressure to.
+    Host nbytes == device upload size for every mirror (device arrays are
+    produced by jnp.asarray over these), so this is backend-independent."""
+    out = {"vector_mirror": 0, "ivf": 0, "graph_csr": 0, "column_mirror": 0}
+    stores = getattr(ds, "index_stores", None)
+    if stores is not None:
+        with stores._lock:  # noqa: SLF001 — read-only snapshot
+            mirrors = list(stores._stores.values())  # noqa: SLF001
+        for m in mirrors:
+            data = getattr(m, "data", None)
+            if data is not None and hasattr(data, "nbytes"):
+                out["vector_mirror"] += int(data.nbytes)
+            ivf = getattr(m, "ivf", None)
+            if ivf is not None:
+                cents = getattr(ivf, "centroids", None)
+                if cents is not None and hasattr(cents, "nbytes"):
+                    out["ivf"] += int(cents.nbytes)
+                out["ivf"] += 8 * int(getattr(ivf, "_n", 0) or 0)
+    gm = getattr(ds, "graph_mirrors", None)
+    if gm is not None:
+        with gm._lock:  # noqa: SLF001
+            csrs = list(gm._m.values())  # noqa: SLF001
+        for c in csrs:
+            for arr in (c.indptr, c.indices):
+                if arr is not None:
+                    out["graph_csr"] += int(arr.nbytes)
+    cm = getattr(ds, "column_mirrors", None)
+    if cm is not None:
+        with cm._lock:  # noqa: SLF001
+            cols = list(cm._mirrors.values())  # noqa: SLF001
+        for mirror in cols:
+            for col in mirror.columns.values():
+                out["column_mirror"] += int(col.tags.nbytes) + int(col.nums.nbytes)
+    return out
 
 
 # ------------------------------------------------------------------ exposition

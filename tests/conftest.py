@@ -31,3 +31,19 @@ def ds():
     from surrealdb_tpu.kvs.ds import Datastore
 
     return Datastore("memory")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Flight-recorder CI hook: a failing suite dumps its own diagnostics
+    (task registry, compile log, slow/error rings, traces) from INSIDE the
+    dying process — scripts/tier1.sh points SURREAL_T1_BUNDLE at
+    /tmp/_t1_bundle.json so failed runs carry their own bundle."""
+    path = os.environ.get("SURREAL_T1_BUNDLE")
+    if not path or exitstatus in (0, 5):  # 5 = no tests collected
+        return
+    try:
+        from surrealdb_tpu.bundle import write_bundle
+
+        write_bundle(path)
+    except Exception:  # noqa: BLE001 — diagnostics must never mask the failure
+        pass
