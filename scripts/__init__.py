@@ -1,0 +1,1 @@
+"""Repo tooling package (`python -m scripts.graftlint`, bench utilities)."""

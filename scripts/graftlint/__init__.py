@@ -1,0 +1,40 @@
+"""graftlint — engine-specific static analysis for surrealdb_tpu.
+
+The reference codebase leans on TLA+ specs and Rust's borrow checker for
+its concurrency/resource invariants (doc/tla/); a Python engine gets the
+equivalent only by building it. graftlint is the static half of that
+tooling (utils/locks.py is the runtime half): an AST-based rule engine
+whose rules encode THIS codebase's invariants — the things reviewers used
+to enforce from memory:
+
+  GL001  raw threading.Thread/Timer outside bg.py — flight-recorder
+         blind spots (unattributable threads in stack dumps, watchdog
+         can't see them)
+  GL002  jax.jit call sites in modules that never touch compile_log —
+         phantom unattributed XLA compiles (the classic latency-swing
+         mystery the compile log exists to kill)
+  GL003  os.environ / os.getenv outside cnf.py — configuration entering
+         the engine outside the sanctioned knob surface
+  GL004  ds.transaction() whose handle can leak without commit()/cancel()
+         on all paths — txn leaks the runtime detector only catches
+         after the fact
+  GL005  blocking host sync (np.asarray, .block_until_ready, device_get)
+         inside dispatch hot-path files — a hidden serialization point in
+         the coalescing pipeline
+  GL006  telemetry metric hygiene — dynamic metric names (unbounded
+         series), inconsistent label-key sets across call sites (broken
+         Prometheus aggregation), high-cardinality label keys
+
+Workflow:
+
+  python -m scripts.graftlint                    # lint surrealdb_tpu/
+  python -m scripts.graftlint --update-baseline  # grandfather findings
+  python -m scripts.graftlint --lock-order F     # check a sanitizer dump
+
+Findings not in scripts/graftlint/baseline.json fail the run (exit 1).
+Intentional exceptions are annotated in source with
+`# graftlint: disable=GL00X` (same line or the line above) or
+`# graftlint: disable-file=GL00X` anywhere in the file.
+"""
+
+from .engine import Finding, lint_paths, load_baseline  # noqa: F401

@@ -1,0 +1,111 @@
+"""graftlint CLI — `python -m scripts.graftlint [paths...]`.
+
+Exit codes: 0 = clean (every finding baselined), 1 = new findings or
+lock-order check failure, 2 = bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import engine
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="engine-specific static analysis for surrealdb_tpu",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to lint (default: surrealdb_tpu/ at the repo root)",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON (default scripts/graftlint/baseline.json)",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline from current findings and exit 0",
+    )
+    ap.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--lock-order", metavar="DUMP",
+        help="check a SURREAL_SANITIZE_OUT dump against the declared "
+        "hierarchy instead of (or in addition to) linting",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument(
+        "--no-lint", action="store_true",
+        help="with --lock-order: skip the static lint pass",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        from . import rules as rules_mod
+
+        for rid, (_fn, doc) in sorted(rules_mod.RULES.items()):
+            print(f"{rid}  {doc}")
+        return 0
+
+    rc = 0
+    if not args.no_lint:
+        paths = args.paths or [os.path.join(engine.repo_root(), "surrealdb_tpu")]
+        rules = (
+            [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+            if args.rules
+            else None
+        )
+        findings = engine.lint_paths(paths, rules=rules)
+        if args.update_baseline:
+            if args.paths or args.rules:
+                # a restricted run sees a SUBSET of findings; writing it
+                # would silently drop every other grandfathered entry and
+                # break the next full-scope gate run
+                print(
+                    "error: --update-baseline requires the default full "
+                    "scope (no path arguments, no --rules)",
+                    file=sys.stderr,
+                )
+                return 2
+            path = engine.write_baseline(findings, args.baseline)
+            print(f"baseline written: {path} ({len(findings)} findings)")
+            return 0
+        baseline = engine.load_baseline(args.baseline)
+        new, stale = engine.apply_baseline(findings, baseline)
+        for f in new:
+            print(f.render())
+        for k in stale:
+            print(f"warning: stale baseline entry (finding fixed — remove it): {k}")
+        grandfathered = len(findings) - len(new)
+        print(
+            f"graftlint: {len(findings)} finding(s), {grandfathered} "
+            f"baselined, {len(new)} new"
+        )
+        if new:
+            rc = 1
+
+    if args.lock_order:
+        from . import lockorder
+
+        errors, warnings = lockorder.check_dump(args.lock_order)
+        for w in warnings:
+            print(f"lock-order warning: {w}")
+        for e in errors:
+            print(f"lock-order ERROR: {e}")
+        print(
+            f"lock-order: {len(errors)} error(s), {len(warnings)} warning(s) "
+            f"({args.lock_order})"
+        )
+        if errors:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,214 @@
+"""graftlint core: module loading, suppression comments, baseline, runner.
+
+Rules live in rules.py and register themselves in RULES; each rule is a
+callable `rule(modules) -> List[Finding]` over the WHOLE module set (the
+telemetry label-consistency rule is inherently cross-module; per-module
+rules just loop).
+
+Baselines: findings carry a STABLE key (rule + path + a rule-specific
+symbol like the env-var name or enclosing function — never a line number,
+so unrelated edits don't churn the file). The committed baseline
+(scripts/graftlint/baseline.json) grandfathers pre-existing findings;
+anything new fails the run. `--update-baseline` rewrites it from the
+current findings — review the diff like any other code change.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable(-file)?=([A-Za-z0-9_,]+)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    key: str  # stable baseline key (no line numbers)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class Module:
+    """One parsed source file + the comment-level suppression map."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> {rule ids}; a standalone suppression comment covers the
+        # NEXT line, a trailing one covers its own
+        self.suppressed: Dict[int, set] = {}
+        self.file_suppressed: set = set()
+        for i, ln in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(ln)
+            if not m:
+                continue
+            rules = {r.strip().upper() for r in m.group(2).split(",") if r.strip()}
+            if m.group(1):  # disable-file
+                self.file_suppressed |= rules
+            elif ln.lstrip().startswith("#"):
+                self.suppressed.setdefault(i + 1, set()).update(rules)
+            else:
+                self.suppressed.setdefault(i, set()).update(rules)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressed:
+            return True
+        return rule in self.suppressed.get(line, ())
+
+    def enclosing_def(self, node: ast.AST) -> str:
+        """Dotted name of the innermost function/class containing `node`
+        (stable symbol for baseline keys)."""
+        target = (node.lineno, getattr(node, "col_offset", 0))
+        best: List[str] = []
+
+        def walk(n: ast.AST, stack: List[str]) -> None:
+            for child in ast.iter_child_nodes(n):
+                name = getattr(child, "name", None)
+                is_scope = isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                )
+                lo = getattr(child, "lineno", None)
+                hi = getattr(child, "end_lineno", None)
+                if is_scope and lo is not None and hi is not None:
+                    if lo <= target[0] <= hi:
+                        stack.append(name)
+                        best[:] = list(stack)
+                        walk(child, stack)
+                        stack.pop()
+                else:
+                    walk(child, stack)
+
+        walk(self.tree, [])
+        return ".".join(best) if best else "<module>"
+
+
+def collect_modules(paths: List[str], root: Optional[str] = None) -> List[Module]:
+    """Parse every .py under `paths` (files or directories). `root` anchors
+    the repo-relative names used by allowlists and baseline keys."""
+    if root is None:
+        root = repo_root()
+    files: List[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            files.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            files.extend(
+                os.path.join(dirpath, f) for f in filenames if f.endswith(".py")
+            )
+    out: List[Module] = []
+    for f in sorted(set(files)):
+        rel = os.path.relpath(f, root)
+        with open(f, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            out.append(Module(f, rel, src))
+        except SyntaxError as e:  # surfaced as a finding, not a crash
+            m = Module.__new__(Module)
+            m.path, m.rel, m.source = f, rel.replace(os.sep, "/"), src
+            m.lines, m.tree = src.splitlines(), ast.Module(body=[], type_ignores=[])
+            m.suppressed, m.file_suppressed = {}, set()
+            m.syntax_error = e  # type: ignore[attr-defined]
+            out.append(m)
+    return out
+
+
+def repo_root() -> str:
+    """The directory containing scripts/ (…/scripts/graftlint/engine.py)."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+# ------------------------------------------------------------------ baseline
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, dict]:
+    path = path or default_baseline_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        doc = json.load(f)
+    return {e["key"]: e for e in doc.get("findings", [])}
+
+
+def write_baseline(findings: List[Finding], path: Optional[str] = None) -> str:
+    path = path or default_baseline_path()
+    doc = {
+        "_comment": (
+            "graftlint grandfathered findings: entries here do not fail the "
+            "run. Keys are line-number-free so edits elsewhere don't churn "
+            "this file. Shrink it; never grow it without a review."
+        ),
+        "findings": [
+            {"rule": f.rule, "key": k, "message": f.message}
+            for k, f in sorted(
+                {f.key: f for f in findings}.items()
+            )  # keys are the identity; same-key sites share one entry
+        ],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    return path
+
+
+# ------------------------------------------------------------------ runner
+def lint_paths(
+    paths: List[str],
+    rules: Optional[List[str]] = None,
+    root: Optional[str] = None,
+) -> List[Finding]:
+    """Run (a subset of) the rules over `paths`; returns ALL findings —
+    the caller applies the baseline."""
+    from . import rules as rules_mod
+
+    modules = collect_modules(paths, root=root)
+    findings: List[Finding] = []
+    for m in modules:
+        err = getattr(m, "syntax_error", None)
+        if err is not None:
+            findings.append(
+                Finding(
+                    "GL000", m.rel, err.lineno or 1, 0,
+                    f"syntax error: {err.msg}", f"GL000:{m.rel}",
+                )
+            )
+    for rule_id, (fn, _doc) in rules_mod.RULES.items():
+        if rules is not None and rule_id not in rules:
+            continue
+        for f in fn(modules):
+            mod = next((m for m in modules if m.rel == f.path), None)
+            if mod is not None and mod.is_suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def apply_baseline(
+    findings: List[Finding], baseline: Dict[str, dict]
+) -> Tuple[List[Finding], List[str]]:
+    """Split into (new findings, stale baseline keys)."""
+    seen_keys = {f.key for f in findings}
+    new = [f for f in findings if f.key not in baseline]
+    stale = [k for k in baseline if k not in seen_keys]
+    return new, stale

@@ -1,0 +1,48 @@
+"""Static lock-order cross-check: observed sanitizer dump vs declared
+hierarchy.
+
+The runtime half (surrealdb_tpu/utils/locks.py, SURREAL_SANITIZE=1)
+records which lock-order edges ACTUALLY happen; utils/locks.HIERARCHY
+declares which orders are ALLOWED. This module closes the loop in CI:
+`python -m scripts.graftlint --lock-order <dump.json>` fails when the
+observed run contains
+
+- an acquisition cycle (potential deadlock, even if it didn't fire),
+- a guarded-state violation (mutation without the declared lock),
+- an edge that inverts the declared hierarchy, or nests two same-level
+  locks.
+
+Edges touching lock names outside the declared hierarchy are warnings
+only (test-local locks constructed outside `locks.isolated()` blocks).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Tuple
+
+
+def check_dump(path: str) -> Tuple[List[str], List[str]]:
+    """(errors, warnings) for one SURREAL_SANITIZE_OUT dump."""
+    from surrealdb_tpu.utils import locks
+
+    with open(path) as f:
+        doc = json.load(f)
+    errors: List[str] = []
+    warnings: List[str] = []
+    if not doc.get("enabled"):
+        warnings.append(
+            "dump was recorded with the sanitizer DISABLED — no edges to check"
+        )
+    for cyc in doc.get("cycles", []):
+        errors.append(f"lock-order cycle (potential deadlock): {' -> '.join(cyc)}")
+    for v in doc.get("violations", []):
+        errors.append(
+            f"guarded-state violation: {v.get('state')} mutated without "
+            f"{v.get('lock')} (thread {v.get('thread')})"
+        )
+    edges = {(e["from"], e["to"]) for e in doc.get("edges", [])}
+    errs, warns = locks.check_hierarchy(edges)
+    errors.extend(errs)
+    warnings.extend(warns)
+    return errors, warnings

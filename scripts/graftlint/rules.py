@@ -1,0 +1,437 @@
+"""graftlint rules GL001–GL006 (see package docstring for the catalog).
+
+Each rule is `fn(modules: List[Module]) -> List[Finding]`. Rules are
+deliberately HEURISTIC — they encode this codebase's conventions, not a
+soundness proof — and every rule supports `# graftlint: disable=GL00X`
+for the rare intentional exception (the suppression is visible in review,
+which is the point).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .engine import Finding, Module
+
+# rule id -> (fn, one-line doc); populated by @_rule below
+RULES: Dict[str, Tuple] = {}
+
+
+def _rule(rule_id: str, doc: str):
+    def deco(fn):
+        RULES[rule_id] = (fn, doc)
+        return fn
+
+    return deco
+
+
+def _call_name(node: ast.Call) -> Tuple[Optional[str], str]:
+    """(receiver, attr) for `recv.attr(...)`, (None, name) for `name(...)`."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        recv = f.value.id if isinstance(f.value, ast.Name) else None
+        return recv, f.attr
+    if isinstance(f, ast.Name):
+        return None, f.id
+    return None, ""
+
+
+def _imports_of(m: Module) -> Set[str]:
+    """Every module path this file imports (absolute, dotted)."""
+    out: Set[str] = set()
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.Import):
+            out.update(a.name for a in node.names)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            out.add(node.module)
+            out.update(f"{node.module}.{a.name}" for a in node.names)
+    return out
+
+
+def _from_imports(m: Module, module: str) -> Set[str]:
+    """Names imported via `from <module> import ...` in this file."""
+    out: Set[str] = set()
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == module:
+            out.update(a.asname or a.name for a in node.names)
+    return out
+
+
+# ------------------------------------------------------------------ GL001
+# Threads the flight recorder cannot see: bg.py owns ALL thread/timer
+# creation (spawn/spawn_service/start_thread/timer) so every thread has a
+# registry entry, a deterministic name, and watchdog coverage.
+GL001_ALLOWED_FILES = frozenset({"surrealdb_tpu/bg.py"})
+
+
+@_rule("GL001", "raw threading.Thread/Timer outside bg.py")
+def gl001(modules: List[Module]) -> List[Finding]:
+    out: List[Finding] = []
+    for m in modules:
+        if m.rel in GL001_ALLOWED_FILES:
+            continue
+        direct = _from_imports(m, "threading")
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            recv, attr = _call_name(node)
+            hit = (
+                attr in ("Thread", "Timer")
+                and (
+                    (recv is not None and "threading" in recv)
+                    or (recv is None and attr in direct)
+                )
+            )
+            if hit:
+                out.append(
+                    Finding(
+                        "GL001", m.rel, node.lineno, node.col_offset,
+                        f"raw threading.{attr} — spawn via surrealdb_tpu.bg "
+                        "(spawn/spawn_service/start_thread/timer) so the "
+                        "flight recorder sees it",
+                        f"GL001:{m.rel}:{m.enclosing_def(node)}:{attr}",
+                    )
+                )
+    return out
+
+
+# ------------------------------------------------------------------ GL002
+# Kernel-definition-only modules: their jitted functions are invoked (and
+# compile_log-wrapped) by callers, never launched here.
+GL002_KERNEL_DEF_MODULES = frozenset(
+    {
+        "surrealdb_tpu/ops/bm25.py",
+        "surrealdb_tpu/ops/distances.py",
+        "surrealdb_tpu/parallel/mesh.py",
+    }
+)
+
+
+@_rule("GL002", "jax.jit site in a module that never touches compile_log")
+def gl002(modules: List[Module]) -> List[Finding]:
+    out: List[Finding] = []
+    for m in modules:
+        if m.rel in GL002_KERNEL_DEF_MODULES:
+            continue
+        if "compile_log" in m.source and (
+            "surrealdb_tpu.compile_log" in _imports_of(m)
+            or "compile_log" in _from_imports(m, "surrealdb_tpu")
+        ):
+            continue
+        for node in ast.walk(m.tree):
+            jit_site: Optional[ast.AST] = None
+            if isinstance(node, ast.Call):
+                recv, attr = _call_name(node)
+                if attr == "jit" and recv == "jax":
+                    jit_site = node
+                # functools.partial(jax.jit, ...)
+                elif attr == "partial" and node.args:
+                    a0 = node.args[0]
+                    if (
+                        isinstance(a0, ast.Attribute)
+                        and a0.attr == "jit"
+                        and isinstance(a0.value, ast.Name)
+                        and a0.value.id == "jax"
+                    ):
+                        jit_site = node
+            elif isinstance(node, ast.Attribute) and node.attr == "jit":
+                # bare @jax.jit decorator (no call parens)
+                if isinstance(node.value, ast.Name) and node.value.id == "jax":
+                    jit_site = node
+            if jit_site is not None:
+                out.append(
+                    Finding(
+                        "GL002", m.rel, node.lineno, node.col_offset,
+                        "jax.jit in a module with no compile_log wiring — "
+                        "first-call XLA compiles here are phantom "
+                        "(unattributed) latency; wrap launch sites with "
+                        "compile_log.tracked(...)",
+                        f"GL002:{m.rel}:{m.enclosing_def(node)}",
+                    )
+                )
+                break  # one finding per scope is enough; key is per-def
+    # de-dup same-key findings (break above only stops the walk early)
+    seen: Set[str] = set()
+    uniq = []
+    for f in out:
+        if f.key not in seen:
+            seen.add(f.key)
+            uniq.append(f)
+    return uniq
+
+
+# ------------------------------------------------------------------ GL003
+GL003_ALLOWED_FILES = frozenset({"surrealdb_tpu/cnf.py"})
+
+
+@_rule("GL003", "os.environ/os.getenv outside cnf.py")
+def gl003(modules: List[Module]) -> List[Finding]:
+    out: List[Finding] = []
+    for m in modules:
+        if m.rel in GL003_ALLOWED_FILES:
+            continue
+        direct = _from_imports(m, "os")
+        for node in ast.walk(m.tree):
+            name = None
+            if isinstance(node, ast.Attribute) and node.attr in (
+                "environ", "getenv",
+            ):
+                if isinstance(node.value, ast.Name) and node.value.id in (
+                    "os", "_os",
+                ):
+                    name = node.attr
+            elif isinstance(node, ast.Name) and node.id in direct and node.id in (
+                "environ", "getenv",
+            ):
+                name = node.id
+            if name is None:
+                continue
+            env_var = _nearest_env_literal(m, node)
+            detail = env_var or m.enclosing_def(node)
+            out.append(
+                Finding(
+                    "GL003", m.rel, node.lineno, node.col_offset,
+                    f"os.{name} outside cnf.py — route through a cnf knob "
+                    "or cnf.env_* helper"
+                    + (f" (variable {env_var})" if env_var else ""),
+                    f"GL003:{m.rel}:{detail}",
+                )
+            )
+    return out
+
+
+def _nearest_env_literal(m: Module, node: ast.AST) -> Optional[str]:
+    """The env-var string literal on the same source line, if any (stable
+    baseline detail)."""
+    try:
+        line = m.lines[node.lineno - 1]
+    except IndexError:
+        return None
+    import re as _re
+
+    lits = _re.findall(r"[\"']([A-Z][A-Z0-9_]{2,})[\"']", line)
+    return lits[0] if lits else None
+
+
+# ------------------------------------------------------------------ GL004
+@_rule("GL004", "transaction handle without commit/cancel on any path")
+def gl004(modules: List[Module]) -> List[Finding]:
+    out: List[Finding] = []
+    for m in modules:
+        for fn in ast.walk(m.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            out.extend(_gl004_check_fn(m, fn))
+    return out
+
+
+def _gl004_check_fn(m: Module, fn: ast.AST) -> List[Finding]:
+    # local names assigned from `<expr>.transaction(...)`
+    tx_names: Dict[str, ast.AST] = {}
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Attribute)
+            and node.value.func.attr == "transaction"
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            tx_names[node.targets[0].id] = node
+    if not tx_names:
+        return []
+    finished: Set[str] = set()
+    escaped: Set[str] = set()
+    for node in ast.walk(fn):
+        # txn.commit() / txn.cancel() finishes it
+        if isinstance(node, ast.Attribute) and node.attr in ("commit", "cancel"):
+            if isinstance(node.value, ast.Name) and node.value.id in tx_names:
+                finished.add(node.value.id)
+        # escapes: returned / yielded / passed to a call / stored on an
+        # object / re-assigned to something else — ownership moved, the
+        # callee or holder is responsible
+        elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            v = node.value
+            if v is not None:
+                for n in ast.walk(v):
+                    if isinstance(n, ast.Name) and n.id in tx_names:
+                        escaped.add(n.id)
+        elif isinstance(node, ast.Call):
+            for n in list(node.args) + [kw.value for kw in node.keywords]:
+                for nn in ast.walk(n):
+                    if isinstance(nn, ast.Name) and nn.id in tx_names:
+                        escaped.add(nn.id)
+        elif isinstance(node, ast.Assign):
+            for n in ast.walk(node.value):
+                if isinstance(n, ast.Name) and n.id in tx_names:
+                    if not (
+                        isinstance(node.value, ast.Call)
+                        and isinstance(node.value.func, ast.Attribute)
+                        and node.value.func.attr == "transaction"
+                    ):
+                        escaped.add(n.id)
+    out: List[Finding] = []
+    for name, site in tx_names.items():
+        if name in finished or name in escaped:
+            continue
+        out.append(
+            Finding(
+                "GL004", m.rel, site.lineno, site.col_offset,
+                f"transaction `{name}` has no commit()/cancel() in "
+                f"{m.enclosing_def(site)} and never escapes — leaks its "
+                "snapshot until GC (the runtime detector fires after the "
+                "fact; fix the path)",
+                f"GL004:{m.rel}:{m.enclosing_def(site)}:{name}",
+            )
+        )
+    return out
+
+
+# ------------------------------------------------------------------ GL005
+# Files whose functions are dispatch hot path: a blocking host sync here
+# serializes the whole coalescing pipeline. Other files opt in with a
+# `# graftlint: hot-path` comment anywhere in the file.
+GL005_HOT_FILES = frozenset({"surrealdb_tpu/dbs/dispatch.py"})
+GL005_BLOCKING_ATTRS = frozenset({"block_until_ready", "device_get", "tolist"})
+GL005_NP_SYNC = frozenset({"asarray", "array"})
+GL005_NP_NAMES = frozenset({"np", "numpy", "onp", "jnp"})
+
+
+@_rule("GL005", "blocking host sync inside dispatch hot-path files")
+def gl005(modules: List[Module]) -> List[Finding]:
+    out: List[Finding] = []
+    for m in modules:
+        hot = m.rel in GL005_HOT_FILES or any(
+            "graftlint: hot-path" in ln for ln in m.lines[:50]
+        )
+        if not hot:
+            continue
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            recv, attr = _call_name(node)
+            hit = attr in GL005_BLOCKING_ATTRS or (
+                attr in GL005_NP_SYNC and recv in GL005_NP_NAMES
+            )
+            if hit:
+                out.append(
+                    Finding(
+                        "GL005", m.rel, node.lineno, node.col_offset,
+                        f"blocking host sync `.{attr}(...)` on the dispatch "
+                        "hot path — this serializes every rider of the "
+                        "coalesced batch; move it to a collect phase / the "
+                        "runner closure",
+                        f"GL005:{m.rel}:{m.enclosing_def(node)}:{attr}",
+                    )
+                )
+    return out
+
+
+# ------------------------------------------------------------------ GL006
+GL006_WRITERS = frozenset(
+    {"inc", "observe", "observe_hist", "gauge_set", "gauge_add", "span"}
+)
+# positional/config kwargs that are NOT metric labels
+GL006_NON_LABEL_KWARGS = frozenset({"by", "buckets"})
+GL006_FORBIDDEN_LABELS = frozenset({"id", "trace_id", "sql", "query", "path"})
+GL006_NAME_RE = r"^[a-z][a-z0-9_]*$"
+
+
+@_rule("GL006", "telemetry metric-name / label-cardinality hygiene")
+def gl006(modules: List[Module]) -> List[Finding]:
+    import re as _re
+
+    out: List[Finding] = []
+    # metric -> {frozenset(label keys) -> [(module, node), ...] all sites}
+    label_sets: Dict[str, Dict[frozenset, List[Tuple[Module, ast.Call]]]] = {}
+    for m in modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            recv, attr = _call_name(node)
+            if recv != "telemetry" or attr not in GL006_WRITERS:
+                continue
+            if not node.args:
+                continue
+            name_node = node.args[0]
+            if not (
+                isinstance(name_node, ast.Constant)
+                and isinstance(name_node.value, str)
+            ):
+                out.append(
+                    Finding(
+                        "GL006", m.rel, node.lineno, node.col_offset,
+                        f"telemetry.{attr} with a DYNAMIC metric name — "
+                        "unbounded series cardinality; use a static name "
+                        "and put the variable part in a label",
+                        f"GL006:{m.rel}:{m.enclosing_def(node)}:dynamic-name",
+                    )
+                )
+                continue
+            metric = name_node.value
+            if not _re.match(GL006_NAME_RE, metric):
+                out.append(
+                    Finding(
+                        "GL006", m.rel, node.lineno, node.col_offset,
+                        f"metric name {metric!r} is not a valid Prometheus "
+                        "base name ([a-z][a-z0-9_]*)",
+                        f"GL006:{metric}:name",
+                    )
+                )
+            keys = []
+            for kw in node.keywords:
+                if kw.arg is None:
+                    out.append(
+                        Finding(
+                            "GL006", m.rel, node.lineno, node.col_offset,
+                            f"telemetry.{attr}({metric!r}, **dynamic) — "
+                            "label KEYS must be static keywords",
+                            f"GL006:{metric}:dynamic-labels",
+                        )
+                    )
+                    continue
+                if kw.arg in GL006_NON_LABEL_KWARGS:
+                    continue
+                keys.append(kw.arg)
+                if kw.arg in GL006_FORBIDDEN_LABELS:
+                    out.append(
+                        Finding(
+                            "GL006", m.rel, node.lineno, node.col_offset,
+                            f"label key {kw.arg!r} on {metric!r} is "
+                            "high-cardinality by construction (per-request "
+                            "values) — join via the slow/error rings or "
+                            "traces instead",
+                            f"GL006:{metric}:label:{kw.arg}",
+                        )
+                    )
+            label_sets.setdefault(metric, {}).setdefault(
+                frozenset(keys), []
+            ).append((m, node))
+    # cross-site consistency: one metric, one label-key set (Prometheus
+    # aggregation breaks silently otherwise). Canonical = the set used at
+    # the MOST call sites (an outlier new site must not out-vote five
+    # established ones just by carrying more keys); ties break to the
+    # larger set.
+    for metric, sets in sorted(label_sets.items()):
+        if len(sets) <= 1:
+            continue
+        majority = max(sets, key=lambda s: (len(sets[s]), len(s), sorted(s)))
+        for keyset, sites in sorted(
+            sets.items(), key=lambda kv: (kv[1][0][0].rel, kv[1][0][1].lineno)
+        ):
+            if keyset == majority:
+                continue
+            m, node = sites[0]
+            out.append(
+                Finding(
+                    "GL006", m.rel, node.lineno, node.col_offset,
+                    f"metric {metric!r} emitted with label keys "
+                    f"{sorted(keyset) or '[]'} here ({len(sites)} site(s)) "
+                    f"but {sorted(majority)} at {len(sets[majority])} "
+                    "other site(s) — inconsistent label sets break "
+                    "aggregation",
+                    f"GL006:{metric}:labelset:{','.join(sorted(keyset))}",
+                )
+            )
+    return out

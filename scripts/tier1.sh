@@ -1,18 +1,34 @@
 #!/usr/bin/env bash
 # Canonical tier-1 gate — the EXACT "Tier-1 verify" line from ROADMAP.md,
-# wrapped so CI and humans run the identical command. Exit code is
-# pytest's; the log lands in /tmp/_t1.log and a DOTS_PASSED recount is
-# printed (driver-proof pass counting independent of the summary line).
+# wrapped so CI and humans run the identical command, plus the repo's
+# static-analysis and concurrency-sanitizer gates:
 #
-# On a non-zero exit the suite dumps a flight-recorder bundle (task
-# registry, compile log, slow/error rings, traces) to /tmp/_t1_bundle.json
-# via the conftest sessionfinish hook, so failed runs carry their own
-# diagnostics. If the process died before the hook could run, a skeleton
-# bundle is captured from a fresh interpreter as a fallback.
+#   0. `python -m scripts.graftlint` — engine-specific lint (GL001–GL006);
+#      findings beyond scripts/graftlint/baseline.json fail the gate.
+#   1. the pytest tier-1 suite (exit code preserved; log in /tmp/_t1.log,
+#      DOTS_PASSED recount printed — driver-proof pass counting).
+#   2. a SURREAL_SANITIZE=1 smoke subset re-run: instrumented locks record
+#      the acquisition graph (dumped to /tmp/_t1_locks.json), then
+#      `--lock-order` cross-checks observed edges against the declared
+#      hierarchy (utils/locks.HIERARCHY) — order cycles, guarded-state
+#      violations and inversions fail the gate.
+#
+# On a non-zero pytest exit the suite dumps a flight-recorder bundle (task
+# registry, compile log, slow/error rings, traces, lock report) to
+# /tmp/_t1_bundle.json via the conftest sessionfinish hook, so failed runs
+# carry their own diagnostics. If the process died before the hook could
+# run, a skeleton bundle is captured from a fresh interpreter as a fallback.
 #
 # Opt-in perf companion (run when touching the dispatch/kNN hot path):
 #   python scripts/bench_gate.py   # smoke-scale concurrent-kNN floor gate
 set -o pipefail
+cd "$(dirname "$0")/.."
+
+# ---- gate 0: static analysis ------------------------------------------------
+python -m scripts.graftlint
+lint_rc=$?
+
+# ---- gate 1: the canonical tier-1 suite ------------------------------------
 rm -f /tmp/_t1.log /tmp/_t1_bundle.json
 timeout -k 10 870 env JAX_PLATFORMS=cpu SURREAL_T1_BUNDLE=/tmp/_t1_bundle.json \
   python -m pytest tests/ -q -m 'not slow' \
@@ -28,4 +44,32 @@ if [ "$rc" -ne 0 ]; then
   fi
   [ -s /tmp/_t1_bundle.json ] && echo "flight-recorder bundle: /tmp/_t1_bundle.json"
 fi
-exit $rc
+
+# ---- gate 2: lock-order / race sanitizer smoke ------------------------------
+rm -f /tmp/_t1_locks.json
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  SURREAL_SANITIZE=1 SURREAL_SANITIZE_OUT=/tmp/_t1_locks.json \
+  python -m pytest \
+  tests/test_locks_sanitizer.py tests/test_dispatch.py \
+  tests/test_flight_recorder.py tests/test_column_scan.py \
+  tests/test_kvs.py tests/test_e2e_crud.py \
+  -q -p no:cacheprovider -p no:xdist -p no:randomly >/tmp/_t1_sanitize.log 2>&1
+san_rc=$?
+[ "$san_rc" -ne 0 ] && tail -20 /tmp/_t1_sanitize.log
+lock_rc=1
+if [ -s /tmp/_t1_locks.json ]; then
+  python -m scripts.graftlint --no-lint --lock-order /tmp/_t1_locks.json
+  lock_rc=$?
+else
+  echo "lock-order: no sanitizer dump produced (smoke run rc=$san_rc)"
+fi
+
+# ---- verdict ---------------------------------------------------------------
+[ "$lint_rc" -ne 0 ] && echo "GATE FAILED: graftlint (rc=$lint_rc)"
+[ "$rc" -ne 0 ] && echo "GATE FAILED: tier-1 pytest (rc=$rc)"
+[ "$san_rc" -ne 0 ] && echo "GATE FAILED: sanitizer smoke subset (rc=$san_rc)"
+[ "$lock_rc" -ne 0 ] && echo "GATE FAILED: lock-order cross-check (rc=$lock_rc)"
+# pytest's exit code still wins for compatibility with the driver recount
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+if [ "$lint_rc" -ne 0 ] || [ "$san_rc" -ne 0 ] || [ "$lock_rc" -ne 0 ]; then exit 1; fi
+exit 0

@@ -37,6 +37,8 @@ import time
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional
 
+from surrealdb_tpu.utils import locks as _locks
+
 # default per-kind watchdog deadlines (seconds) — how long a RUNNING task
 # of this kind may take before it is presumed wedged. Callers may override
 # per task; the global default (cnf.BG_WATCHDOG_DEADLINE_SECS) covers the
@@ -59,7 +61,7 @@ class Task:
     __slots__ = (
         "id", "kind", "target", "state", "owner", "trace_id", "deadline_s",
         "scheduled_ts", "start_ts", "end_ts", "duration_s", "error",
-        "retries", "stalled", "thread",
+        "retries", "stalled", "thread", "service", "stack",
     )
 
     def __init__(self, tid, kind, target, owner, trace_id, deadline_s):
@@ -78,6 +80,11 @@ class Task:
         self.retries = 0
         self.stalled = False  # sticky: set once the watchdog flagged it
         self.thread: Optional[threading.Thread] = None
+        # long-lived worker loop (WS pump/pool, SDK reader, server tick):
+        # lives as long as its connection, exempt from deadlines and joins
+        self.service = False
+        # stack sample captured by the watchdog when it flagged the stall
+        self.stack: Optional[List[str]] = None
 
     def to_dict(self) -> dict:
         return {
@@ -95,11 +102,13 @@ class Task:
             "error": self.error,
             "retries": self.retries,
             "stalled": self.stalled,
+            "service": self.service,
+            "stack": self.stack,
             "thread": self.thread.name if self.thread is not None else None,
         }
 
 
-_lock = threading.Lock()
+_lock = _locks.Lock("bg.registry")
 _tasks: Dict[int, Task] = {}  # id -> Task (bounded: finished tasks trimmed)
 _next_id = 0
 _watchdog: Optional[threading.Thread] = None
@@ -112,6 +121,7 @@ def _trim_locked() -> None:
     evicted — the watchdog and teardown must always see them."""
     from surrealdb_tpu import cnf
 
+    _locks.assert_held(_lock, "bg._tasks")
     cap = max(cnf.BG_REGISTRY_CAP, 16)
     if len(_tasks) <= cap:
         return
@@ -271,6 +281,99 @@ def spawn(
     return tid
 
 
+def spawn_service(
+    kind: str,
+    target: str,
+    fn: Callable,
+    *args,
+    owner: Optional[int] = None,
+) -> threading.Thread:
+    """Register + start a long-lived WORKER LOOP (WS notification pump,
+    WS request-pool worker, SDK reader, server tick loop): a daemon thread
+    that lives as long as its connection/server, so it is exempt from the
+    per-kind stall deadline and from shutdown() joins — its registry entry
+    exists for ATTRIBUTION (deterministic `bg:<kind>:<target>` thread name,
+    flight-recorder visibility, stack-dump identification). The entry
+    flips to done/failed when the loop exits. Returns the Thread (callers
+    that join on their own teardown need it)."""
+    tid = register(kind, target, owner=owner, deadline=float("inf"))
+    with _lock:
+        rec = _tasks.get(tid)
+        if rec is not None:
+            rec.service = True
+
+    def body():
+        try:
+            with run(tid):
+                fn(*args)
+        except Exception:
+            pass  # the registry record carries the error
+
+    t = threading.Thread(
+        target=body,
+        name=f"bg:{kind}:{target}" if target else f"bg:{kind}",
+        daemon=True,
+    )
+    with _lock:
+        rec = _tasks.get(tid)
+        if rec is not None:
+            rec.thread = t
+    t.start()
+    return t
+
+
+def start_thread(task_id: int, fn: Callable, *args) -> threading.Thread:
+    """Start the daemon thread for an ALREADY-REGISTERED task whose body
+    enters `bg.run(task_id)` itself (the IVF-train / index-build pattern:
+    registration happens under the caller's lock, the heavy body later).
+    Centralizes raw thread creation in this module (graftlint GL001)."""
+    with _lock:
+        rec = _tasks.get(task_id)
+        kind = rec.kind if rec is not None else "task"
+        target = rec.target if rec is not None else ""
+    t = threading.Thread(
+        target=fn,
+        args=args,
+        name=f"bg:{kind}:{target}" if target else f"bg:{kind}",
+        daemon=True,
+    )
+    with _lock:
+        rec = _tasks.get(task_id)
+        if rec is not None:
+            rec.thread = t
+    t.start()
+    return t
+
+
+def timer(
+    delay: float, fn: Callable, *args, task_id: Optional[int] = None,
+    name: Optional[str] = None, start: bool = True,
+) -> threading.Timer:
+    """Create a named daemon Timer attributed to a registered task (the
+    debounced column-mirror / graph-prewarm arm sites). The caller keeps
+    the Timer for cancel(); the registry keeps the attribution. Pass
+    `start=False` when the callback must learn its own Timer object first
+    (the self-identifying debounce pattern) — then call .start() yourself."""
+    t = threading.Timer(delay, fn, args=args)
+    t.daemon = True
+    if task_id is not None:
+        with _lock:
+            rec = _tasks.get(task_id)
+            if rec is not None:
+                rec.thread = t
+                if name is None:
+                    name = (
+                        f"bg:{rec.kind}:{rec.target}"
+                        if rec.target
+                        else f"bg:{rec.kind}"
+                    )
+    if name:
+        t.name = name
+    if start:
+        t.start()
+    return t
+
+
 # ------------------------------------------------------------------ watchdog
 def _ensure_watchdog() -> None:
     global _watchdog
@@ -306,7 +409,43 @@ def _watchdog_loop() -> None:
                     t.stalled = True
                     flagged.append(t)
         for t in flagged:
+            # counter first: observers poll state->counter in lockstep and
+            # must not see a stalled task without its metric
             telemetry.inc("bg_task_stalled", kind=t.kind)
+        if flagged:
+            # sample the wedged threads' stacks (sys._current_frames — the
+            # faulthandler view, but attributable per task) so the bundle's
+            # task-registry section says WHERE a stalled rebuild is stuck,
+            # not just that it is
+            stacks = _sample_stacks([t.thread for t in flagged])
+            with _lock:
+                for t in flagged:
+                    if t.thread is not None and t.thread.ident in stacks:
+                        t.stack = stacks[t.thread.ident]
+
+
+def _sample_stacks(threads) -> Dict[int, List[str]]:
+    """{thread ident: formatted stack tail} for live threads, via
+    sys._current_frames(). Best-effort: a thread that exits between the
+    flag and the sample simply yields no entry."""
+    import sys
+    import traceback
+
+    idents = {t.ident for t in threads if t is not None and t.ident is not None}
+    out: Dict[int, List[str]] = {}
+    if not idents:
+        return out
+    try:
+        frames = sys._current_frames()  # noqa: SLF001 — the documented API
+    except Exception:  # noqa: BLE001
+        return out
+    for ident, frame in frames.items():
+        if ident in idents:
+            out[ident] = [
+                ln.rstrip()
+                for ln in traceback.format_stack(frame, limit=12)
+            ][-12:]
+    return out
 
 
 def watchdog_alive() -> bool:
@@ -323,10 +462,14 @@ def shutdown(owner: Optional[int] = None, timeout: float = 10.0) -> bool:
     deadline = time.monotonic() + timeout
     while True:
         with _lock:
+            # services (WS pumps/pools, SDK readers) live as long as their
+            # CONNECTION, not the datastore — they are never joined here;
+            # their run() lifecycle resolves them when the loop exits
             pending = [
                 t
                 for t in _tasks.values()
                 if t.state in ("running", "stalled")
+                and not t.service
                 and (owner is None or t.owner == owner)
             ]
         if not pending:
@@ -350,7 +493,10 @@ def shutdown(owner: Optional[int] = None, timeout: float = 10.0) -> bool:
                 t.error = "cancelled: datastore closed"
                 t.end_ts = time.time()
                 t.duration_s = 0.0
-        idle = not any(t.state in ("running", "stalled") for t in _tasks.values())
+        idle = not any(
+            t.state in ("running", "stalled") and not t.service
+            for t in _tasks.values()
+        )
         wd = _watchdog if idle else None
         if idle:
             _watchdog = None
@@ -365,6 +511,7 @@ def shutdown(owner: Optional[int] = None, timeout: float = 10.0) -> bool:
             t
             for t in _tasks.values()
             if t.state in ("running", "stalled")
+            and not t.service
             and (owner is None or t.owner == owner)
         ]
     return joined and not still
@@ -381,6 +528,7 @@ def wait_idle(timeout: float = 30.0, owner: Optional[int] = None) -> bool:
             # mirrors would race exactly the slow tasks this helper gates
             busy = any(
                 t.state in ("scheduled", "running", "stalled")
+                and not t.service  # worker loops never go idle by design
                 and (owner is None or t.owner == owner)
                 for t in _tasks.values()
             )

@@ -12,7 +12,11 @@ versioned document — the artifact you attach to any perf report:
                      prewarm vs on-demand, per-shape cache hits;
 6. `engine`        — dispatch stats + width distribution, column-mirror /
                      graph-CSR / vector-mirror staleness states, and
-                     per-subsystem mirror memory watermarks.
+                     per-subsystem mirror memory watermarks;
+7. `locks`         — the concurrency sanitizer's report (utils/locks.py):
+                     observed lock-acquisition edges, order cycles and
+                     guarded-state violations (populated under
+                     SURREAL_SANITIZE=1; enabled=false otherwise).
 
 Served by `GET /debug/bundle` (system-user-gated) and embedded via
 `INFO FOR ROOT` (`system.bundle`); bench.py embeds one per artifact so a
@@ -28,14 +32,17 @@ from typing import Any, Dict, Optional
 
 BUNDLE_SCHEMA = "surrealdb-tpu-bundle/1"
 
-# the six sections every consumer may rely on
-SECTIONS = ("traces", "slow_queries", "errors", "tasks", "compiles", "engine")
+# the sections every consumer may rely on
+SECTIONS = (
+    "traces", "slow_queries", "errors", "tasks", "compiles", "engine", "locks",
+)
 
 
 def debug_bundle(
     ds=None, trace_limit: int = 50, full_traces: int = 10
 ) -> Dict[str, Any]:
     from surrealdb_tpu import bg, compile_log, telemetry, tracing
+    from surrealdb_tpu.utils import locks
 
     ids = tracing.trace_ids()
     docs = []
@@ -57,6 +64,7 @@ def debug_bundle(
         "tasks": bg.snapshot(),
         "compiles": compile_log.snapshot(),
         "engine": _engine_state(ds),
+        "locks": locks.report(),
     }
     return out
 

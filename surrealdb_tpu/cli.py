@@ -133,9 +133,9 @@ def _start(args) -> int:
 
     from surrealdb_tpu.dbs.capabilities import from_env_and_args
 
-    import os as _os
+    from surrealdb_tpu import cnf
 
-    if getattr(args, "profile", False) or _os.environ.get("SURREAL_PROFILE") == "1":
+    if getattr(args, "profile", False) or cnf.PROFILE:
         from surrealdb_tpu import telemetry
 
         telemetry.enable(True)

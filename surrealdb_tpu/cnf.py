@@ -2,6 +2,12 @@
 
 Mirrors the role of the reference's `SURREAL_*` env-parsed config statics
 (reference: core/src/cnf/mod.rs:17-97). Values are read once at import.
+
+This module is the ONLY sanctioned environment reader (graftlint GL003):
+every other module takes its knobs from a constant below or, for
+late-bound / dynamically-named variables, through the public `env_*`
+helpers — so `python -m scripts.graftlint` can prove no configuration
+enters the engine anywhere else.
 """
 
 from __future__ import annotations
@@ -28,6 +34,32 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, default))
     except (TypeError, ValueError):
         return default
+
+
+# ------------------------------------------------------------ public helpers
+# Late-bound reads for callers whose variable NAMES are dynamic (capability
+# flags) or whose values change within a process lifetime (pytest's
+# PYTEST_CURRENT_TEST). Everything else should be a module constant.
+def env_str(name: str, default=None):
+    return os.environ.get(name, default)
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    return _env_bool(name, default)
+
+
+def env_int(name: str, default: int = 0) -> int:
+    return _env_int(name, default)
+
+
+def env_float(name: str, default: float = 0.0) -> float:
+    return _env_float(name, default)
+
+
+def under_pytest() -> bool:
+    """True while pytest is executing a test (set/cleared per test by
+    pytest itself, so this must be a live read, not an import-time knob)."""
+    return bool(os.environ.get("PYTEST_CURRENT_TEST"))
 
 
 # Execution limits
@@ -169,6 +201,18 @@ BG_WATCHDOG_INTERVAL_SECS = _env_float("SURREAL_BG_WATCHDOG_INTERVAL", 1.0)
 BG_WATCHDOG_DEADLINE_SECS = _env_float("SURREAL_BG_WATCHDOG_DEADLINE", 120.0)
 BG_REGISTRY_CAP = _env_int("SURREAL_BG_REGISTRY_CAP", 512)
 COMPILE_LOG_CAP = _env_int("SURREAL_COMPILE_LOG_CAP", 512)
+
+# Concurrency sanitizer (utils/locks.py): instrumented lock wrappers record
+# the lock-acquisition graph, detect order cycles (potential deadlocks) and
+# guarded-state mutations without the declared lock. Zero overhead when off:
+# the factories hand back raw threading primitives. SANITIZE_OUT dumps the
+# observed report as JSON at pytest sessionfinish (the static lock-order
+# cross-check in scripts/graftlint consumes it).
+SANITIZE = _env_bool("SURREAL_SANITIZE", False)
+SANITIZE_OUT = os.environ.get("SURREAL_SANITIZE_OUT")
+
+# --profile equivalent: enable span recording from the environment
+PROFILE = _env_bool("SURREAL_PROFILE", False)
 
 # Websocket / server
 # largest accepted HTTP request body (model imports carry inline weights)

@@ -26,13 +26,13 @@ call traced + compiled". The log is bounded by SURREAL_COMPILE_LOG_CAP.
 from __future__ import annotations
 
 import contextvars
-import threading
+from surrealdb_tpu.utils import locks as _locks
 import time
 from collections import deque
 from contextlib import contextmanager
 from typing import Any, Deque, Optional, Tuple
 
-_lock = threading.Lock()
+_lock = _locks.Lock("compile_log")
 _seen: set = set()  # (subsystem, shape_key) already compiled
 _inflight: set = set()  # keys whose FIRST call is still inside tracked()
 _events: Deque[dict] = deque(maxlen=512)  # re-bounded lazily from cnf

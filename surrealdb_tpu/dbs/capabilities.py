@@ -335,7 +335,7 @@ def from_env_and_args(args=None) -> Capabilities:
     """Build server capabilities from CLI args (cli.py start) and/or
     SURREAL_CAPS_* environment variables (reference: the --allow-*/--deny-*
     flags on `surreal start`)."""
-    import os
+    from surrealdb_tpu import cnf
 
     caps = Capabilities.default()
     falsy = ("", "0", "false", "no", "off", "none")
@@ -343,7 +343,7 @@ def from_env_and_args(args=None) -> Capabilities:
     def flag(cli_name: str, env: str) -> Optional[str]:
         v = getattr(args, cli_name, None) if args is not None else None
         if v is None:
-            v = os.environ.get(env)
+            v = cnf.env_str(env)
         if v is True:
             return "all"
         if v is False:

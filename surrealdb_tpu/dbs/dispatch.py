@@ -63,6 +63,7 @@ import time as _time
 from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence
 
 from surrealdb_tpu import cnf
+from surrealdb_tpu.utils import locks as _locks
 
 
 _TRANSIENT_MARKERS = (
@@ -124,7 +125,7 @@ class _Bucket:
     __slots__ = ("lock", "queue", "launching", "sem", "depth")
 
     def __init__(self, depth: int):
-        self.lock = threading.Lock()
+        self.lock = _locks.Lock("dispatch.bucket")
         self.queue: List[_Req] = []
         self.launching = False  # exactly one leader in the launch phase
         self.depth = depth
@@ -153,7 +154,7 @@ class DispatchQueue:
         pipeline_depth: Optional[int] = None,
         split_floor: Optional[int] = None,
     ):
-        self._lock = threading.Lock()
+        self._lock = _locks.Lock("dispatch.queue")
         self._buckets: Dict[Hashable, _Bucket] = {}
         self._max_width_override = max_width
         self._depth_override = pipeline_depth

@@ -10,7 +10,7 @@ commits.
 from __future__ import annotations
 
 import queue
-import threading
+from surrealdb_tpu.utils import locks as _locks
 from typing import Any, Dict, List, Optional
 
 
@@ -40,7 +40,7 @@ class NotificationHub:
 
     def __init__(self):
         self._subs: Dict[str, "queue.Queue[Notification]"] = {}
-        self._lock = threading.Lock()
+        self._lock = _locks.Lock("notification.hub")
 
     def subscribe(self, live_id: str) -> "queue.Queue[Notification]":
         with self._lock:

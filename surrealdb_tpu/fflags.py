@@ -12,8 +12,9 @@ Flags are read once at import; `reload()` re-reads the environment (tests).
 
 from __future__ import annotations
 
-import os
 from typing import Dict, NamedTuple
+
+from surrealdb_tpu import cnf
 
 
 class _Flag(NamedTuple):
@@ -38,21 +39,23 @@ _REGISTRY: Dict[str, _Flag] = {
     ),
 }
 
-_TRUE = ("1", "true", "yes", "on")
-
-
 class _FFlags:
     def __init__(self):
         self.reload()
 
     def reload(self) -> None:
         for name, flag in _REGISTRY.items():
-            raw = os.environ.get(flag.env)
-            val = flag.default if raw is None else raw.lower() in _TRUE
-            setattr(self, name, val)
+            setattr(self, name, cnf.env_bool(flag.env, flag.default))
 
     def snapshot(self) -> Dict[str, bool]:
         return {name: getattr(self, name) for name in _REGISTRY}
 
 
 FFLAGS = _FFlags()
+
+
+def enabled(name: str) -> bool:
+    """Live read of one flag (request-time gates: tests flip the env var
+    after import, so the gate must not rely on the import-time snapshot)."""
+    flag = _REGISTRY[name]
+    return cnf.env_bool(flag.env, flag.default)

@@ -10,9 +10,9 @@ from surrealdb_tpu.err import SurrealError
 
 
 def execute_graphql(ds, session, request: dict):
-    import os
+    from surrealdb_tpu import fflags
 
-    if os.environ.get("SURREAL_EXPERIMENTAL_GRAPHQL", "").lower() not in ("1", "true"):
+    if not fflags.enabled("graphql_experimental"):
         raise SurrealError("GraphQL is an experimental feature; set SURREAL_EXPERIMENTAL_GRAPHQL=true")
     from .exec import run_graphql
 

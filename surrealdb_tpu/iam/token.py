@@ -13,7 +13,7 @@ import base64
 import hashlib
 import hmac
 import json
-import threading
+from surrealdb_tpu.utils import locks as _locks
 import time
 from typing import Any, Dict, Optional
 
@@ -69,7 +69,7 @@ def _asym_verify(alg: str, key_pem: str, signed: bytes, sig: bytes) -> bool:
 _JWKS_TTL = 43_200.0  # 12h, reference iam/jwks.rs cache expiry
 _JWKS_COOLDOWN = 300.0  # failed-fetch cooldown (reference jwks.rs remote cooldown)
 _jwks_cache: Dict[str, tuple] = {}  # url -> (ts, keyset | None on failure)
-_jwks_lock = threading.Lock()
+_jwks_lock = _locks.Lock("iam.jwks")
 
 
 def _jwk_to_pem(jwk: Dict[str, Any]) -> str:

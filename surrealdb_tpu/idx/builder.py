@@ -14,7 +14,7 @@ While an index is building the planner refuses to serve reads from it
 
 from __future__ import annotations
 
-import threading
+from surrealdb_tpu.utils import locks as _locks
 import time
 from typing import Dict, Optional, Tuple
 
@@ -27,7 +27,7 @@ from surrealdb_tpu.utils.ser import unpack
 class IndexBuilder:
     def __init__(self, ds):
         self.ds = ds
-        self._lock = threading.Lock()
+        self._lock = _locks.Lock("idx.builder")
         self._status: Dict[Tuple[str, str, str, str], dict] = {}
 
     # ------------------------------------------------------------ status
@@ -55,12 +55,7 @@ class IndexBuilder:
         task_id = bg.register(
             "index_build", target=f"{tb}.{ix['name']}", owner=id(self.ds)
         )
-        t = threading.Thread(
-            target=self._run, args=(key, ns, db, tb, ix, session, task_id),
-            name=f"bg:index_build:{tb}.{ix['name']}",
-            daemon=True,
-        )
-        t.start()
+        bg.start_thread(task_id, self._run, key, ns, db, tb, ix, session, task_id)
 
     def _ctx(self, session):
         """Fresh executor + write txn + context for one build chunk."""

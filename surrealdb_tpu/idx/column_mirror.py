@@ -34,6 +34,7 @@ path (rows the predicate compiler can't judge are re-checked per row).
 from __future__ import annotations
 
 import threading
+from surrealdb_tpu.utils import locks as _locks
 import time as _time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -239,7 +240,7 @@ class ColumnMirrors:
     version counters the staleness protocol hangs off."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = _locks.RLock("idx.column.registry")
         self.versions: Dict[Tuple[str, str, str], int] = {}
         self._mirrors: Dict[Tuple[str, str, str], ColumnMirror] = {}
         self._build_locks: Dict[Tuple[str, str, str], threading.Lock] = {}
@@ -329,10 +330,14 @@ class ColumnMirrors:
                 self._arm_timer(key3, delay)
 
     def _arm_timer(self, key3, delay: float) -> None:
-        timer = threading.Timer(delay, self._rebuild_cb, args=(key3, None))
+        from surrealdb_tpu import bg
+
+        timer = bg.timer(
+            delay, self._rebuild_cb, key3, None,
+            task_id=self._task_ids.get(key3),
+            name=f"bg:column_mirror:{key3[2]}", start=False,
+        )
         timer.args = (key3, timer)
-        timer.daemon = True
-        timer.name = f"bg:column_mirror:{key3[2]}"
         self._timers[key3] = timer
         timer.start()
 
@@ -427,7 +432,7 @@ class ColumnMirrors:
     def build(self, ds, ns: str, db: str, tb: str) -> Optional[ColumnMirror]:
         key3 = (ns, db, tb)
         with self._lock:
-            bl = self._build_locks.setdefault(key3, threading.Lock())
+            bl = self._build_locks.setdefault(key3, _locks.Lock("idx.column.build"))
         with bl:
             with self._lock:
                 m = self._mirrors.get(key3)

@@ -558,14 +558,18 @@ class FtIndex:
 
             scores = bm25_scores_host(tf_mat, df, lens, st["dc"], st["tl"], k1, b)
         else:
+            from surrealdb_tpu import compile_log
             from surrealdb_tpu.ops.bm25 import bm25_scores
 
-            scores = np.asarray(
-                bm25_scores(
-                    tf_mat, df, lens,
-                    np.float32(st["dc"]), np.float32(st["tl"]), k1, b,
+            with compile_log.tracked(
+                "bm25", (int(tf_mat.shape[0]), int(tf_mat.shape[1]))
+            ):
+                scores = np.asarray(
+                    bm25_scores(
+                        tf_mat, df, lens,
+                        np.float32(st["dc"]), np.float32(st["tl"]), k1, b,
+                    )
                 )
-            )
         resolve = self._rid_resolver(ctx)
         by_rid: Dict[Tuple[str, str], Tuple[Thing, float]] = {}
         for did, s in zip(dids, scores):

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import bisect
 import threading
+from surrealdb_tpu.utils import locks as _locks
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -68,8 +69,8 @@ class FtMirror:
         self.t_tfs: Optional[np.ndarray] = None
         self.doclen_arr: Optional[np.ndarray] = None
         self._pending: Optional[List[tuple]] = None
-        self._lock = threading.RLock()
-        self._build_lock = threading.Lock()
+        self._lock = _locks.RLock("idx.ft.state")
+        self._build_lock = _locks.Lock("idx.ft.build")
 
     # ------------------------------------------------------------ build
     def ensure_built(self, ctx, ix: dict) -> None:
@@ -400,11 +401,19 @@ class FtMirror:
             lens = self.doclen_arr[cand]
             dc, tl = self.dc, self.tl
         if not cnf.TPU_DISABLE and cand.size >= cnf.TPU_FT_ONDEVICE_THRESHOLD:
+            from surrealdb_tpu import compile_log
             from surrealdb_tpu.ops.bm25 import bm25_scores
 
-            scores = np.asarray(
-                bm25_scores(tf_mat, df, lens, np.float32(dc), np.float32(tl), k1, b)
-            )
+            # every distinct (candidates, terms) shape is one XLA compile
+            # (graftlint GL002: the launch site owns the attribution)
+            with compile_log.tracked(
+                "bm25", (int(tf_mat.shape[0]), int(tf_mat.shape[1]))
+            ):
+                scores = np.asarray(
+                    bm25_scores(
+                        tf_mat, df, lens, np.float32(dc), np.float32(tl), k1, b
+                    )
+                )
         else:
             from surrealdb_tpu.ops.bm25 import bm25_scores_host
 

@@ -22,6 +22,7 @@ the exact KV walk (sql/path.py graph_hop).
 from __future__ import annotations
 
 import threading
+from surrealdb_tpu.utils import locks as _locks
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -39,7 +40,7 @@ class NodeInterner:
     def __init__(self):
         self.id_of: Dict[Tuple[str, str], int] = {}
         self.node_of: List[Thing] = []
-        self._lock = threading.Lock()
+        self._lock = _locks.Lock("idx.graph.interner")
 
     def __len__(self) -> int:
         return len(self.node_of)
@@ -79,7 +80,7 @@ class PointerCsr:
         self.edge_count = 0
         self.n_built = 0
         self.max_degree = 0
-        self._lock = threading.Lock()
+        self._lock = _locks.Lock("idx.graph.mirror")
 
     def load(self, adj: Dict[int, List[int]]) -> None:
         with self._lock:
@@ -373,7 +374,7 @@ class GraphMirrors:
         # buffered here and replayed after load (closes the scan→built gap)
         self._building: Dict[Tuple[str, str, str], List[tuple]] = {}
         self._build_locks: Dict[Tuple[str, str, str], threading.Lock] = {}
-        self._lock = threading.RLock()
+        self._lock = _locks.RLock("idx.graph.registry")
         # ingest-time prewarm (cnf.GRAPH_PREWARM): RELATE commits into a
         # not-yet-mirrored table arm a debounced timer; when ingest
         # quiesces, the mirror build + batched-count-kernel compiles run in
@@ -473,7 +474,7 @@ class GraphMirrors:
         with self._lock:
             if key3 in self._built:
                 return
-            bl = self._build_locks.setdefault(key3, threading.Lock())
+            bl = self._build_locks.setdefault(key3, _locks.Lock("idx.graph.build"))
         with bl:
             with self._lock:
                 if key3 in self._built:
@@ -534,10 +535,14 @@ class GraphMirrors:
     # ------------------------------------------------------------ prewarm
     def _arm_timer(self, key3: Tuple[str, str, str], delay: float) -> None:
         """Start one self-identifying timer for key3 (caller holds _lock)."""
-        timer = threading.Timer(delay, self._prewarm, args=(key3, None))
+        from surrealdb_tpu import bg
+
+        timer = bg.timer(
+            delay, self._prewarm, key3, None,
+            task_id=self._task_ids.get(key3),
+            name=f"bg:graph_prewarm:{key3[2]}", start=False,
+        )
         timer.args = (key3, timer)  # the callback must recognise itself
-        timer.daemon = True
-        timer.name = f"bg:graph_prewarm:{key3[2]}"
         self._prewarm_timers[key3] = timer
         timer.start()
 

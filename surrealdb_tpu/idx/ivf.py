@@ -562,6 +562,8 @@ class IvfState:
 
         from surrealdb_tpu.utils.num import dispatch_tile
 
+        from surrealdb_tpu import compile_log
+
         cents, list_rows, list_mask, _ = self._device_sharded(mesh, matrix.shape[0])
         probe_metric = metric if metric in _PROBE_METRICS else "euclidean"
         nprobe = min(nprobe, self.nlists)
@@ -569,7 +571,7 @@ class IvfState:
         tile = dispatch_tile(qs.shape[0], tile)
         dd = np.full((qs.shape[0], k), np.inf, dtype=np.float32)
         rr = np.full((qs.shape[0], k), -1, dtype=np.int64)
-        for lo, hi in tile_slices(qs.shape[0], tile):
+        def one_slice(lo, hi):
             d, r = sharded_ivf_search(
                 mesh, cents, list_rows, list_mask, matrix,
                 jnp.asarray(pad_tail(qs[lo:hi], tile)),
@@ -578,6 +580,20 @@ class IvfState:
             k_out = int(np.asarray(d).shape[1])
             dd[lo:hi, :k_out] = np.asarray(d)[: hi - lo]
             rr[lo:hi, :k_out] = np.asarray(r)[: hi - lo]
+
+        # the sharded probe+rerank compiles per (tile, corpus, k, nprobe,
+        # metrics): only the FIRST slice can compile, so only it is tracked
+        # — wrapping the whole loop would log N tile executions as one
+        # giant phantom "compile" (graftlint GL002)
+        slices = list(tile_slices(qs.shape[0], tile))
+        with compile_log.tracked(
+            "ivf_sharded",
+            (tile, int(matrix.shape[1]), int(matrix.shape[0]), k, nprobe,
+             metric, probe_metric),
+        ):
+            one_slice(*slices[0])
+        for lo, hi in slices[1:]:
+            one_slice(lo, hi)
         return dd, rr
 
 

@@ -16,6 +16,7 @@ vector::distance::knn().
 from __future__ import annotations
 
 import threading
+from surrealdb_tpu.utils import locks as _locks
 import time as _time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -76,8 +77,8 @@ class VectorMirror:
         self._renumber = 0  # bumped when compaction renumbers slots
         self._pending: Optional[List[tuple]] = None  # deltas during build
         self._host_cache = None  # (contig data, sq-norms, rids) for host search
-        self._lock = threading.RLock()
-        self._build_lock = threading.Lock()
+        self._lock = _locks.RLock("idx.knn.state")
+        self._build_lock = _locks.Lock("idx.knn.build")
         self.label = ""  # "<table>.<index>", set on build (task attribution)
         self._owner = None  # id(ds), for bg teardown scoping
 
@@ -323,13 +324,7 @@ class VectorMirror:
         # attributable task (linked to the query that kicked it), named so
         # stack dumps say WHICH index is training
         task_id = bg.register("ivf_train", target=self.label, owner=self._owner)
-        t = threading.Thread(
-            target=self._train_ivf,
-            args=(data, alive, matrix, renum0, task_id),
-            name=f"bg:ivf_train:{self.label}" if self.label else "bg:ivf_train",
-            daemon=True,
-        )
-        t.start()
+        bg.start_thread(task_id, self._train_ivf, data, alive, matrix, renum0, task_id)
         return ivf
 
     def _train_ivf(self, data, alive, matrix, renum0: int, task_id=None) -> None:
@@ -677,6 +672,7 @@ class KnnPlan(_KnnExecutorMixin):
                     key = ("knn-sharded", id(matrix), metric, k)
 
                     def runner(qs):
+                        from surrealdb_tpu import compile_log
                         from surrealdb_tpu.parallel.mesh import sharded_knn
                         from surrealdb_tpu.utils.num import dispatch_tile, pad_tail, tile_slices
 
@@ -685,12 +681,28 @@ class KnnPlan(_KnnExecutorMixin):
                         tile = dispatch_tile(nq)
                         dd = np.empty((nq, k), dtype=np.float32)
                         rr = np.empty((nq, k), dtype=np.int64)
-                        for lo, hi in tile_slices(nq, tile):
+
+                        def one_slice(lo, hi):
                             d, r = sharded_knn(
                                 mesh, matrix, mask_dev, pad_tail(qs_m[lo:hi], tile), k, metric
                             )
                             dd[lo:hi] = np.asarray(d)[: hi - lo]
                             rr[lo:hi] = np.asarray(r)[: hi - lo]
+
+                        # one executable per (tile, corpus dims, metric, k)
+                        # on the mesh: only the FIRST slice can compile, so
+                        # only it is tracked — wrapping the whole loop would
+                        # log N tile executions as one giant phantom
+                        # "compile" (graftlint GL002)
+                        slices = list(tile_slices(nq, tile))
+                        with compile_log.tracked(
+                            "knn_sharded",
+                            (tile, int(matrix.shape[1]), int(matrix.shape[0]),
+                             metric, k),
+                        ):
+                            one_slice(*slices[0])
+                        for lo, hi in slices[1:]:
+                            one_slice(lo, hi)
                         return list(zip(dd, rr))
 
                     dists, slots = ds.dispatch.submit(key, q, runner)

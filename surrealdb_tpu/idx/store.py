@@ -10,7 +10,7 @@ is behind the KV state generation.
 
 from __future__ import annotations
 
-import threading
+from surrealdb_tpu.utils import locks as _locks
 from typing import Any, Dict, Optional, Tuple
 
 IndexKey = Tuple[str, str, str, str]  # ns, db, tb, ix
@@ -19,7 +19,7 @@ IndexKey = Tuple[str, str, str, str]  # ns, db, tb, ix
 class IndexStores:
     def __init__(self):
         self._stores: Dict[IndexKey, Any] = {}
-        self._lock = threading.RLock()
+        self._lock = _locks.RLock("idx.store")
 
     def get(self, ns: str, db: str, tb: str, ix: str) -> Optional[Any]:
         with self._lock:

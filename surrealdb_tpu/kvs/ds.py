@@ -8,7 +8,7 @@ registry, and the live-query notification channel.
 
 from __future__ import annotations
 
-import threading
+from surrealdb_tpu.utils import locks as _locks
 import uuid as _uuid
 from typing import Any, Dict, List, Optional
 
@@ -51,7 +51,7 @@ class Datastore:
         # serializes backend commit + mirror-delta application so two
         # concurrently committing transactions can't apply graph/vector
         # deltas in the opposite order of their backend commits (advisor r2)
-        self.commit_lock = threading.Lock()
+        self.commit_lock = _locks.Lock("kvs.commit")
         # live queries: uuid(hex) -> LiveSubscription (registered in M10)
         self.notifications = None  # set by enable_notifications()
         self.auth_enabled = False

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import os
 import struct
-import threading
+from surrealdb_tpu.utils import locks as _locks
 import zlib
 
 from surrealdb_tpu import cnf
@@ -111,7 +111,7 @@ class FileDatastore(BackendDatastore):
         self.path = path
         self.wal_path = path + ".wal"
         self.mem = MemDatastore()
-        self._lock = threading.Lock()
+        self._lock = _locks.Lock("kvs.file")
         self._wal_f = None
         self._wal_size = 0
         if os.path.exists(path):

@@ -14,7 +14,7 @@ applied atomically (no awaits inside).
 
 from __future__ import annotations
 
-import threading
+from surrealdb_tpu.utils import locks as _locks
 from typing import Dict, List, Optional, Tuple
 
 try:
@@ -97,7 +97,7 @@ class MemDatastore(BackendDatastore):
         self.data: Dict[bytes, list] = {}
         self.sorted_keys: SortedList = SortedList()
         self.version: int = 0
-        self.lock = threading.RLock()
+        self.lock = _locks.RLock("kvs.mem")
         self.active: Dict[int, int] = {}  # snapshot version -> refcount
 
     # -- snapshots ---------------------------------------------------------

@@ -70,17 +70,16 @@ class Transaction:
             tr.cancel()  # always release the snapshot refcount
             if not leaked_write:
                 return
-            import os
             import warnings
 
-            from surrealdb_tpu import telemetry
+            from surrealdb_tpu import cnf, telemetry
 
             telemetry.inc("unfinished_txns")
             msg = (
                 "write transaction garbage-collected with uncommitted writes "
                 "(missing commit()/cancel())"
             )
-            if os.environ.get("PYTEST_CURRENT_TEST"):
+            if cnf.under_pytest():
                 raise RuntimeError(msg)
             warnings.warn(msg, ResourceWarning, stacklevel=2)
         except (AttributeError, ImportError, TypeError):
@@ -118,6 +117,12 @@ class Transaction:
             # BEFORE the backend commit (and under the datastore commit
             # lock, see commit()): any reader whose snapshot will include
             # these writes then provably sees the bumped version too
+            if self._commit_lock is not None:
+                from surrealdb_tpu.utils import locks as _locks
+
+                _locks.assert_held(
+                    self._commit_lock, "column_mirror.versions (commit bump)"
+                )
             cm.invalidate(self.touched_tables, self.touched_scopes)
         self.tr.commit()
         if cm is not None and self.touched_tables:

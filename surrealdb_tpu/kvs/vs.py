@@ -29,10 +29,10 @@ class Oracle:
     """Monotone versionstamp source, one per datastore."""
 
     def __init__(self):
-        import threading
+        from surrealdb_tpu.utils import locks as _locks
 
         self._last = 0
-        self._lock = threading.Lock()
+        self._lock = _locks.Lock("kvs.version_store")
 
     def next_vs(self, now_nanos: int) -> bytes:
         with self._lock:

@@ -10,7 +10,7 @@ config 5 (model scored over a full-table scan).
 
 from __future__ import annotations
 
-import threading
+from surrealdb_tpu.utils import locks as _locks
 from typing import Any, Optional
 
 import numpy as np
@@ -20,7 +20,7 @@ from surrealdb_tpu.obs import get_blob, put_blob
 
 from .model import CompiledModel, spec_from_bytes, spec_to_bytes, validate_spec
 
-_cache_lock = threading.Lock()
+_cache_lock = _locks.Lock("ml.cache")
 
 
 def _model_cache(ds) -> dict:

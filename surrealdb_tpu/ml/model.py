@@ -20,6 +20,7 @@ model is unwrapped to a scalar per row.
 from __future__ import annotations
 
 import hashlib
+import itertools
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -152,11 +153,17 @@ def _np_act(x: np.ndarray, act: Optional[str]) -> np.ndarray:
     return x
 
 
+_MODEL_SEQ = itertools.count(1)
+
+
 class CompiledModel:
     """One (model, version): host twin + lazily-jitted device forward."""
 
     def __init__(self, spec: dict):
         self.spec = spec
+        # distinguishes compile-log shape keys of dimension-twin models
+        # (each instance jits its own executable)
+        self.seq = next(_MODEL_SEQ)
         self._graph = None
         if spec["format"] == "onnx":
             from .onnx_mini import OnnxGraph
@@ -232,4 +239,12 @@ class CompiledModel:
             x = np.concatenate([x, np.zeros((cap - n, x.shape[1]), np.float32)])
         import jax.numpy as jnp
 
-        return np.asarray(fwd(jnp.asarray(x.astype(np.float32))))[:n]
+        from surrealdb_tpu import compile_log
+
+        # each distinct padded batch width is one XLA executable per model:
+        # the first call through it IS the compile — record + attribute it
+        # (graftlint GL002: no phantom unattributed compiles)
+        with compile_log.tracked(
+            "ml_forward", (self.seq, cap, self.in_dim, self.out_dim)
+        ):
+            return np.asarray(fwd(jnp.asarray(x.astype(np.float32))))[:n]

@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 import queue
 import threading
+from surrealdb_tpu.utils import locks as _locks
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 from urllib.parse import urlparse
@@ -29,6 +30,12 @@ from surrealdb_tpu.sql.value import to_json_value
 from surrealdb_tpu.utils.ser import wire_pack as pack, wire_unpack
 
 from . import ws as wsproto
+
+# deterministic per-connection labels for the WS service threads
+# (bg:ws_pump:connN / bg:ws_worker:connN.i in stack dumps + task registry)
+import itertools as _itertools
+
+_WS_CONN_SEQ = _itertools.count(1)
 
 
 class BodyTooLarge(Exception):
@@ -728,7 +735,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
             sess = Session.owner(None, None)
             sess.ns = sess.db = None
         ctx = RpcContext(self.ds, sess)
-        send_lock = threading.Lock()
+        send_lock = _locks.Lock("net.ws_send")
         alive = {"v": True}
         # wire format follows the client's most recent request frame so JSON
         # (text) clients receive notifications they can actually decode
@@ -766,8 +773,13 @@ class SurrealHandler(BaseHTTPRequestHandler):
                     _t.sleep(0.02)
 
         self.ds.enable_notifications()
-        t = threading.Thread(target=pump, daemon=True)
-        t.start()
+        # flight-recorder registration: the pump used to be an anonymous
+        # daemon thread — a blind spot in every stack dump and task-registry
+        # view (graftlint GL001). conn label makes the name deterministic.
+        from surrealdb_tpu import bg
+
+        conn = f"conn{next(_WS_CONN_SEQ)}"
+        bg.spawn_service("ws_pump", conn, pump, owner=id(self.ds))
 
         # per-socket concurrent request pool (reference: the WS actor's
         # concurrent-request semaphore, src/rpc/connection.rs:80-147).
@@ -779,7 +791,10 @@ class SurrealHandler(BaseHTTPRequestHandler):
         from surrealdb_tpu.net.ws import DaemonPool
 
         telemetry.gauge_add("ws_connections", 1)
-        pool = DaemonPool(max(cnf.WEBSOCKET_MAX_CONCURRENT_REQUESTS, 1))
+        pool = DaemonPool(
+            max(cnf.WEBSOCKET_MAX_CONCURRENT_REQUESTS, 1),
+            target=conn, owner=id(self.ds),
+        )
         inflight: list = []
         _SESSION_METHODS = {
             "use", "signin", "signup", "authenticate", "invalidate",
@@ -946,10 +961,9 @@ class Server:
                 except Exception:  # noqa: BLE001 — maintenance must not die
                     pass
 
-        self._ticker = threading.Thread(
-            target=tick_loop, name="bg:tick", daemon=True
-        )
-        self._ticker.start()
+        from surrealdb_tpu import bg
+
+        self._ticker = bg.spawn_service("tick", "server", tick_loop, owner=id(ds))
 
     @property
     def url(self) -> str:
@@ -957,8 +971,11 @@ class Server:
         return f"{scheme}://{self.host}:{self.port}"
 
     def start_background(self) -> "Server":
-        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
-        self._thread.start()
+        from surrealdb_tpu import bg
+
+        self._thread = bg.spawn_service(
+            "http_serve", f"{self.host}:{self.port}", self.httpd.serve_forever
+        )
         return self
 
     def serve_forever(self) -> None:

@@ -146,17 +146,24 @@ class DaemonPool:
     when the task finishes (exceptions included — tasks handle their own
     errors)."""
 
-    def __init__(self, workers: int):
+    def __init__(self, workers: int, target: str = "", owner=None):
         import queue as _queue
-        import threading as _threading
+
+        from surrealdb_tpu import bg
 
         self._q: "_queue.Queue" = _queue.Queue()
+        # flight-recorder registration (graftlint GL001): each worker is a
+        # bg SERVICE task — deterministic bg:ws_worker:<conn>.<i> names,
+        # visible in the task registry, resolved when shutdown() drains
         self._threads = [
-            _threading.Thread(target=self._worker, daemon=True, name=f"ws-pool-{i}")
+            bg.spawn_service(
+                "ws_worker",
+                f"{target}.{i}" if target else str(i),
+                self._worker,
+                owner=owner,
+            )
             for i in range(max(workers, 1))
         ]
-        for t in self._threads:
-            t.start()
 
     def _worker(self) -> None:
         import time as _time

@@ -12,7 +12,7 @@ import http.client
 import itertools
 import queue
 import socket
-import threading
+from surrealdb_tpu.utils import locks as _locks
 from typing import Any, Dict, List, Optional
 from urllib.parse import urlparse
 
@@ -182,10 +182,16 @@ class WsEngine:
         self._ids = itertools.count(1)
         self._pending: Dict[int, "queue.Queue[Any]"] = {}
         self._notifications: Dict[str, "queue.Queue[Any]"] = {}
-        self._lock = threading.Lock()
+        self._lock = _locks.Lock("sdk.ws_client")
         self._closed = False
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
-        self._reader.start()
+        # registered service thread (graftlint GL001): the reader shows up
+        # in the task registry as bg:sdk_reader:<host>:<port> instead of an
+        # anonymous daemon — embedded test/SDK processes share the registry
+        from surrealdb_tpu import bg
+
+        self._reader = bg.spawn_service(
+            "sdk_reader", f"{self.host}:{self.port}", self._read_loop
+        )
 
     def _read_loop(self) -> None:
         try:

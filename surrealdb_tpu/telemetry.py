@@ -23,13 +23,14 @@ environment has no OTLP collector, so the equivalent surface is:
 from __future__ import annotations
 
 import threading
+from surrealdb_tpu.utils import locks as _locks
 import time
 from bisect import bisect_left
 from collections import deque
 from contextlib import contextmanager, nullcontext
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
-_lock = threading.Lock()
+_lock = _locks.Lock("telemetry.registry")
 _enabled = False
 _spans: Deque[Tuple[str, float, float]] = deque(maxlen=4096)  # (name, start, dur_s)
 

@@ -34,7 +34,7 @@ import contextvars
 import itertools
 import random
 import re
-import threading
+from surrealdb_tpu.utils import locks as _locks
 import time
 import uuid
 from collections import OrderedDict
@@ -103,7 +103,7 @@ _current: "contextvars.ContextVar[Optional[SpanCtx]]" = contextvars.ContextVar(
     "surreal_trace", default=None
 )
 
-_store_lock = threading.Lock()
+_store_lock = _locks.Lock("tracing.store")
 _store: "OrderedDict[str, dict]" = OrderedDict()  # trace_id -> finished doc
 
 

@@ -37,7 +37,20 @@ def pytest_sessionfinish(session, exitstatus):
     """Flight-recorder CI hook: a failing suite dumps its own diagnostics
     (task registry, compile log, slow/error rings, traces) from INSIDE the
     dying process — scripts/tier1.sh points SURREAL_T1_BUNDLE at
-    /tmp/_t1_bundle.json so failed runs carry their own bundle."""
+    /tmp/_t1_bundle.json so failed runs carry their own bundle.
+
+    Under SURREAL_SANITIZE=1 with SURREAL_SANITIZE_OUT set, the lock
+    sanitizer's observed acquisition graph is dumped too (success or
+    failure) — scripts/tier1.sh feeds it to the graftlint lock-order
+    cross-check."""
+    sanitize_out = os.environ.get("SURREAL_SANITIZE_OUT")
+    if sanitize_out:
+        try:
+            from surrealdb_tpu.utils import locks
+
+            locks.dump(sanitize_out)
+        except Exception:  # noqa: BLE001
+            pass
     path = os.environ.get("SURREAL_T1_BUNDLE")
     if not path or exitstatus in (0, 5):  # 5 = no tests collected
         return

@@ -1,0 +1,13 @@
+"""GL001 fixture: raw thread/timer spawns the flight recorder can't see.
+Never imported — parsed by the lint engine only."""
+
+import threading
+
+
+def orphan_thread():
+    t = threading.Thread(target=print, daemon=True)
+    t.start()
+
+
+def orphan_timer():
+    threading.Timer(1.0, print).start()

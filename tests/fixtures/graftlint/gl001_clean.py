@@ -1,0 +1,13 @@
+"""GL001 clean twin: all spawning goes through bg.py."""
+
+
+def registered_thread():
+    from surrealdb_tpu import bg
+
+    bg.spawn("demo", "fixture", print)
+
+
+def registered_service():
+    from surrealdb_tpu import bg
+
+    bg.spawn_service("demo_service", "fixture", print)

@@ -1,0 +1,17 @@
+"""GL002 fixture: jit sites with no compile_log wiring (phantom compiles)."""
+
+import functools
+
+import jax
+
+
+@jax.jit
+def kernel(x):
+    return x * 2
+
+
+masked = functools.partial(jax.jit, static_argnames=("n",))
+
+
+def launch(x):
+    return kernel(x)

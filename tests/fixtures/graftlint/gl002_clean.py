@@ -1,0 +1,15 @@
+"""GL002 clean twin: the launch site is compile_log-tracked."""
+
+import jax
+
+
+@jax.jit
+def kernel(x):
+    return x * 2
+
+
+def launch(x):
+    from surrealdb_tpu import compile_log
+
+    with compile_log.tracked("fixture", (int(x.shape[0]),)):
+        return kernel(x)

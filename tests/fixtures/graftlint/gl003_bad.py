@@ -1,0 +1,10 @@
+"""GL003 fixture: configuration read outside cnf.py."""
+
+import os
+
+FLAG = os.environ.get("SURREAL_FIXTURE_FLAG", "0")
+OTHER = os.getenv("SURREAL_FIXTURE_OTHER")
+
+
+def late_read():
+    return os.environ["SURREAL_FIXTURE_LATE"]

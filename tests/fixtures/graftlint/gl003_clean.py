@@ -1,0 +1,9 @@
+"""GL003 clean twin: knobs come from cnf."""
+
+from surrealdb_tpu import cnf
+
+FLAG = cnf.env_bool("SURREAL_FIXTURE_FLAG", False)
+
+
+def late_read():
+    return cnf.env_str("SURREAL_FIXTURE_LATE")

@@ -1,0 +1,8 @@
+"""GL004 fixture: a transaction handle that can leak (no commit/cancel,
+never escapes)."""
+
+
+def leaky(ds):
+    txn = ds.transaction(True)
+    txn.set_record(b"k", {"v": 1})
+    return 42

@@ -1,0 +1,21 @@
+"""GL004 clean twin: finished on all paths, or ownership escapes."""
+
+
+def finished(ds):
+    txn = ds.transaction(True)
+    try:
+        txn.set_record(b"k", {"v": 1})
+        txn.commit()
+    except Exception:
+        txn.cancel()
+        raise
+
+
+def escapes_by_return(ds):
+    txn = ds.transaction(False)
+    return txn
+
+
+def escapes_by_call(ds, runner):
+    txn = ds.transaction(False)
+    runner(txn)

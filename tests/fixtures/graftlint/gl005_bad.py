@@ -1,0 +1,11 @@
+"""GL005 fixture: blocking host syncs on a dispatch hot path.
+# graftlint: hot-path
+"""
+
+import numpy as np
+
+
+def launch_phase(batch, dev_result):
+    arr = np.asarray(dev_result)  # blocks every rider
+    dev_result.block_until_ready()
+    return arr.tolist()

@@ -1,0 +1,10 @@
+"""GL006 fixture: metric-name and label-cardinality hazards."""
+
+from surrealdb_tpu import telemetry
+
+
+def emit(name, sql):
+    telemetry.inc(name)  # dynamic metric name
+    telemetry.inc("fixture_queries", sql=sql)  # forbidden label key
+    telemetry.observe("fixture_latency", 0.1, route="a")
+    telemetry.observe("fixture_latency", 0.2)  # inconsistent label set

@@ -1,0 +1,9 @@
+"""GL006 clean twin: static names, consistent low-cardinality labels."""
+
+from surrealdb_tpu import telemetry
+
+
+def emit():
+    telemetry.inc("fixture_queries_ok", kind="select")
+    telemetry.observe("fixture_latency_ok", 0.1, route="a")
+    telemetry.observe("fixture_latency_ok", 0.2, route="b")

@@ -152,9 +152,21 @@ def test_wedged_mirror_rebuild_surfaces(ds, monkeypatch):
         )
         assert telemetry.get_counter("bg_task_stalled", kind="column_mirror") >= 1
         assert "surreal_bg_task_stalled_total" in telemetry.render_prometheus()
+        # the watchdog sampled WHERE the wedged thread is stuck
+        # (sys._current_frames): the stack tail names the wedge site
+        assert _wait(
+            lambda: any(
+                t["state"] == "stalled"
+                and t["stack"]
+                and any("wedged" in ln for ln in t["stack"])
+                for t in bg.snapshot()["live"]
+            ),
+            timeout=4.0,
+        )
         b = debug_bundle(ds)
         stalled = [t for t in b["tasks"]["live"] if t["state"] == "stalled"]
         assert any(t["target"].endswith(".t") for t in stalled)
+        assert any(t["stack"] for t in stalled)  # stack rides into the bundle
         # the engine section knows the mirror is stale + a rebuild exists
         key = next(k for k in b["engine"]["column_mirrors"] if k.endswith(".t"))
         assert b["engine"]["column_mirrors"][key]["stale"] is True
