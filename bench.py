@@ -63,22 +63,20 @@ import time
 import numpy as np
 
 SCALE = float(os.environ.get("SURREAL_BENCH_SCALE", "1.0"))
-CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6").split(","))
-ROUND = os.environ.get("SURREAL_BENCH_ROUND", "r09")
+CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6,7").split(","))
+ROUND = os.environ.get("SURREAL_BENCH_ROUND", "r10")
 OUT_PATH = os.environ.get(
     "SURREAL_BENCH_OUT",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), f"bench_results_{ROUND}.json"),
 )
 PROFILE = "--profile" in sys.argv[1:] or os.environ.get("SURREAL_PROFILE") == "1"
-# schema/5 (r9, the flight recorder): every per-config line carries
-# `bg_tasks` — registry-derived overlap accounting (WHICH background task
-# kinds ran inside the measurement window, with overlap durations and
-# stall flags; replaces the ad-hoc ann_training_overlap boolean) — and
-# `compiles` — the XLA compile events in the window, each attributed
-# prewarm vs on-demand (with the owning trace id). The artifact also
-# embeds a full debug bundle (bundle.py) so a perf number always ships
-# with the engine state that produced it.
-SCHEMA = "surrealdb-tpu-bench/5"
+# schema/6 (r10, cluster mode): new config 7 — a 2-node in-process cluster
+# (surrealdb_tpu/cluster/) serving the same sharded dataset; its line
+# carries a `cluster` object (node count, per-node row spread, merged-
+# result parity vs a single node for WHERE/kNN/BM25, and the node ids a
+# single request's span tree covered). Everything schema/5 carried
+# (bg_tasks/compiles accounting + the embedded debug bundle) stays.
+SCHEMA = "surrealdb-tpu-bench/6"
 
 D = 768
 NI = max(int(1_000_000 * SCALE), 1024)  # item corpus (configs 2/4/5)
@@ -928,6 +926,148 @@ def bench_filtered_scan(ds, s):
     return ratio
 
 
+def bench_cluster(rng):
+    """Config 7: 2-node sharded serving (surrealdb_tpu/cluster/) over its
+    own small corpus — measures coordinator kNN qps and PROVES merged-
+    result parity: the cluster must return byte-identical results to a
+    single node holding the same dataset for SELECT-with-WHERE, exact kNN
+    top-k and BM25 (the scatter/gather executor's correctness contract).
+    Self-contained: builds its own nodes, never touches the main ds."""
+    import uuid as _uuid
+
+    from surrealdb_tpu import cluster as _cluster, tracing
+    from surrealdb_tpu.dbs.session import Session
+    from surrealdb_tpu.kvs.ds import Datastore
+    from surrealdb_tpu.net.server import serve as _serve
+
+    n = max(min(int(4096 * SCALE), 4096), 256)
+    d = min(D, 64)  # merge mechanics, not corpus scale — keep the wire light
+    s = Session.owner("bench", "bench")
+    ref = Datastore("memory")
+    srv1 = _serve("memory", port=0, auth_enabled=False).start_background()
+    srv2 = _serve("memory", port=0, auth_enabled=False).start_background()
+    nodes = [{"id": "n1", "url": srv1.url}, {"id": "n2", "url": srv2.url}]
+    ds1 = srv1.httpd.RequestHandlerClass.ds
+    ds2 = srv2.httpd.RequestHandlerClass.ds
+    _cluster.attach(ds1, _cluster.ClusterConfig(nodes, "n1", secret="bench"))
+    _cluster.attach(ds2, _cluster.ClusterConfig(nodes, "n2", secret="bench"))
+    try:
+        ddl = (
+            "DEFINE TABLE item SCHEMALESS; "
+            "DEFINE TABLE doc SCHEMALESS; "
+            "DEFINE ANALYZER simple TOKENIZERS blank,class FILTERS lowercase; "
+            "DEFINE INDEX fbody ON doc FIELDS body SEARCH ANALYZER simple BM25"
+        )
+        for target in (ref.execute, ds1.execute):
+            for r in target(ddl, s):
+                assert r["status"] == "OK", r
+        corpus = rng.standard_normal((n, d)).astype(np.float32)
+        vals = rng.random(n)
+        vocab = [f"w{i}" for i in range(60)]
+        t0 = time.perf_counter()
+        for lo in range(0, n, 512):
+            hi = min(lo + 512, n)
+            rows = [
+                {
+                    "id": i,
+                    "emb": corpus[i].tolist(),
+                    "val": float(vals[i]),
+                    # distinct tf profiles -> distinct BM25 scores, so the
+                    # byte-identical comparison is order-meaningful
+                    "body": " ".join(
+                        vocab[int(w)] for w in rng.integers(0, 60, size=4 + i % 5)
+                    ),
+                }
+                for i in range(lo, hi)
+            ]
+            for target in (ref.execute, ds1.execute):
+                r = target("INSERT INTO item $rows", s, {"rows": [
+                    {k: row[k] for k in ("id", "emb", "val")} for row in rows
+                ]})
+                assert r[0]["status"] == "OK", r
+                r = target("INSERT INTO doc $rows", s, {"rows": [
+                    {"id": row["id"], "body": row["body"]} for row in rows
+                ]})
+                assert r[0]["status"] == "OK", r
+        ingest_s = time.perf_counter() - t0
+        spread = {}
+        for name, node_ds in (("n1", ds1), ("n2", ds2)):
+            c = node_ds.execute_local("SELECT count() FROM item GROUP ALL", s)
+            rows_held = c[0]["result"][0]["count"] if c[0]["result"] else 0
+            spread[name] = int(rows_held)
+        assert sum(spread.values()) == n, spread
+
+        # ---- merged-result parity (the correctness contract)
+        where_sql = "SELECT * FROM item WHERE val < 0.25"
+        knn_sql = "SELECT id FROM item WHERE emb <|10|> $q"
+        bm_sql = (
+            "SELECT id, search::score(1) AS sc FROM doc "
+            "WHERE body @1@ 'w3 w7' ORDER BY sc DESC LIMIT 10"
+        )
+        qv = {"q": (corpus[17] + 0.01).tolist()}
+        parity = {
+            "where": ref.execute(where_sql, s)[0]["result"]
+            == ds1.execute(where_sql, s)[0]["result"],
+            "knn": ref.execute(knn_sql, s, dict(qv))[0]["result"]
+            == ds1.execute(knn_sql, s, dict(qv))[0]["result"],
+            "bm25": ref.execute(bm_sql, s)[0]["result"]
+            == ds1.execute(bm_sql, s)[0]["result"],
+        }
+
+        # ---- one request, one span tree across nodes
+        tid = _uuid.uuid4().hex
+        with tracing.request("bench_cluster", trace_id=tid):
+            tracing.force_keep()
+            ds1.execute(where_sql, s)
+        doc = tracing.get_trace(tid) or {"spans": []}
+        trace_nodes = sorted(
+            {sp["labels"]["node"] for sp in doc["spans"] if "node" in sp["labels"]}
+        )
+
+        # ---- kNN qps through the coordinator vs the single node
+        nq = 24
+        qs = corpus[rng.integers(0, n, size=nq)] + 0.01
+        queries = [{"q": qs[i].tolist()} for i in range(nq)]
+        for target in (ds1, ref):  # warm both paths
+            target.execute(knn_sql, s, dict(queries[0]))
+        t0 = time.perf_counter()
+        for v in queries:
+            r = ds1.execute(knn_sql, s, dict(v))
+            assert r[0]["status"] == "OK", r
+        cl_qps = nq / (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for v in queries:
+            ref.execute(knn_sql, s, dict(v))
+        single_qps = nq / (time.perf_counter() - t0)
+
+        emit(
+            {
+                "metric": f"cluster_knn_qps_2nodes_{n}x{d}",
+                "value": round(cl_qps, 2),
+                "unit": "qps",
+                "vs_baseline": None,
+                "single_node_qps": round(single_qps, 2),
+                "scale_ratio": round(cl_qps / single_qps, 3) if single_qps else None,
+                "ingest_s": round(ingest_s, 2),
+                "cluster": {
+                    "nodes": len(nodes),
+                    "per_node_rows": spread,
+                    "parity": all(parity.values()),
+                    "parity_detail": parity,
+                    "trace_nodes": trace_nodes,
+                },
+            }
+        )
+        assert all(parity.values()), f"cluster parity broken: {parity}"
+    finally:
+        srv1.shutdown()
+        srv2.shutdown()
+        ds1.close()
+        ds2.close()
+        ref.close()
+    return None  # scale-out ratio, not a vs-CPU speedup: keep out of the geomean
+
+
 def bench_ml_scan(ds, s, rng):
     from surrealdb_tpu.ml.exec import import_model
 
@@ -1070,6 +1210,8 @@ def main() -> None:
         run_cfg("3", lambda: bench_bm25(ds, s, rng))
     if CONFIGS & {"2", "4", "5", "6"}:
         need_corpus()
+    if "7" in CONFIGS:
+        run_cfg("7", lambda: bench_cluster(rng))
     if "5" in CONFIGS:
         run_cfg("5", lambda: bench_ml_scan(ds, s, rng))
     if "6" in CONFIGS:

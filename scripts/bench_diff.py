@@ -17,7 +17,15 @@ config also cites the background tasks and on-demand compiles that ran in
 its window (the usual suspects). Exit code 1 when anything was flagged,
 0 otherwise (pipe-friendly: use `|| true` where the diff is informational).
 
-Also importable: `diff(old_art, new_art, threshold) -> list[dict]`.
+`--bundles` compares the two runs' EMBEDDED debug bundles instead (or two
+standalone surrealdb-tpu-bundle/1 files from GET /debug/bundle): column-
+mirror staleness flips, tables that appeared/vanished, compile-cache drift
+(shapes compiled in one round but not the other, on-demand compile counts),
+ANN quantizer state changes, and dispatch counter ratios — the round-over-
+round engine-state attribution the per-config metric deltas can't show.
+
+Also importable: `diff(old_art, new_art, threshold) -> list[dict]` and
+`diff_bundles(old_bundle, new_bundle) -> dict`.
 """
 
 from __future__ import annotations
@@ -120,6 +128,127 @@ def diff(old: dict, new: dict, threshold: float = 0.25) -> List[dict]:
     return rows
 
 
+# ------------------------------------------------------------------ bundles
+def _as_bundle(doc: dict) -> Optional[dict]:
+    """Accept a standalone bundle (GET /debug/bundle) or a bench artifact
+    embedding one."""
+    if not isinstance(doc, dict):
+        return None
+    if str(doc.get("schema", "")).startswith("surrealdb-tpu-bundle/"):
+        return doc
+    b = doc.get("bundle")
+    if isinstance(b, dict):
+        return b
+    return None
+
+
+def diff_bundles(old: dict, new: dict) -> dict:
+    """Engine-state drift between two debug bundles: mirror staleness and
+    compile-cache movement — what changed under the numbers between rounds."""
+    out: Dict[str, Any] = {"flags": [], "columns": {}, "compiles": {}, "ann": {}}
+
+    # ---- column-mirror staleness drift
+    oc = (old.get("engine") or {}).get("column_mirrors") or {}
+    nc = (new.get("engine") or {}).get("column_mirrors") or {}
+    for tb in sorted(set(oc) | set(nc)):
+        o, n = oc.get(tb), nc.get(tb)
+        if o is None:
+            out["columns"][tb] = {"change": "appeared", "stale": bool(n.get("stale"))}
+            continue
+        if n is None:
+            out["columns"][tb] = {"change": "vanished"}
+            continue
+        entry = {
+            "rows": [o.get("rows"), n.get("rows")],
+            "stale": [bool(o.get("stale")), bool(n.get("stale"))],
+            "rebuild_armed": [bool(o.get("rebuild_armed")), bool(n.get("rebuild_armed"))],
+        }
+        out["columns"][tb] = entry
+        if not o.get("stale") and n.get("stale"):
+            out["flags"].append(
+                f"column mirror {tb} went STALE between rounds "
+                "(queries fall back to the row path until it rebuilds)"
+            )
+
+    # ---- compile-cache drift
+    ocm = old.get("compiles") or {}
+    ncm = new.get("compiles") or {}
+
+    def shapes(c):
+        return {
+            f"{e.get('subsystem')}:{e.get('shape')}"
+            for e in (c.get("events") or [])
+        }
+
+    os_, ns_ = shapes(ocm), shapes(ncm)
+    out["compiles"] = {
+        "on_demand": [ocm.get("on_demand"), ncm.get("on_demand")],
+        "prewarmed": [ocm.get("prewarmed"), ncm.get("prewarmed")],
+        "only_in_new": sorted(ns_ - os_),
+        "only_in_old": sorted(os_ - ns_),
+    }
+    new_od = int(ncm.get("on_demand") or 0)
+    old_od = int(ocm.get("on_demand") or 0)
+    if new_od > old_od:
+        out["flags"].append(
+            f"on-demand XLA compiles rose {old_od} -> {new_od} — a shape the "
+            "warmers used to cover is compiling inside requests"
+        )
+    if ns_ - os_:
+        out["flags"].append(
+            f"{len(ns_ - os_)} kernel shape(s) compiled this round that the "
+            "old round never saw (shape drift — check dispatch widths/knobs)"
+        )
+
+    # ---- ANN quantizer drift
+    ov = (old.get("engine") or {}).get("vector_indexes") or {}
+    nv = (new.get("engine") or {}).get("vector_indexes") or {}
+    for ix in sorted(set(ov) | set(nv)):
+        o_state = ((ov.get(ix) or {}).get("ann") or {}).get("state")
+        n_state = ((nv.get(ix) or {}).get("ann") or {}).get("state")
+        out["ann"][ix] = [o_state, n_state]
+        if o_state == "ready" and n_state in ("stale", "training", "none"):
+            out["flags"].append(
+                f"ANN quantizer {ix}: {o_state} -> {n_state} — kNN may be "
+                "serving the exact fallback path this round"
+            )
+
+    # ---- dispatch counter ratios (retry/split pressure)
+    od = ((old.get("engine") or {}).get("dispatch") or {}).get("stats") or {}
+    nd = ((new.get("engine") or {}).get("dispatch") or {}).get("stats") or {}
+    out["dispatch"] = {k: [od.get(k), nd.get(k)] for k in sorted(set(od) | set(nd))}
+    for counter in ("retries", "splits", "failures"):
+        o_n, n_n = od.get(counter) or 0, nd.get(counter) or 0
+        o_d, n_d = max(od.get("dispatches") or 1, 1), max(nd.get("dispatches") or 1, 1)
+        if n_n / n_d > (o_n / o_d) * 2 and n_n > o_n:
+            out["flags"].append(
+                f"dispatch {counter} rate doubled between rounds "
+                f"({o_n}/{o_d} -> {n_n}/{n_d})"
+            )
+    return out
+
+
+def _main_bundles(old_doc: dict, new_doc: dict) -> int:
+    ob, nb = _as_bundle(old_doc), _as_bundle(new_doc)
+    if ob is None or nb is None:
+        print(
+            "not a bundle: inputs must be surrealdb-tpu-bundle/1 files or "
+            "artifacts embedding one (schema /5+)",
+            file=sys.stderr,
+        )
+        return 2
+    rep = diff_bundles(ob, nb)
+    for tb, entry in sorted(rep["columns"].items()):
+        print(f"column {tb}: {json.dumps(entry)}")
+    print(f"compiles: {json.dumps(rep['compiles'])}")
+    for ix, states in sorted(rep["ann"].items()):
+        print(f"ann {ix}: {states[0]} -> {states[1]}")
+    for fl in rep["flags"]:
+        print(f"FLAG  {fl}")
+    print(f"{len(rep['flags'])} drift flag(s)")
+    return 1 if rep["flags"] else 0
+
+
 def main(argv: List[str]) -> int:
     import argparse
 
@@ -132,6 +261,11 @@ def main(argv: List[str]) -> int:
     ap.add_argument(
         "--threshold", type=float, default=0.25,
         help="relative delta that flags (default 0.25 = 25%%)",
+    )
+    ap.add_argument(
+        "--bundles", action="store_true",
+        help="diff the two runs' debug bundles (mirror staleness, "
+        "compile-cache drift) instead of the metric lines",
     )
     try:
         ns = ap.parse_args(argv)
@@ -146,6 +280,8 @@ def main(argv: List[str]) -> int:
     except (OSError, json.JSONDecodeError) as e:
         print(f"unreadable artifact: {e}", file=sys.stderr)
         return 2
+    if ns.bundles:
+        return _main_bundles(old, new)
     rows = diff(old, new, threshold)
     if not rows:
         print("no comparable configs between the two artifacts", file=sys.stderr)

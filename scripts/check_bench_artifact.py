@@ -23,13 +23,14 @@ import os
 import sys
 from typing import List
 
-SCHEMA = "surrealdb-tpu-bench/5"
+SCHEMA = "surrealdb-tpu-bench/6"
 # earlier rounds' committed artifacts stay validatable under their own rules
 KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/1",
     "surrealdb-tpu-bench/2",
     "surrealdb-tpu-bench/3",
     "surrealdb-tpu-bench/4",
+    "surrealdb-tpu-bench/5",
     SCHEMA,
 )
 
@@ -55,6 +56,11 @@ CONFIG_KEYS_V4 = CONFIG_KEYS_V3 + ("scan",)
 # the ad-hoc ann_training_overlap flag is gone; the artifact embeds a
 # debug bundle with the six flight-recorder sections
 CONFIG_KEYS_V5 = CONFIG_KEYS_V4 + ("bg_tasks", "compiles")
+# schema/6 (cluster mode): a cluster_* config line must carry the `cluster`
+# object proving the run was actually distributed (node count, per-node row
+# spread) and CORRECT (merged-result parity vs a single node; parity false
+# means the scatter/gather merge diverged — an invalid artifact)
+CLUSTER_KEYS = ("nodes", "per_node_rows", "parity")
 BUNDLE_SECTIONS = ("traces", "slow_queries", "errors", "tasks", "compiles", "engine")
 COMPILES_KEYS = ("on_demand", "prewarm", "events")
 BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
@@ -79,7 +85,8 @@ def validate(path: str) -> List[str]:
     if art.get("schema") not in KNOWN_SCHEMAS:
         problems.append(f"schema is {art.get('schema')!r}, expected one of {KNOWN_SCHEMAS}")
     schema = art.get("schema")
-    v5 = schema == SCHEMA
+    v6 = schema == SCHEMA
+    v5 = v6 or schema == "surrealdb-tpu-bench/5"
     v4 = v5 or schema == "surrealdb-tpu-bench/4"
     v3 = v4 or schema == "surrealdb-tpu-bench/3"
     if v5:
@@ -157,6 +164,32 @@ def validate(path: str) -> List[str]:
                         problems.append(
                             f"{where} ({metric}): latency_ms missing {key!r}"
                         )
+        if v6 and metric.startswith("cluster_"):
+            cl = r.get("cluster")
+            if not isinstance(cl, dict):
+                problems.append(f"{where} ({metric}): missing 'cluster' object")
+            else:
+                for key in CLUSTER_KEYS:
+                    if key not in cl:
+                        problems.append(f"{where} ({metric}): cluster missing {key!r}")
+                if isinstance(cl.get("nodes"), int) and cl["nodes"] < 2:
+                    problems.append(
+                        f"{where} ({metric}): cluster.nodes must be >= 2 "
+                        "(a 1-node 'cluster' proves nothing)"
+                    )
+                pnr = cl.get("per_node_rows")
+                if isinstance(pnr, dict) and sum(
+                    1 for v in pnr.values() if isinstance(v, int) and v > 0
+                ) < 2:
+                    problems.append(
+                        f"{where} ({metric}): per_node_rows shows data on "
+                        "fewer than 2 nodes — the dataset was not sharded"
+                    )
+                if cl.get("parity") is not True:
+                    problems.append(
+                        f"{where} ({metric}): cluster.parity must be true "
+                        "(merged results diverged from the single-node run)"
+                    )
         if v4 and metric.startswith("filtered_scan"):
             for key in FILTERED_SCAN_KEYS:
                 if key not in r:

@@ -24,6 +24,9 @@ to enforce from memory:
   GL006  telemetry metric hygiene — dynamic metric names (unbounded
          series), inconsistent label-key sets across call sites (broken
          Prometheus aggregation), high-cardinality label keys
+  GL007  manual span names (tracing.pop / record_span_into) drifting
+         from the telemetry.observe() family recorded in the same
+         function — a drifted name breaks the trace<->metric join
 
 Workflow:
 

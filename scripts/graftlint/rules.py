@@ -435,3 +435,58 @@ def gl006(modules: List[Module]) -> List[Finding]:
                 )
             )
     return out
+
+
+# ------------------------------------------------------------------ GL007
+# Manual span-record calls that hand-name their span: tracing.pop's 2nd
+# positional arg, tracing.record_span_into's 2nd positional arg.
+GL007_SPAN_RECORDERS = {"pop": 1, "record_span_into": 1}
+
+
+@_rule("GL007", "manual span name drifting from its observe() metric family")
+def gl007(modules: List[Module]) -> List[Finding]:
+    """Sites that record a span by hand (tracing.pop / record_span_into)
+    AND feed a duration histogram (telemetry.observe) in the same function
+    must use ONE name for both — the span tree and the metric family are
+    two views of the same instrument, and a drifted name breaks the
+    trace<->metric join (`knn_search` spans with an `ivf_probe` histogram
+    would never correlate). telemetry.span() is exempt: it feeds both from
+    one name by construction."""
+    out: List[Finding] = []
+    for m in modules:
+        for fn in ast.walk(m.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            observes: Set[str] = set()
+            spans: List[Tuple[str, ast.Call]] = []
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                recv, attr = _call_name(node)
+                if recv == "telemetry" and attr == "observe" and node.args:
+                    a0 = node.args[0]
+                    if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                        observes.add(a0.value)
+                elif recv == "tracing" and attr in GL007_SPAN_RECORDERS:
+                    idx = GL007_SPAN_RECORDERS[attr]
+                    if len(node.args) > idx:
+                        a = node.args[idx]
+                        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                            spans.append((a.value, node))
+            if not observes or not spans:
+                continue
+            for name, node in spans:
+                if name in observes:
+                    continue
+                out.append(
+                    Finding(
+                        "GL007", m.rel, node.lineno, node.col_offset,
+                        f"manual span {name!r} recorded in a function whose "
+                        f"observe() families are {sorted(observes)} — span "
+                        "name and metric family must match for the "
+                        "trace<->metric join; rename one (or move the span "
+                        "to telemetry.span())",
+                        f"GL007:{m.rel}:{m.enclosing_def(node)}:{name}",
+                    )
+                )
+    return out

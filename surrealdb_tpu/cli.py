@@ -35,6 +35,10 @@ def main(argv=None) -> int:
     p_start.add_argument("--web-key", dest="web_key", help="TLS private key (PEM)")
     p_start.add_argument("--profile", action="store_true",
                          help="record timed spans around statements and kernel dispatches")
+    p_start.add_argument("--cluster", dest="cluster",
+                         help="cluster topology JSON (multi-node sharded serving)")
+    p_start.add_argument("--cluster-node", dest="cluster_node",
+                         help="this node's id in the topology (overrides the file's \"self\")")
     # capability flags (reference: surreal start --allow-*/--deny-*)
     p_start.add_argument("--allow-all", "-A", dest="allow_all", action="store_const", const="all", default=None)
     p_start.add_argument("--deny-all", dest="deny_all", action="store_const", const="all", default=None)
@@ -140,6 +144,12 @@ def _start(args) -> int:
 
         telemetry.enable(True)
 
+    cluster_config = None
+    if getattr(args, "cluster", None):
+        from surrealdb_tpu.cluster import load_config
+
+        cluster_config = load_config(args.cluster, getattr(args, "cluster_node", None))
+
     host, _, port = args.bind.partition(":")
     srv = serve(
         args.path, host or "127.0.0.1", int(port or 8000),
@@ -147,7 +157,14 @@ def _start(args) -> int:
         capabilities=from_env_and_args(args),
         tls_cert=getattr(args, "web_crt", None),
         tls_key=getattr(args, "web_key", None),
+        cluster_config=cluster_config,
     )
+    if cluster_config is not None:
+        print(
+            f"cluster node {cluster_config.node_id!r}: "
+            f"{len(cluster_config.nodes)} member(s), {cluster_config.vnodes} vnodes",
+            file=sys.stderr,
+        )
     if args.user and args.password:
         from surrealdb_tpu.sql.value import format_value
 

@@ -1,0 +1,197 @@
+"""Inter-node RPC client: CBOR over the internal `/cluster` HTTP channel.
+
+Reuses the existing CBOR wire format (rpc/cbor.py — Things, Datetimes,
+Durations, vectors all round-trip), carries the coordinator's W3C
+`traceparent` outbound so the remote joins the SAME trace, and ships the
+remote's recorded spans back in every response for grafting
+(tracing.graft_spans). Per-node liveness is maintained by probe pumps
+registered through bg.spawn_service — deterministic `bg:cluster_probe:<id>`
+threads the flight recorder can see.
+
+Failure semantics: a dead or timed-out node raises NodeUnavailableError
+naming the node and url — the executor turns that into a clear per-shard
+statement error instead of a hang (the RPC deadline is
+cnf.CLUSTER_RPC_TIMEOUT_SECS).
+"""
+
+from __future__ import annotations
+
+import http.client
+import time as _time
+from typing import Any, Dict, List, Optional
+from urllib.parse import urlparse
+
+from surrealdb_tpu import cnf
+from surrealdb_tpu.err import SurrealError
+from surrealdb_tpu.rpc import cbor as _cbor
+from surrealdb_tpu.utils import locks as _locks
+
+from .config import ClusterConfig
+
+
+class ClusterError(SurrealError):
+    pass
+
+
+class NodeUnavailableError(ClusterError):
+    def __init__(self, node_id: str, url: str, cause: str):
+        super().__init__(
+            f"cluster node {node_id!r} ({url}) unavailable: {cause}"
+        )
+        self.node_id = node_id
+
+
+class RemoteOpError(ClusterError):
+    """The remote executed the op and reported a failure."""
+
+    def __init__(self, node_id: str, message: str):
+        super().__init__(f"cluster node {node_id!r}: {message}")
+        self.node_id = node_id
+
+
+class ClusterClient:
+    """RPC fan-out to every member of the cluster (one short-lived
+    connection per call — scatter calls run concurrently from the
+    executor's pool threads, so per-call sockets also sidestep
+    http.client's single-in-flight-request limitation)."""
+
+    def __init__(self, config: ClusterConfig, owner: Optional[int] = None):
+        self.config = config
+        self._owner = owner
+        self._lock = _locks.Lock("cluster.client")
+        # node_id -> liveness view maintained by the probe pumps + call
+        # outcomes (guarded by cluster.client)
+        self._health: Dict[str, Dict[str, Any]] = {
+            n["id"]: {"up": None, "last_seen": 0.0, "error": None}
+            for n in config.nodes
+        }
+        self._alive = True
+        self._probes_started = False
+
+    # ------------------------------------------------------------ transport
+    def _request(
+        self, node_id: str, path: str, body: bytes, timeout: float,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> bytes:
+        url = self.config.url_of(node_id)
+        u = urlparse(url)
+        conn_cls = (
+            http.client.HTTPSConnection if u.scheme == "https" else http.client.HTTPConnection
+        )
+        conn = conn_cls(u.hostname, u.port, timeout=timeout)
+        try:
+            # Connection: close — one-shot internal requests; leaving the
+            # keep-alive socket to be reset on close() makes the remote's
+            # ThreadingHTTPServer log spurious ConnectionResetErrors
+            hdrs = {"Content-Type": "application/cbor", "Connection": "close"}
+            if self.config.secret:
+                hdrs["x-surreal-cluster-key"] = self.config.secret
+            if headers:
+                hdrs.update(headers)
+            conn.request("POST", path, body=body, headers=hdrs)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RemoteOpError(
+                    node_id, f"HTTP {resp.status}: {data[:200]!r}"
+                )
+            return data
+        except (OSError, http.client.HTTPException) as e:
+            raise NodeUnavailableError(node_id, url, f"{type(e).__name__}: {e}") from e
+        finally:
+            conn.close()
+
+    def call(self, node_id: str, op: str, req: Dict[str, Any]) -> Dict[str, Any]:
+        """One cluster op against one node. Attaches the active trace as an
+        outbound `traceparent` and grafts the remote's spans back into it."""
+        from surrealdb_tpu import telemetry, tracing
+
+        req = dict(req, op=op)
+        headers: Dict[str, str] = {}
+        ctx = tracing.current()
+        if ctx is not None:
+            headers["traceparent"] = tracing.format_traceparent(
+                ctx.trace.trace_id, ctx.span_id
+            )
+        t0 = _time.perf_counter()
+        try:
+            with telemetry.span("cluster_rpc", node=node_id, op=op):
+                raw = self._request(
+                    node_id, "/cluster", _cbor.encode(req),
+                    cnf.CLUSTER_RPC_TIMEOUT_SECS, headers,
+                )
+                resp = _cbor.decode(raw)
+        except ClusterError:
+            telemetry.inc("cluster_rpc_errors", node=node_id, op=op)
+            self._mark(node_id, up=False)
+            raise
+        self._mark(node_id, up=True)
+        if not isinstance(resp, dict):
+            raise RemoteOpError(node_id, "malformed cluster response")
+        spans = resp.get("spans")
+        if spans:
+            tracing.graft_spans(spans, t0, node_id)
+        if resp.get("error"):
+            raise RemoteOpError(node_id, str(resp["error"]))
+        return resp
+
+    # ------------------------------------------------------------ health
+    def _mark(self, node_id: str, up: bool, error: Optional[str] = None) -> None:
+        from surrealdb_tpu import telemetry
+
+        with self._lock:
+            h = self._health.get(node_id)
+            if h is None:
+                return
+            h["up"] = up
+            h["error"] = error
+            if up:
+                h["last_seen"] = _time.time()
+        telemetry.gauge_set("cluster_node_up", 1.0 if up else 0.0, node=node_id)
+
+    def health(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._health.items()}
+
+    def start_probes(self) -> None:
+        """One liveness pump per REMOTE node (bg.spawn_service — service
+        tasks: exempt from shutdown joins, visible in the task registry)."""
+        from surrealdb_tpu import bg
+
+        with self._lock:
+            if self._probes_started:
+                return
+            self._probes_started = True
+        for node_id in self.config.peer_ids():
+            bg.spawn_service(
+                "cluster_probe", node_id, self._probe_loop, node_id,
+                owner=self._owner,
+            )
+
+    def _probe_loop(self, node_id: str) -> None:
+        url = self.config.url_of(node_id)
+        u = urlparse(url)
+        while self._alive:
+            try:
+                conn_cls = (
+                    http.client.HTTPSConnection
+                    if u.scheme == "https"
+                    else http.client.HTTPConnection
+                )
+                conn = conn_cls(u.hostname, u.port, timeout=2.0)
+                try:
+                    conn.request("GET", "/health", headers={"Connection": "close"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    ok = resp.status == 200
+                finally:
+                    conn.close()
+                self._mark(node_id, up=ok)
+            except (OSError, http.client.HTTPException) as e:
+                # BadStatusLine etc. is HTTPException, NOT OSError — a peer
+                # restarting mid-probe must not kill the pump for good
+                self._mark(node_id, up=False, error=str(e))
+            _time.sleep(max(cnf.CLUSTER_PROBE_INTERVAL_SECS, 0.05))
+
+    def shutdown(self) -> None:
+        self._alive = False

@@ -1,0 +1,107 @@
+"""Static cluster membership config.
+
+The reference derives membership from the distributed KV store's node table
+(kvs/node.rs heartbeats); this reproduction keeps a STATIC topology file so
+placement is deterministic and testable without a consensus layer:
+
+    {
+      "nodes": [
+        {"id": "n1", "url": "http://127.0.0.1:8101"},
+        {"id": "n2", "url": "http://127.0.0.1:8102"}
+      ],
+      "self": "n1",
+      "vnodes": 64,
+      "secret": "shared-internal-secret"
+    }
+
+`secret` authenticates the internal `/cluster` channel (every request
+carries it as `x-surreal-cluster-key`); operator/user auth still applies at
+the public ingress of whichever node coordinates.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+class ClusterConfigError(ValueError):
+    pass
+
+
+class ClusterConfig:
+    __slots__ = ("nodes", "node_id", "vnodes", "secret")
+
+    def __init__(
+        self,
+        nodes: List[Dict[str, str]],
+        node_id: str,
+        vnodes: int = 64,
+        secret: Optional[str] = None,
+    ):
+        if not nodes:
+            raise ClusterConfigError("cluster config needs at least one node")
+        ids = [str(n.get("id", "")) for n in nodes]
+        if len(set(ids)) != len(ids) or not all(ids):
+            raise ClusterConfigError("cluster node ids must be unique and non-empty")
+        for n in nodes:
+            if not str(n.get("url", "")).startswith(("http://", "https://")):
+                raise ClusterConfigError(
+                    f"node {n.get('id')!r}: url must be http(s)://host:port"
+                )
+        if node_id not in ids:
+            raise ClusterConfigError(
+                f"self node {node_id!r} is not in the membership list {ids}"
+            )
+        if len(nodes) > 1 and not secret:
+            # the /cluster channel executes ops with SYSTEM privileges and
+            # the shared secret is its only gate — an unauthenticated
+            # multi-node channel would hand owner-level SurrealQL to
+            # anyone with network reach
+            raise ClusterConfigError(
+                "cluster config requires a non-empty shared 'secret' "
+                "(the internal /cluster channel runs with system privileges)"
+            )
+        self.nodes = [dict(id=str(n["id"]), url=str(n["url"]).rstrip("/")) for n in nodes]
+        self.node_id = node_id
+        self.vnodes = max(int(vnodes), 1)
+        self.secret = secret
+
+    def url_of(self, node_id: str) -> str:
+        for n in self.nodes:
+            if n["id"] == node_id:
+                return n["url"]
+        raise ClusterConfigError(f"unknown cluster node {node_id!r}")
+
+    def peer_ids(self) -> List[str]:
+        return [n["id"] for n in self.nodes if n["id"] != self.node_id]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "nodes": list(self.nodes),
+            "self": self.node_id,
+            "vnodes": self.vnodes,
+            "secret": self.secret,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any], node_id: Optional[str] = None) -> "ClusterConfig":
+        if not isinstance(d, dict):
+            raise ClusterConfigError("cluster config must be a JSON object")
+        return ClusterConfig(
+            d.get("nodes") or [],
+            node_id or d.get("self") or "",
+            vnodes=d.get("vnodes", 64),
+            secret=d.get("secret"),
+        )
+
+
+def load_config(path: str, node_id: Optional[str] = None) -> ClusterConfig:
+    """Load a topology file; `node_id` overrides the file's "self" (so one
+    file can be shipped to every node of the cluster)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ClusterConfigError(f"unreadable cluster config {path!r}: {e}") from e
+    return ClusterConfig.from_dict(doc, node_id)

@@ -1,0 +1,970 @@
+"""The distributed scatter/gather executor — cluster mode's query brain.
+
+Every statement arriving at a cluster node routes through here:
+
+- **SELECT over tables/ranges** scatters a `SELECT * ... WHERE <cond>` to
+  every member (each node's WHERE runs vectorized over ITS column mirror),
+  gathers the raw row batches, re-sorts them into single-node scan order,
+  and re-runs the ORIGINAL projection/GROUP/ORDER/LIMIT pipeline locally
+  over the gathered rows — results stay byte-identical to one node.
+- **kNN** scatters the statement with a `vector::distance::knn()` carrier
+  field; per-shard top-k merge by distance yields the global top-k.
+- **BM25 (MATCHES)** runs two-phase: per-node corpus stats (df/dc/avgdl)
+  merge into GLOBAL stats that are injected into phase two, so every shard
+  scores exactly as one corpus; score-merged rows feed the local pipeline.
+- **Graph idioms** (`SELECT ->e->t FROM ...`) exchange frontier sets per
+  hop: each hop broadcasts the frontier, every node expands the records it
+  holds, and the per-id maps union into the next frontier.
+- **Writes** route by record ownership (consistent hash): CREATE/UPSERT/
+  INSERT to the owner (ids pre-generated so placement is deterministic),
+  RELATE to the `from` record's owner (edges colocate with their source),
+  UPDATE/DELETE broadcast (non-owners match nothing). DDL broadcasts so
+  schema exists on every member.
+
+Unsupported in cluster mode (clear errors, never wrong answers): explicit
+transactions, LIVE/KILL, FETCH, and UPSERT on a bare table target.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from surrealdb_tpu.err import SurrealError
+from surrealdb_tpu.sql.ast import (
+    FunctionCall,
+    KnnOp,
+    Literal,
+    MatchesOp,
+    ModelCall,
+    Param,
+    Subquery,
+    walk_exprs,
+)
+from surrealdb_tpu.sql.path import Idiom, PField, PGraph
+from surrealdb_tpu.sql.statements import (
+    AccessStatement,
+    AlterStatement,
+    BeginStatement,
+    CancelStatement,
+    CommitStatement,
+    CreateStatement,
+    DefineStatement,
+    DeleteStatement,
+    InfoStatement,
+    InsertStatement,
+    KillStatement,
+    LetStatement,
+    LiveStatement,
+    OptionStatement,
+    Query,
+    RebuildStatement,
+    RelateStatement,
+    RemoveStatement,
+    SelectStatement,
+    ShowStatement,
+    UpdateStatement,
+    UpsertStatement,
+    UseStatement,
+)
+from surrealdb_tpu.sql.value import (
+    NONE,
+    Range,
+    Table,
+    Thing,
+    generate_record_id,
+    is_none,
+)
+
+from . import merge as _merge
+from .client import ClusterError
+
+_DIST = "__cluster_dist"
+_SCORE = "__cluster_score"
+_ROWS = "__cluster_rows"
+
+
+def _fmt_time(seconds: float) -> str:
+    if seconds < 1e-3:
+        return f"{seconds * 1e6:.1f}µs"
+    if seconds < 1.0:
+        return f"{seconds * 1e3:.2f}ms"
+    return f"{seconds:.3f}s"
+
+
+def _ok(result) -> dict:
+    return {"status": "OK", "result": result}
+
+
+def _err(msg: str) -> dict:
+    return {"status": "ERR", "result": msg}
+
+
+class ClusterExecutor:
+    def __init__(self, ds, node):
+        self.ds = ds
+        self.node = node
+        # persistent scatter pool: a fresh ThreadPoolExecutor per fan-out
+        # would spawn+join N OS threads per statement — real churn at
+        # coordinator qps. Sized for a few concurrent statements' worth of
+        # scatters; deterministic thread names for stack dumps.
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(4 * len(node.config.nodes), 8),
+            thread_name_prefix="cluster-scatter",
+        )
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+    # ------------------------------------------------------------ entry
+    def execute(self, text: str, session, vars: Optional[Dict[str, Any]] = None) -> List[dict]:
+        from surrealdb_tpu import tracing
+        from surrealdb_tpu.syn import parse_query
+
+        with tracing.request("cluster_execute", sql=text[:120]):
+            ast = parse_query(text)
+            out: List[dict] = []
+            vars = dict(vars or {})
+            sources = ast.sources or [repr(s) for s in ast.statements]
+            for stm, src in zip(ast.statements, sources):
+                t0 = _time.perf_counter()
+                try:
+                    resp = self._route(stm, src, session, vars)
+                except ClusterError as e:
+                    resp = _err(str(e))
+                except SurrealError as e:
+                    resp = _err(str(e))
+                except Exception as e:  # noqa: BLE001 — mirror Executor's guard
+                    resp = _err(f"Internal error: {type(e).__name__}: {e}")
+                resp["time"] = _fmt_time(_time.perf_counter() - t0)
+                out.append(resp)
+            return out
+
+    # ------------------------------------------------------------ routing
+    def _route(self, stm, src: str, session, vars) -> dict:
+        if isinstance(stm, (BeginStatement, CommitStatement, CancelStatement)):
+            return _err("explicit transactions are not supported in cluster mode")
+        if isinstance(stm, (LiveStatement, KillStatement)):
+            return _err("live queries are not supported in cluster mode")
+        if isinstance(
+            stm, (UseStatement, OptionStatement, InfoStatement, ShowStatement, AccessStatement)
+        ):
+            return self._local_stm(src, session, vars)
+        if isinstance(stm, LetStatement):
+            # bind on the coordinator; later scattered statements see the
+            # value as an ordinary $param. A subquery here would read only
+            # the coordinator's shard — refuse rather than answer wrong.
+            if _has_subquery(stm.what):
+                return _err(
+                    "subqueries in LET read a single shard — not supported "
+                    "in cluster mode (run the SELECT as its own statement)"
+                )
+            vars[stm.name] = self.ds.compute(stm.what, session, vars)
+            return _ok(NONE)
+        if isinstance(stm, (DefineStatement, RemoveStatement, AlterStatement, RebuildStatement)):
+            return self._ddl_broadcast(src, session, vars)
+        if isinstance(stm, SelectStatement):
+            return self._select(stm, src, session, vars)
+        if isinstance(
+            stm,
+            (UpdateStatement, DeleteStatement, CreateStatement, InsertStatement, RelateStatement),
+        ) and _has_subquery(stm):
+            # a subquery in a write's WHERE or data would evaluate over the
+            # executing shard's partial data — refuse, never answer wrong
+            return _err(
+                "subqueries in write statements evaluate per shard — not "
+                "supported in cluster mode (materialize the SELECT into a "
+                "$param first)"
+            )
+        if isinstance(stm, UpsertStatement):
+            return self._create_route(stm, session, vars, verb="UPSERT")
+        if isinstance(stm, (UpdateStatement, DeleteStatement)):
+            return self._write_broadcast(stm, src, session, vars)
+        if isinstance(stm, CreateStatement):
+            return self._create_route(stm, session, vars, verb="CREATE")
+        if isinstance(stm, InsertStatement):
+            return self._insert_route(stm, session, vars)
+        if isinstance(stm, RelateStatement):
+            return self._relate_route(stm, session, vars)
+        # control flow / expressions (RETURN, IF, FOR, THROW, SLEEP, ...)
+        # evaluate on the coordinator. An embedded subquery would read only
+        # the coordinator's shard — a silent partial answer; refuse instead
+        # ("unsupported shapes error clearly, never answer wrong").
+        if _has_subquery(stm):
+            return _err(
+                "subqueries inside control-flow statements read a single "
+                "shard — not supported in cluster mode (run the SELECT as "
+                "its own statement)"
+            )
+        return self._local_stm(src, session, vars)
+
+    # ------------------------------------------------------------ plumbing
+    def _all_nodes(self) -> List[str]:
+        return [n["id"] for n in self.node.config.nodes]
+
+    def _call(self, node_id: str, op: str, req: Dict[str, Any]) -> Dict[str, Any]:
+        """One cluster op; the self node short-circuits in-process (its
+        spans nest naturally — no export/graft round trip)."""
+        from surrealdb_tpu import telemetry
+
+        from . import rpc as _rpc
+
+        if node_id == self.node.node_id:
+            with telemetry.span("cluster_rpc", node=node_id, op=op):
+                return _rpc._OPS[op](self.ds, req)
+        return self.node.client.call(node_id, op, req)
+
+    def _fan_out(self, node_ids: List[str], op: str, req: Dict[str, Any]) -> Dict[str, dict]:
+        """Scatter one op to several nodes concurrently; raises the first
+        node failure (a down shard owner must surface as a per-shard error,
+        not a partial answer). Contextvars are copied into the pool threads
+        so every remote call records into the coordinating request's trace."""
+        if len(node_ids) == 1:
+            return {node_ids[0]: self._call(node_ids[0], op, req)}
+
+        out: Dict[str, dict] = {}
+        # one context COPY per target, captured on the submitting thread:
+        # the workers then share the request's Trace object (span appends
+        # are GIL-atomic) without sharing a Context
+        futs = {
+            nid: self._pool.submit(
+                contextvars.copy_context().run, self._call, nid, op, req
+            )
+            for nid in node_ids
+        }
+        errs: List[BaseException] = []
+        for nid, fut in futs.items():
+            try:
+                out[nid] = fut.result()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errs.append(e)
+        if errs:
+            raise errs[0]
+        return out
+
+    def _scatter_sql(
+        self, node_ids: List[str], sql: str, session, vars,
+    ) -> Dict[str, List[dict]]:
+        """Run one statement on several nodes; returns node -> responses.
+        Any remote statement-level ERR raises (partial scatters must not
+        silently drop a shard's rows)."""
+        req = {
+            "sql": sql,
+            "ns": session.ns,
+            "db": session.db,
+            "vars": vars or None,
+        }
+        gathered = self._fan_out(node_ids, "query", req)
+        out: Dict[str, List[dict]] = {}
+        for nid, resp in gathered.items():
+            results = resp.get("results") or []
+            for r in results:
+                if r.get("status") != "OK":
+                    raise SurrealError(
+                        f"cluster node {nid!r}: {r.get('result')}"
+                    )
+            out[nid] = results
+        return out
+
+    def _gather_rows(self, per_node: Dict[str, List[dict]]) -> List[Any]:
+        rows: List[Any] = []
+        for nid in sorted(per_node):
+            for resp in per_node[nid]:
+                r = resp.get("result")
+                if isinstance(r, list):
+                    rows.extend(r)
+                elif r is not None and not is_none(r):
+                    rows.append(r)
+        return rows
+
+    def _local_stm(self, src: str, session, vars) -> dict:
+        out = self.ds.execute_local(src, session, vars)
+        if not out:
+            return _ok(NONE)
+        return {"status": out[0]["status"], "result": out[0]["result"]}
+
+    def _eval_exprs(self, exprs, session, vars) -> List[Any]:
+        """Evaluate statement-target expressions on the coordinator (they
+        are constants/params — tables, record ids, row objects)."""
+        from surrealdb_tpu.dbs.context import Context
+        from surrealdb_tpu.dbs.executor import Executor
+        from surrealdb_tpu.dbs.iterator import target_value
+
+        ex = Executor(self.ds, session, vars)
+        ctx = Context(ex, session)
+        for name, value in (vars or {}).items():
+            ctx.set_param(name, value)
+        ex._open(False)
+        try:
+            return [target_value(ctx, e) for e in exprs]
+        finally:
+            ex._cancel()
+
+    @staticmethod
+    def _flatten_targets(vals) -> List[Any]:
+        out: List[Any] = []
+        for v in vals:
+            if isinstance(v, (list, tuple)):
+                out.extend(ClusterExecutor._flatten_targets(v))
+            else:
+                out.append(v)
+        return out
+
+    def _owner(self, tb: str, rid) -> str:
+        return self.node.ring.owner_of(tb, rid)
+
+    # ------------------------------------------------------------ DDL
+    def _ddl_broadcast(self, src: str, session, vars) -> dict:
+        from surrealdb_tpu import telemetry
+
+        with telemetry.span("cluster_scatter", kind="ddl"):
+            per_node = self._scatter_sql(self._all_nodes(), src, session, vars)
+        mine = per_node.get(self.node.node_id) or []
+        return (
+            {"status": mine[0]["status"], "result": mine[0]["result"]}
+            if mine
+            else _ok(NONE)
+        )
+
+    # ------------------------------------------------------------ writes
+    def _write_broadcast(self, stm, src: str, session, vars) -> dict:
+        """UPDATE/DELETE: every member applies the statement to its shard
+        (non-owners match nothing); merged rows return in scan order.
+
+        Deliberately broadcast even for id-addressed targets: edge records
+        colocate with their FROM record's owner (not their hash owner), so
+        hash-routing `UPDATE knows:x` would miss the record entirely —
+        correctness over the N-1 no-op RPCs."""
+        from surrealdb_tpu import telemetry
+
+        with telemetry.span("cluster_scatter", kind="write"):
+            per_node = self._scatter_sql(self._all_nodes(), src, session, vars)
+        rows = self._gather_rows(per_node)
+        if rows and all(isinstance(r, dict) and "id" in r for r in rows):
+            # FROM-source rank first (a multi-table UPDATE returns table by
+            # table on a single node), key order within each source
+            rows = _merge.sort_rows_scan_order(
+                rows, self._from_tables(stm, session, vars)
+            )
+        if getattr(stm, "only", False):
+            return _ok(rows[0] if rows else NONE)
+        return _ok(rows)
+
+    def _create_route(self, stm, session, vars, verb: str) -> dict:
+        """CREATE / UPSERT: each target record routes to its hash owner;
+        bare-table CREATE pre-generates the id so placement is
+        deterministic."""
+        from surrealdb_tpu import telemetry
+
+        targets = self._flatten_targets(self._eval_exprs(stm.what, session, vars))
+        things: List[Thing] = []
+        for t in targets:
+            if isinstance(t, Table):
+                if verb == "UPSERT":
+                    return _err(
+                        "UPSERT on a bare table target is not supported in "
+                        "cluster mode — name the record id"
+                    )
+                things.append(Thing(str(t), generate_record_id()))
+            elif isinstance(t, Thing) and not isinstance(t.id, Range):
+                things.append(t)
+            elif isinstance(t, str):
+                things.append(Thing.parse(t))
+            else:
+                return _err(f"{verb}: unsupported cluster target {t!r}")
+        rows: List[Any] = []
+        saved_what = stm.what
+        try:
+            with telemetry.span("cluster_scatter", kind="write"):
+                for t in things:
+                    stm.what = [Literal(t)]
+                    per_node = self._scatter_sql(
+                        [self._owner(t.tb, t.id)], repr(stm), session, vars
+                    )
+                    rows.extend(self._gather_rows(per_node))
+        finally:
+            stm.what = saved_what
+        if getattr(stm, "only", False):
+            return _ok(rows[0] if rows else NONE)
+        return _ok(rows)
+
+    def _insert_route(self, stm, session, vars) -> dict:
+        from surrealdb_tpu import telemetry
+
+        if stm.into is None:
+            return _err("cluster INSERT requires an INTO table")
+        if stm.update is not None:
+            return _err(
+                "INSERT ... ON DUPLICATE KEY UPDATE is not supported in "
+                "cluster mode yet"
+            )
+        into = self._flatten_targets(self._eval_exprs([stm.into], session, vars))
+        if len(into) != 1 or not isinstance(into[0], Table):
+            return _err("cluster INSERT requires a plain table target")
+        tb = str(into[0])
+        rows = self._insert_rows(stm, session, vars)
+        # pre-assign missing ids so placement is deterministic, then route
+        # each row to its owner
+        by_owner: Dict[str, List[Tuple[int, dict]]] = {}
+        for i, row in enumerate(rows):
+            if not isinstance(row, dict):
+                return _err("cluster INSERT rows must be objects")
+            row = dict(row)
+            if stm.relation:
+                src = row.get("in")
+                if not isinstance(src, Thing):
+                    return _err("cluster INSERT RELATION rows need an `in` record id")
+                owner = self._owner(src.tb, src.id)
+            else:
+                rid = row.get("id")
+                if rid is None or is_none(rid):
+                    row["id"] = generate_record_id()
+                    rid = row["id"]
+                if isinstance(rid, Thing):
+                    rid = rid.id
+                owner = self._owner(tb, rid)
+            by_owner.setdefault(owner, []).append((i, row))
+        from surrealdb_tpu.sql.value import escape_ident
+
+        # InsertStatement repr does not round-trip (Data repr prints a
+        # CONTENT keyword INSERT's grammar rejects) — build the routed
+        # statement text directly
+        sql = (
+            "INSERT "
+            + ("RELATION " if stm.relation else "")
+            + ("IGNORE " if stm.ignore else "")
+            + f"INTO {escape_ident(tb)} ${_ROWS}"
+        )
+        indexed: List[Tuple[int, Any]] = []
+        with telemetry.span("cluster_scatter", kind="write"):
+            for owner, batch in by_owner.items():
+                per_node = self._scatter_sql(
+                    [owner], sql, session,
+                    dict(vars or {}, **{_ROWS: [r for _, r in batch]}),
+                )
+                got = self._gather_rows(per_node)
+                indexed.extend(_align_insert_rows(tb, batch, got))
+        indexed.sort(key=lambda p: p[0])
+        return _ok([r for _, r in indexed])
+
+    def _insert_rows(self, stm, session, vars) -> List[dict]:
+        """Materialize the INSERT payload into a list of row objects."""
+        data = stm.data
+        if data is None:
+            return []
+        if data.kind == "content":
+            v = self._eval_exprs([data.items], session, vars)[0]
+            if isinstance(v, Table):  # a bare identifier is not rows
+                raise SurrealError("cluster INSERT payload must be object(s)")
+            rows = v if isinstance(v, list) else [v]
+            return [dict(r) if isinstance(r, dict) else r for r in rows]
+        if data.kind == "values":
+            fields, tuples = data.items
+            names = [repr(f) for f in fields]
+            out = []
+            for tup in tuples:
+                vals = self._eval_exprs(list(tup), session, vars)
+                row: Dict[str, Any] = {}
+                for name, v in zip(names, vals):
+                    if isinstance(v, Table):
+                        v = str(v)
+                    row[name] = v
+                out.append(row)
+            return out
+        raise SurrealError(f"cluster INSERT cannot route {data.kind!r} payloads")
+
+    def _relate_route(self, stm, session, vars) -> dict:
+        """RELATE routes to the FROM record's owner — an edge record and
+        its pointer keys colocate with the source record, which is what
+        makes outbound graph expansion local-per-shard."""
+        from surrealdb_tpu import telemetry
+
+        froms = self._flatten_targets(self._eval_exprs([stm.from_], session, vars))
+        for f in froms:
+            if not isinstance(f, Thing):
+                return _err("cluster RELATE requires record-id FROM targets")
+        by_owner: Dict[str, List[Thing]] = {}
+        for f in froms:
+            by_owner.setdefault(self._owner(f.tb, f.id), []).append(f)
+        saved = stm.from_
+        rows: List[Any] = []
+        try:
+            with telemetry.span("cluster_scatter", kind="write"):
+                for owner, batch in by_owner.items():
+                    stm.from_ = Param("__cluster_from")
+                    per_node = self._scatter_sql(
+                        [owner], repr(stm), session,
+                        dict(vars or {}, __cluster_from=batch),
+                    )
+                    rows.extend(self._gather_rows(per_node))
+        finally:
+            stm.from_ = saved
+        if getattr(stm, "only", False):
+            return _ok(rows[0] if rows else NONE)
+        return _ok(rows)
+
+    # ------------------------------------------------------------ SELECT
+    def _select(self, stm, src: str, session, vars) -> dict:
+        from surrealdb_tpu import telemetry
+
+        if getattr(stm, "explain", False):
+            return self._local_stm(src, session, vars)
+        if getattr(stm, "fetch", None):
+            return _err("FETCH is not supported in cluster mode yet")
+
+        if _has_subquery(getattr(stm, "cond", None)):
+            # the scattered WHERE would resolve the inner SELECT over each
+            # shard's PARTIAL data — wrong (often empty) membership sets
+            return _err(
+                "subqueries in WHERE evaluate per shard — not supported in "
+                "cluster mode (materialize the inner SELECT into a $param "
+                "first)"
+            )
+        if _has_inbound_graph(getattr(stm, "cond", None)):
+            # a row's OUTBOUND pointers are local to its owner (RELATE
+            # routing), so outbound graph conds evaluate correctly per
+            # shard — but INBOUND pointers live on the edge source's owner
+            # and a per-shard check silently drops matches
+            return _err(
+                "inbound (<- / <->) graph traversal in WHERE reads pointer "
+                "keys on other shards — not supported in cluster mode"
+            )
+
+        knn = _find_operator(getattr(stm, "cond", None), KnnOp)
+        matches = _find_operator(getattr(stm, "cond", None), MatchesOp)
+
+        graph = self._graph_shape(stm)
+        if graph is not None:
+            with telemetry.span("cluster_scatter", kind="graph"):
+                return self._graph_select(stm, session, vars, graph)
+
+        shape = self._projection_shape(stm)
+        if shape == "unsupported":
+            # a subquery / ml:: call in the projection would evaluate over
+            # each shard's PARTIAL data (and imported models are per-node)
+            return _err(
+                "subquery/ml projections evaluate per shard — not supported "
+                "in cluster mode"
+            )
+        if shape == "colocated":
+            if getattr(stm, "group", None) or getattr(stm, "group_all", False):
+                # each shard would aggregate its slice and the coordinator
+                # cannot merge arbitrary graph-projection aggregates —
+                # concatenated partials are wrong
+                return _err(
+                    "GROUP over graph projections aggregates per shard — "
+                    "not supported in cluster mode"
+                )
+            with telemetry.span("cluster_scatter", kind="colocated"):
+                return self._colocated_select(stm, session, vars)
+
+        kind = "knn" if knn is not None else ("bm25" if matches is not None else "scan")
+        with telemetry.span("cluster_scatter", kind=kind):
+            if knn is not None:
+                return self._scatter_select(stm, session, vars, knn=knn)
+            if matches is not None:
+                return self._scatter_select(stm, session, vars, matches=matches)
+            return self._scatter_select(stm, session, vars)
+
+    # ---- shape analysis
+    def _graph_shape(self, stm) -> Optional[Idiom]:
+        """`SELECT [VALUE] <pure graph idiom> FROM ...` with no other
+        clauses — the per-hop frontier-exchange shape."""
+        fields = getattr(stm, "fields", None) or []
+        if len(fields) != 1 or getattr(fields[0], "all", False):
+            return None
+        expr = fields[0].expr
+        if not isinstance(expr, Idiom) or not expr.parts:
+            return None
+        if not all(
+            isinstance(p, PGraph) and getattr(p, "cond", None) is None
+            for p in expr.parts
+        ):
+            return None
+        for attr in ("cond", "group", "order", "limit", "start", "split", "omit"):
+            if getattr(stm, attr, None):
+                return None
+        if getattr(stm, "group_all", False):
+            return None
+        return expr
+
+    def _projection_shape(self, stm) -> str:
+        """How the projection may execute across shards:
+        - "replay": evaluates over gathered plain rows (the universal path);
+        - "colocated": graph hops / search:: functions — run the whole
+          statement on every member; correct because RELATE routing keeps
+          outbound neighborhoods local and FT mirrors are per-shard;
+        - "unsupported": subqueries / ml:: calls would read PARTIAL data
+          per shard (models are per-node) — must error, never answer wrong.
+        """
+        kind = ["replay"]
+
+        def visit(node):
+            if isinstance(node, (Subquery, ModelCall)):
+                kind[0] = "unsupported"
+            elif isinstance(node, PGraph):
+                if node.dir != "out":
+                    # inbound pointers live on the edge SOURCE's owner — a
+                    # colocated per-shard evaluation silently returns
+                    # partial neighbor sets (only the pure-idiom frontier-
+                    # exchange shape resolves them)
+                    kind[0] = "unsupported"
+                elif kind[0] == "replay":
+                    kind[0] = "colocated"
+            elif kind[0] == "replay" and isinstance(node, FunctionCall):
+                if node.name.startswith("search::") and node.name != "search::score":
+                    kind[0] = "colocated"
+
+        walk_exprs(getattr(stm, "fields", None), visit)
+        walk_exprs(getattr(stm, "group", None), visit)
+        walk_exprs(getattr(stm, "split", None), visit)
+        return kind[0]
+
+    def _from_tables(self, stm, session, vars) -> List[str]:
+        try:
+            targets = self._flatten_targets(self._eval_exprs(stm.what, session, vars))
+        except SurrealError:
+            return []
+        return [str(t) for t in targets if isinstance(t, Table)]
+
+    # ---- strategies
+    def _colocated_select(self, stm, session, vars) -> dict:
+        """Scatter the FULL statement (minus ORDER/LIMIT/START), gather the
+        already-projected rows, then apply ordering/limit locally."""
+        saved = (stm.order, stm.limit, stm.start)
+        try:
+            stm.order = stm.limit = stm.start = None
+            per_node = self._scatter_sql(self._all_nodes(), repr(stm), session, vars)
+        finally:
+            stm.order, stm.limit, stm.start = saved
+        rows = self._gather_rows(per_node)
+        if rows and all(isinstance(r, dict) and "id" in r for r in rows):
+            rows = _merge.sort_rows_scan_order(rows, self._from_tables(stm, session, vars))
+        if not (stm.order or stm.limit or stm.start):
+            if getattr(stm, "only", False):
+                return _ok(rows[0] if rows else NONE)
+            return _ok(rows)
+        post = SelectStatement(
+            [_star_field()], [Param(_ROWS)],
+            order=stm.order, limit=stm.limit, start=stm.start,
+            only=getattr(stm, "only", False),
+        )
+        out = self.ds.process(
+            Query([post]), session, dict(vars or {}, **{_ROWS: rows})
+        )
+        return {"status": out[0]["status"], "result": out[0]["result"]}
+
+    def _scatter_select(self, stm, session, vars, knn=None, matches=None) -> dict:
+        """The universal gather-then-replay strategy (see module doc)."""
+        cond = getattr(stm, "cond", None)
+        extra_proj = ""
+        scatter_vars = dict(vars or {})
+        if knn is not None:
+            extra_proj = f", vector::distance::knn() AS {_DIST}"
+        elif matches is not None:
+            stats = self._ft_global_stats(stm, matches, session, vars)
+            if stats is None:
+                # no search index anywhere: every node falls back to the
+                # naive containment operator — still scatter + replay
+                ref = matches.ref
+            else:
+                if any(
+                    stats["df"].get(t, 0) <= 0 for t in (stats.get("terms") or [])
+                ):
+                    return self._replay(stm, session, vars, [], knn, matches)
+                scatter_vars["__cluster_ft_stats"] = {
+                    "dc": stats["dc"], "tl": stats["tl"], "df": stats["df"],
+                }
+                ref = matches.ref
+            extra_proj = f", search::score({ref if ref is not None else 0}) AS {_SCORE}"
+
+        from_txt = ", ".join(repr(e) for e in stm.what)
+        inner = f"SELECT *{extra_proj} FROM {from_txt}"
+        if cond is not None:
+            inner += f" WHERE {cond!r}"
+        # LIMIT pushdown: safe only when the statement neither reorders nor
+        # aggregates (each shard then over-fetches exactly the global cap)
+        push = self._static_limit(stm, session, vars)
+        if (
+            push is not None
+            and knn is None
+            and matches is None
+            and not stm.order
+            and not stm.group
+            and not getattr(stm, "group_all", False)
+            and not stm.split
+        ):
+            inner += f" LIMIT {push}"
+
+        per_node = self._scatter_sql(self._all_nodes(), inner, session, scatter_vars)
+        rows = self._gather_rows(per_node)
+        if knn is not None:
+            rows = _merge.merge_topk(rows, int(knn.k), _DIST)
+        elif matches is not None:
+            rows = _merge.sort_by_score(rows, _SCORE)
+        else:
+            rows = _merge.sort_rows_scan_order(
+                rows, self._from_tables(stm, session, vars)
+            )
+        return self._replay(stm, session, vars, rows, knn, matches)
+
+    def _replay(self, stm, session, vars, rows, knn, matches) -> dict:
+        """Re-run the ORIGINAL statement shape over the gathered rows: the
+        WHERE already ran on the shards (and the kNN/BM25 merge decided
+        membership), so the cond drops; score/distance functions resolve
+        from the carrier fields instead of a per-statement query executor."""
+        saved = (stm.what, stm.cond, stm.fields, stm.order)
+        try:
+            stm.what = [Param(_ROWS)]
+            stm.cond = None
+            stm.fields = [_rewrite_field(f) for f in stm.fields]
+            if stm.order:
+                stm.order = [_rewrite_order(o) for o in stm.order]
+            out = self.ds.process(
+                Query([stm]), session, dict(vars or {}, **{_ROWS: rows})
+            )
+        finally:
+            stm.what, stm.cond, stm.fields, stm.order = saved
+        resp = {"status": out[0]["status"], "result": out[0]["result"]}
+        if resp["status"] == "OK":
+            resp["result"] = _merge.strip_cluster_fields(resp["result"])
+        return resp
+
+    def _static_limit(self, stm, session, vars) -> Optional[int]:
+        try:
+            if stm.limit is None:
+                return None
+            vals = self._eval_exprs(
+                [stm.limit] + ([stm.start] if stm.start is not None else []),
+                session, vars,
+            )
+            limit = int(vals[0])
+            start = int(vals[1]) if len(vals) > 1 else 0
+            return limit + start
+        except (SurrealError, TypeError, ValueError):
+            return None
+
+    def _ft_global_stats(self, stm, matches, session, vars) -> Optional[dict]:
+        """Phase one of distributed BM25: merge every member's local corpus
+        statistics into the global df/dc/avgdl the shards will score with."""
+        tables = self._from_tables(stm, session, vars)
+        if len(tables) != 1 or not isinstance(matches.l, Idiom):
+            return None
+        query = self._eval_exprs([matches.r], session, vars)[0]
+        req = {
+            "ns": session.ns,
+            "db": session.db,
+            "tb": tables[0],
+            "field": repr(matches.l),
+            "query": str(query),
+        }
+        gathered = self._fan_out(self._all_nodes(), "ft_stats", req)
+        return _merge.merge_ft_stats(list(gathered.values()))
+
+    # ---- graph frontier exchange
+    def _graph_select(self, stm, session, vars, idiom: Idiom) -> dict:
+        targets = self._flatten_targets(self._eval_exprs(stm.what, session, vars))
+        sources: List[Thing] = []
+        for t in targets:
+            if isinstance(t, Thing) and not isinstance(t.id, Range):
+                sources.append(t)
+            elif isinstance(t, Table):
+                sources.extend(self._table_ids(str(t), session))
+            else:
+                return _err(f"graph SELECT: unsupported cluster source {t!r}")
+
+        # per-hop frontier exchange: broadcast each level's unique ids;
+        # every member expands the pointers IT holds (empty elsewhere), and
+        # the per-id lists concatenate in node order — deterministic, and
+        # each pointer key exists on exactly one member
+        hop_maps: List[Dict[str, Any]] = []
+        frontier: List[Thing] = list(dict.fromkeys(sources))
+        for part in idiom.parts:
+            if not frontier:
+                hop_maps.append({})
+                continue
+            req = {
+                "ns": session.ns,
+                "db": session.db,
+                "dir": part.dir,
+                "what": list(part.what or []),
+                "ids": frontier,
+            }
+            gathered = self._fan_out(self._all_nodes(), "expand", req)
+            exp: Dict[str, Any] = {}
+            for nid in sorted(gathered):
+                for k, v in (gathered[nid].get("map") or {}).items():
+                    if not isinstance(v, list) or not v:
+                        continue
+                    exp.setdefault(k, []).extend(v)
+            hop_maps.append(exp)
+            nxt: List[Thing] = []
+            seen = set()
+            for v in exp.values():
+                for t in v if isinstance(v, list) else ([v] if isinstance(v, Thing) else []):
+                    if isinstance(t, Thing) and repr(t) not in seen:
+                        seen.add(repr(t))
+                        nxt.append(t)
+            frontier = nxt
+
+        def expand(src: Thing) -> List[Any]:
+            cur: List[Any] = [src]
+            for mp in hop_maps:
+                nxt: List[Any] = []
+                for t in cur:
+                    v = mp.get(repr(t)) if isinstance(t, Thing) else None
+                    if isinstance(v, list):
+                        nxt.extend(v)
+                    elif v is not None and not is_none(v):
+                        nxt.append(v)
+                cur = nxt
+            return cur
+
+        f = stm.fields[0]
+        if getattr(stm, "value_mode", False):
+            rows: List[Any] = [expand(s) for s in sources]
+        else:
+            if f.alias is not None:
+                key = (
+                    f.alias.simple_name()
+                    if isinstance(f.alias, Idiom) and f.alias.simple_name()
+                    else repr(f.alias)
+                )
+            else:
+                key = repr(idiom)
+            rows = [{key: expand(s)} for s in sources]
+        if getattr(stm, "only", False):
+            return _ok(rows[0] if rows else NONE)
+        return _ok(rows)
+
+    def _table_ids(self, tb: str, session) -> List[Thing]:
+        from surrealdb_tpu.sql.value import escape_ident
+
+        per_node = self._scatter_sql(
+            self._all_nodes(), f"SELECT id FROM {escape_ident(tb)}", session, None
+        )
+        rows = _merge.sort_rows_scan_order(self._gather_rows(per_node), [tb])
+        return [r["id"] for r in rows if isinstance(r, dict) and isinstance(r.get("id"), Thing)]
+
+
+# ------------------------------------------------------------------ helpers
+def _align_insert_rows(
+    tb: str, batch: List[Tuple[int, dict]], got: List[Any]
+) -> List[Tuple[int, Any]]:
+    """Pair an owner's INSERT output rows back to their original input
+    indexes. With IGNORE (or a unique-index skip) the output is SHORTER
+    than the input, so positional zip would misattribute indexes and the
+    cross-owner reassembly would reorder rows — match by record id when
+    the inputs carry them, else fall back to positional pairing."""
+    if len(got) == len(batch):
+        return [(i, row) for (i, _), row in zip(batch, got)]
+    by_id: Dict[str, Any] = {}
+    for row in got:
+        if isinstance(row, dict) and isinstance(row.get("id"), Thing):
+            by_id[repr(row["id"])] = row
+    out: List[Tuple[int, Any]] = []
+    matched = 0
+    for i, src in batch:
+        rid = src.get("id") if isinstance(src, dict) else None
+        if rid is None:
+            continue
+        key = repr(rid) if isinstance(rid, Thing) else repr(Thing(tb, rid))
+        row = by_id.get(key)
+        if row is not None:
+            out.append((i, row))
+            matched += 1
+    if matched == len(got):
+        return out
+    # ids didn't resolve every output row (RELATION payloads, exotic ids):
+    # keep the owner's own order, positionally
+    return [(batch[j][0], row) for j, row in enumerate(got)]
+
+
+def _has_subquery(node) -> bool:
+    """True when an AST fragment (or whole statement) embeds a Subquery —
+    shard-partial evaluation territory the cluster must refuse."""
+    found = [False]
+
+    def visit(n):
+        if isinstance(n, Subquery):
+            found[0] = True
+
+    walk_exprs(node, visit)
+    return found[0]
+
+
+def _has_inbound_graph(node) -> bool:
+    """True when a fragment traverses `<-` / `<->` edges: their pointer
+    keys live on the edge source's owner, not the evaluating shard."""
+    found = [False]
+
+    def visit(n):
+        if isinstance(n, PGraph) and n.dir != "out":
+            found[0] = True
+
+    walk_exprs(node, visit)
+    return found[0]
+
+
+def _find_operator(expr, klass):
+    """A kNN/MATCHES operator reachable through ANDs (planner twin)."""
+    if expr is None:
+        return None
+    if isinstance(expr, klass):
+        return expr
+    from surrealdb_tpu.sql.ast import BinaryOp
+
+    if isinstance(expr, BinaryOp) and expr.op in ("&&", "AND"):
+        return _find_operator(expr.l, klass) or _find_operator(expr.r, klass)
+    return None
+
+
+def _star_field():
+    from surrealdb_tpu.sql.statements import Field
+
+    return Field(None, all_=True)
+
+
+def _carrier_idiom(name: str) -> Idiom:
+    return Idiom([PField(name)])
+
+
+def _rewrite_expr(expr):
+    """search::score(...) / vector::distance::knn() -> the carrier fields
+    the scatter projection added to every gathered row."""
+    if isinstance(expr, FunctionCall):
+        if expr.name == "search::score":
+            return _carrier_idiom(_SCORE)
+        if expr.name == "vector::distance::knn":
+            return _carrier_idiom(_DIST)
+    return expr
+
+
+def _rewrite_field(f):
+    from surrealdb_tpu.sql.statements import Field
+
+    if getattr(f, "all", False) or f.expr is None:
+        return f
+    new = _rewrite_expr(f.expr)
+    if new is f.expr:
+        return f
+    # preserve the display name of the original expression when un-aliased
+    alias = f.alias if f.alias is not None else _display_alias(f.expr)
+    return Field(new, alias=alias)
+
+
+def _display_alias(expr):
+    from surrealdb_tpu.dbs.iterator import field_display_name
+
+    return Idiom([PField(field_display_name(expr))])
+
+
+def _rewrite_order(o):
+    from surrealdb_tpu.sql.statements import OrderItem
+
+    new = _rewrite_expr(o.idiom)
+    if new is o.idiom:
+        return o
+    return OrderItem(new, asc=o.asc, collate=o.collate, numeric=o.numeric, rand=o.rand)

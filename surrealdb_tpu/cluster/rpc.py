@@ -1,0 +1,148 @@
+"""Server side of the internal `/cluster` channel.
+
+Each op executes against THIS node's shard of the data (execute_local —
+never back through the cluster executor, or a scatter would recurse) and
+returns its payload plus the spans recorded while handling, so the
+coordinator can graft them into the one request-wide trace.
+
+Ops:
+    query     {sql, ns, db, vars}            -> {results}
+    ft_stats  {ns, db, tb, field, query}     -> {dc, tl, df, terms} | {missing}
+    expand    {ns, db, part, ids}            -> {map: repr(id) -> expansion}
+    ping      {}                             -> {ok}
+
+The channel is authenticated by the shared config secret (net/server.py
+checks `x-surreal-cluster-key` before calling handle()); ops execute with
+system privileges — the COORDINATOR's public ingress is where user auth and
+capabilities are enforced.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from surrealdb_tpu.err import SurrealError
+
+
+def handle(ds, req: Dict[str, Any]) -> Dict[str, Any]:
+    """Execute one cluster op; never raises — failures come back as
+    {"error": ...} so the transport stays a clean 200 CBOR channel and the
+    coordinator can distinguish node-down from op-failed."""
+    from surrealdb_tpu import telemetry, tracing
+
+    op = str(req.get("op", ""))
+    fn = _OPS.get(op)
+    try:
+        if fn is None:
+            raise SurrealError(f"unknown cluster op {op!r}")
+        with telemetry.span("cluster_serve", op=op):
+            out = fn(ds, req)
+    except SurrealError as e:
+        out = {"error": str(e)}
+    except Exception as e:  # noqa: BLE001 — a bad op must not kill the channel
+        out = {"error": f"Internal error: {type(e).__name__}: {e}"}
+    out["node"] = str(getattr(getattr(ds, "cluster", None), "node_id", "") or "")
+    out["spans"] = tracing.export_spans()
+    return out
+
+
+def _session(req):
+    from surrealdb_tpu.dbs.session import Session
+
+    return Session.owner(req.get("ns"), req.get("db"))
+
+
+def _op_ping(ds, req):
+    return {"ok": True}
+
+
+def _op_query(ds, req):
+    sql = str(req.get("sql", ""))
+    vars = req.get("vars") or None
+    if vars is not None and not isinstance(vars, dict):
+        raise SurrealError("cluster query vars must be an object")
+    results = ds.execute_local(sql, _session(req), vars)
+    return {"results": results}
+
+
+def _op_expand(ds, req):
+    """One graph hop over THIS node's pointer keys: expand every requested
+    record id through one `->edge` / `<-edge` / `<->edge` step, evaluated
+    directly on the id (get_path over a Thing) — pointer keys are read even
+    when the RECORD lives on another member (RELATE writes both directions'
+    pointers where it executes, so inbound pointers routinely sit on a
+    non-owner). Ids with no local pointers yield empty lists; the
+    coordinator concatenates per-id across members (frontier exchange)."""
+    from surrealdb_tpu.dbs.context import Context
+    from surrealdb_tpu.dbs.executor import Executor
+    from surrealdb_tpu.sql.path import PGraph, get_path
+    from surrealdb_tpu.sql.value import Thing
+
+    ids = req.get("ids") or []
+    direction = str(req.get("dir", "out"))
+    if direction not in ("out", "in", "both"):
+        raise SurrealError(f"bad expand direction {direction!r}")
+    part = PGraph(direction, [str(w) for w in (req.get("what") or [])])
+    sess = _session(req)
+    ex = Executor(ds, sess)
+    ctx = Context(ex, sess)
+    ex._open(False)
+    mp: Dict[str, Any] = {}
+    try:
+        for t in ids:
+            if not isinstance(t, Thing):
+                continue
+            v = get_path(ctx, t, [part])
+            mp[repr(t)] = v if isinstance(v, list) else [v]
+    finally:
+        ex._cancel()
+    return {"map": mp}
+
+
+def _op_ft_stats(ds, req):
+    """Local corpus statistics for one search index + query: doc count,
+    total doc length, per-term document frequency — phase one of the
+    two-phase distributed BM25 (global stats, then globally-scored
+    postings)."""
+    from surrealdb_tpu.dbs.executor import Executor
+    from surrealdb_tpu.dbs.context import Context
+    from surrealdb_tpu.idx.ft_index import FtIndex
+    from surrealdb_tpu.idx.ft_mirror import FtMirror
+
+    ns, db = req.get("ns"), req.get("db")
+    tb, field = str(req.get("tb", "")), str(req.get("field", ""))
+    query = str(req.get("query", ""))
+    sess = _session(req)
+    ex = Executor(ds, sess)
+    ctx = Context(ex, sess)
+    ex._open(False)
+    try:
+        txn = ctx.txn()
+        ix = next(
+            (
+                i
+                for i in txn.all_tb_indexes(ns, db, tb)
+                if i["index"]["type"] == "search"
+                and i.get("status", "ready") == "ready"
+                and i["fields"]
+                and repr(i["fields"][0]) == field
+            ),
+            None,
+        )
+        if ix is None:
+            return {"missing": True}
+        mirror = ds.index_stores.get_or_create(ns, db, tb, ix["name"], FtMirror)
+        mirror.ensure_built(ctx, ix)
+        terms = FtIndex.for_index(None, ix).analyzer(ctx).terms(query)
+        dc, tl, df = mirror.term_stats(terms)
+        return {"dc": dc, "tl": tl, "df": df, "terms": terms}
+    finally:
+        ex._cancel()
+
+
+_OPS = {
+    "ping": _op_ping,
+    "query": _op_query,
+    "expand": _op_expand,
+    "ft_stats": _op_ft_stats,
+}

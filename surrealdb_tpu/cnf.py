@@ -168,6 +168,12 @@ KNN_COLUMN_PREFILTER = _env_bool("SURREAL_KNN_COLUMN_PREFILTER", True)
 # is measurable GIL-held work on a million-row scan)
 SCAN_DEADLINE_INTERVAL = _env_int("SURREAL_SCAN_DEADLINE_INTERVAL", 256)
 
+# Cluster mode (surrealdb_tpu/cluster/): inter-node RPC deadline — a dead
+# shard owner surfaces as a per-shard error after this long instead of a
+# hung query — and the liveness-probe pump interval per remote node.
+CLUSTER_RPC_TIMEOUT_SECS = _env_float("SURREAL_CLUSTER_RPC_TIMEOUT", 10.0)
+CLUSTER_PROBE_INTERVAL_SECS = _env_float("SURREAL_CLUSTER_PROBE_INTERVAL", 2.0)
+
 # Changefeeds
 CHANGEFEED_GC_INTERVAL_SECS = _env_int("SURREAL_CHANGEFEED_GC_INTERVAL", 10)
 

@@ -192,6 +192,9 @@ class DispatchQueue:
 
     def _bucket(self, key: Hashable) -> _Bucket:
         with self._lock:
+            # the queue counters + bucket map are one guarded unit
+            # (sanitizer-declared: stats() diffs depend on their atomicity)
+            _locks.assert_held(self._lock, "dispatch.counters")
             b = self._buckets.get(key)
             if b is None:
                 b = self._buckets[key] = _Bucket(self._depth())
@@ -272,6 +275,7 @@ class DispatchQueue:
         from surrealdb_tpu import telemetry, tracing
 
         with self._lock:
+            _locks.assert_held(self._lock, "dispatch.counters")
             self.dispatches += 1
             self.batched += len(batch) - 1
             self.pipeline_wait_s += pipeline_wait
@@ -325,6 +329,7 @@ class DispatchQueue:
             return None
         finally:
             with self._lock:
+                _locks.assert_held(self._lock, "dispatch.counters")
                 self.launch_s += _time.perf_counter() - t0
         self._trace_batch(batch, "dispatch_launch", t0, _time.perf_counter() - t0)
         if not callable(res):
@@ -352,6 +357,7 @@ class DispatchQueue:
                 return
             finally:
                 with self._lock:
+                    _locks.assert_held(self._lock, "dispatch.counters")
                     self.collect_s += _time.perf_counter() - t1
             self._trace_batch(batch, "dispatch_collect", t1, _time.perf_counter() - t1)
             self._distribute(batch, results)
@@ -396,6 +402,7 @@ class DispatchQueue:
                 return
             mid = len(sub) // 2
             with self._lock:
+                _locks.assert_held(self._lock, "dispatch.counters")
                 self.splits += 1
             telemetry.inc("dispatch_splits", cause=_retry_cause(err))
             self._trace_batch(
@@ -433,6 +440,7 @@ class DispatchQueue:
         from surrealdb_tpu import telemetry
 
         with self._lock:
+            _locks.assert_held(self._lock, "dispatch.counters")
             self.retries += 1
         telemetry.inc("dispatch_retries", cause=_retry_cause(e))
         # the cause rides as a LABEL, not a span error: a retried-then-
@@ -461,6 +469,7 @@ class DispatchQueue:
         from surrealdb_tpu import telemetry
 
         with self._lock:
+            _locks.assert_held(self._lock, "dispatch.counters")
             self.failures += 1
         telemetry.inc("dispatch_failures", error=telemetry.error_class(e))
         t = _time.perf_counter()

@@ -45,6 +45,7 @@ from surrealdb_tpu import key as keys
 from surrealdb_tpu.key.encode import prefix_end
 from surrealdb_tpu.ops.predicates import (
     TAG_BOOL,
+    TAG_DATETIME,
     TAG_FLOAT,
     TAG_INT,
     TAG_NONE,
@@ -54,7 +55,7 @@ from surrealdb_tpu.ops.predicates import (
     F64_EXACT_INT,
     CompiledPredicate,
 )
-from surrealdb_tpu.sql.value import Thing, is_none, is_null
+from surrealdb_tpu.sql.value import Datetime, Thing, is_none, is_null
 from surrealdb_tpu.utils.ser import unpack
 
 
@@ -62,13 +63,27 @@ from surrealdb_tpu.utils.ser import unpack
 class Column:
     """One dotted path's values over the table's row order."""
 
-    __slots__ = ("tags", "nums", "_strs", "_nonempty")
+    __slots__ = ("tags", "nums", "_strs", "_nonempty", "_i64")
 
-    def __init__(self, tags: np.ndarray, nums: np.ndarray, strs: Optional[np.ndarray]):
+    def __init__(
+        self,
+        tags: np.ndarray,
+        nums: np.ndarray,
+        strs: Optional[np.ndarray],
+        i64: Optional[np.ndarray] = None,
+    ):
         self.tags = tags
         self.nums = nums
         self._strs = strs  # object-dtype, "" where not a string
         self._nonempty: Optional[np.ndarray] = None
+        # exact integer plane: datetime nanos (epoch nanos overflow the f64
+        # mantissa — ~1.7e18 vs 2^53 — so they compare on int64)
+        self._i64 = i64
+
+    def i64(self) -> np.ndarray:
+        if self._i64 is None:
+            self._i64 = np.zeros(len(self.tags), dtype=np.int64)
+        return self._i64
 
     def str_eq(self, c: str) -> np.ndarray:
         if self._strs is None:
@@ -92,6 +107,17 @@ class Column:
                 self._nonempty = np.asarray(self._strs != "", dtype=bool)
         return self._nonempty
 
+    def str_contains(self, c: str) -> np.ndarray:
+        """Substring containment per STRING cell (`field CONTAINS 'sub'`).
+        Object-dtype columns have no vectorized substring kernel; the
+        generator pass is still one C-level loop over python strings —
+        far from the row path's full per-row cond.compute machinery."""
+        if self._strs is None:
+            return np.zeros(len(self.tags), dtype=bool)
+        return np.fromiter(
+            (c in s for s in self._strs), dtype=bool, count=len(self.tags)
+        )
+
 
 def _all_none_column(n: int) -> Column:
     return Column(np.zeros(n, dtype=np.int8), np.zeros(n, dtype=np.float64), None)
@@ -101,13 +127,15 @@ class _ColBuilder:
     """Growable column during the build scan; rows before first sight
     backfill as NONE (missing field == NONE, get_path semantics)."""
 
-    __slots__ = ("tags", "nums", "str_rows", "str_vals", "n")
+    __slots__ = ("tags", "nums", "str_rows", "str_vals", "i64_rows", "i64_vals", "n")
 
     def __init__(self, cap: int, backfill: int):
         self.tags = np.zeros(cap, dtype=np.int8)
         self.nums = np.zeros(cap, dtype=np.float64)
         self.str_rows: List[int] = []
         self.str_vals: List[str] = []
+        self.i64_rows: List[int] = []  # datetime cells (nanos, exact)
+        self.i64_vals: List[int] = []
         self.n = backfill  # rows already covered (as NONE)
 
     def grow(self, cap: int) -> None:
@@ -119,13 +147,16 @@ class _ColBuilder:
             self.tags, self.nums = t, m
 
     def put(self, row: int, v: Any) -> None:
-        tag, num, s = _classify(v)
+        tag, num, s, i64 = _classify(v)
         self.tags[row] = tag
         if num is not None:
             self.nums[row] = num
         if s is not None:
             self.str_rows.append(row)
             self.str_vals.append(s)
+        if i64 is not None:
+            self.i64_rows.append(row)
+            self.i64_vals.append(i64)
         self.n = row + 1
 
     def finalize(self, n: int) -> Column:
@@ -135,27 +166,34 @@ class _ColBuilder:
         if self.str_vals:
             strs = np.full(n, "", dtype=object)
             strs[self.str_rows] = self.str_vals
-        return Column(tags, nums, strs)
+        i64 = None
+        if self.i64_vals:
+            i64 = np.zeros(n, dtype=np.int64)
+            i64[self.i64_rows] = self.i64_vals
+        return Column(tags, nums, strs, i64)
 
 
-def _classify(v) -> Tuple[int, Optional[float], Optional[str]]:
-    """(tag, numeric value, string value) for one scalar cell; anything the
-    mask algebra can't reproduce exactly is OTHER (per-row fallback)."""
+def _classify(v) -> Tuple[int, Optional[float], Optional[str], Optional[int]]:
+    """(tag, numeric value, string value, int64 value) for one scalar cell;
+    anything the mask algebra can't reproduce exactly is OTHER (per-row
+    fallback)."""
     if is_none(v):
-        return TAG_NONE, None, None
+        return TAG_NONE, None, None, None
     if is_null(v):
-        return TAG_NULL, None, None
+        return TAG_NULL, None, None, None
     if isinstance(v, bool):
-        return TAG_BOOL, 1.0 if v else 0.0, None
+        return TAG_BOOL, 1.0 if v else 0.0, None, None
     if isinstance(v, int):
         if -F64_EXACT_INT <= v <= F64_EXACT_INT:
-            return TAG_INT, float(v), None
-        return TAG_OTHER, None, None
+            return TAG_INT, float(v), None, None
+        return TAG_OTHER, None, None, None
     if isinstance(v, float):
-        return TAG_FLOAT, v, None
+        return TAG_FLOAT, v, None, None
     if isinstance(v, str) and type(v) is str:
-        return TAG_STR, None, v
-    return TAG_OTHER, None, None
+        return TAG_STR, None, v, None
+    if isinstance(v, Datetime):
+        return TAG_DATETIME, None, None, v.nanos
+    return TAG_OTHER, None, None, None
 
 
 # ------------------------------------------------------------------ mirror

@@ -357,9 +357,28 @@ class FtMirror:
         self.dirty = False
 
     # ------------------------------------------------------------ search
-    def search(self, terms: List[str], k1: float, b: float):
+    def term_stats(self, terms: List[str]):
+        """Local corpus statistics for a term set: (doc count, total doc
+        length, {term: document frequency}) — phase one of the cluster's
+        two-phase BM25 (cluster/rpc.py ft_stats). Unknown terms report 0."""
+        with self._lock:
+            self._ensure_arrays()
+            df: Dict[str, int] = {}
+            for t in dict.fromkeys(terms):
+                tid = self.term_ids.get(t)
+                df[t] = (
+                    int(self.t_indptr[tid + 1] - self.t_indptr[tid])
+                    if tid is not None
+                    else 0
+                )
+            return int(self.dc), float(self.tl), df
+
+    def search(self, terms: List[str], k1: float, b: float, stats_override=None):
         """AND-match the analyzed query terms; returns (dids, scores) —
-        empty arrays when any term is unknown."""
+        empty arrays when any term is unknown. `stats_override`
+        ({dc, tl, df: {term: n}}) swaps the corpus statistics BM25 scores
+        with — the cluster executor passes the merged GLOBAL stats so every
+        shard scores exactly as one single-node corpus would."""
         from surrealdb_tpu import cnf
 
         with self._lock:
@@ -368,11 +387,13 @@ class FtMirror:
             if not uniq:
                 return np.empty(0, np.int64), np.empty(0, np.float32)
             tids = []
+            term_of: Dict[int, str] = {}
             for t in uniq:
                 tid = self.term_ids.get(t)
                 if tid is None or self.t_indptr[tid + 1] == self.t_indptr[tid]:
                     return np.empty(0, np.int64), np.empty(0, np.float32)
                 tids.append(tid)
+                term_of[tid] = t
             # rarest-first intersection over sorted did rows
             tids.sort(key=lambda tid: self.t_indptr[tid + 1] - self.t_indptr[tid])
             rows = [
@@ -400,6 +421,14 @@ class FtMirror:
             )
             lens = self.doclen_arr[cand]
             dc, tl = self.dc, self.tl
+            if isinstance(stats_override, dict):
+                odf = stats_override.get("df") or {}
+                df = np.array(
+                    [float(odf.get(term_of[t], df[i])) for i, t in enumerate(tids)],
+                    dtype=np.float32,
+                )
+                dc = float(stats_override.get("dc", dc))
+                tl = float(stats_override.get("tl", tl))
         if not cnf.TPU_DISABLE and cand.size >= cnf.TPU_FT_ONDEVICE_THRESHOLD:
             from surrealdb_tpu import compile_log
             from surrealdb_tpu.ops.bm25 import bm25_scores

@@ -55,7 +55,14 @@ class MatchesPlan:
             terms = self.ft.analyzer(ctx).terms(self.query)
             k1 = float(self.ix["index"].get("k1", 1.2))
             b = float(self.ix["index"].get("b", 0.75))
-            dids, scores = mirror.search(terms, k1, b)
+            # cluster mode: the coordinator injects merged GLOBAL corpus
+            # stats so per-shard scoring matches one single-node corpus
+            # (cluster/executor.py two-phase BM25)
+            stats = ctx.get_param("__cluster_ft_stats")
+            dids, scores = mirror.search(
+                terms, k1, b,
+                stats_override=stats if isinstance(stats, dict) else None,
+            )
             import numpy as np
 
             order = np.argsort(-scores, kind="stable")

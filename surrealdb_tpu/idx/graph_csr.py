@@ -84,6 +84,7 @@ class PointerCsr:
 
     def load(self, adj: Dict[int, List[int]]) -> None:
         with self._lock:
+            _locks.assert_held(self._lock, "graph.adjacency")
             self.adj = adj
             self.edge_count = sum(len(v) for v in adj.values())
             self.version += 1
@@ -93,6 +94,9 @@ class PointerCsr:
         """Idempotent delta: pointer keys are unique in KV, so the mirror
         holds at most one (src, dst) entry per keyspace."""
         with self._lock:
+            # adjacency/version/dirty are one guarded unit: a mutation
+            # outside idx.graph.mirror races ensure_arrays' compaction
+            _locks.assert_held(self._lock, "graph.adjacency")
             lst = self.adj.setdefault(src, [])
             if add:
                 if dst not in lst:
@@ -113,6 +117,7 @@ class PointerCsr:
         """Compact host adjacency into CSR arrays (numpy only — no KV)."""
         n = len(self.interner)
         with self._lock:
+            _locks.assert_held(self._lock, "graph.adjacency")
             if not self.dirty and self.n_built == n and self.indptr is not None:
                 return
             # indptr spans a pow2-padded node capacity and indices a pow2

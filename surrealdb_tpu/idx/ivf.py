@@ -276,7 +276,8 @@ class IvfState:
         return self._dev
 
     def search_host(
-        self, qs: np.ndarray, data: np.ndarray, metric: str, k: int, nprobe: int
+        self, qs: np.ndarray, data: np.ndarray, metric: str, k: int, nprobe: int,
+        slot_mask: Optional[np.ndarray] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """CPU twin of `search_batch`: the same probe+exact-rerank recipe in
         numpy over the host mirror. This is the honest CPU-ANN baseline the
@@ -314,11 +315,16 @@ class IvfState:
         for qi in range(nq):
             cl = [self.lists[int(p)] for p in probes[qi]]
             total = sum(len(l) for l in cl)
-            cand_per_q.append(
-                np.fromiter((s for l in cl for s in l), dtype=np.int64, count=total)
-            )
+            c = np.fromiter((s for l in cl for s in l), dtype=np.int64, count=total)
+            if slot_mask is not None:
+                # columnar residual prefilter: rerank only matching slots —
+                # top-k among rows that satisfy the WHERE, the same
+                # condition-checker semantics as the exact strategies
+                inb = c < slot_mask.shape[0]
+                c = c[inb & slot_mask[np.minimum(c, slot_mask.shape[0] - 1)]]
+            cand_per_q.append(c)
             telemetry.observe_hist(
-                "ivf_candidates", total, buckets=telemetry.COUNT_BUCKETS, path="host"
+                "ivf_candidates", int(c.size), buckets=telemetry.COUNT_BUCKETS, path="host"
             )
         counts = np.array([c.size for c in cand_per_q], dtype=np.int64)
         q2 = (qs**2).sum(1)
@@ -391,15 +397,24 @@ class IvfState:
 
     def search_batch_launch(
         self, qs: np.ndarray, matrix, metric: str, k: int, nprobe: int,
-        tile: Optional[int] = None, owner=None,
+        tile: Optional[int] = None, owner=None, slot_mask=None,
     ):
         """Async probe+rerank: enqueue every tile's kernel + start the
         device→host copies, return a collect() closure that blocks on the
         results. Lets the dispatch queue overlap the next batch's upload
-        with this batch's compute/download (double buffering)."""
+        with this batch's compute/download (double buffering). `slot_mask`
+        [cap] restricts the rerank to matching corpus slots (the columnar
+        residual prefilter — ROADMAP carried item)."""
         import jax.numpy as jnp
 
         cents, list_rows, list_mask = self._device()
+        if slot_mask is None:
+            slot_ok = jnp.ones(int(matrix.shape[0]), dtype=bool)
+        else:
+            pad = int(matrix.shape[0]) - int(slot_mask.shape[0])
+            if pad > 0:
+                slot_mask = np.concatenate([slot_mask, np.zeros(pad, dtype=bool)])
+            slot_ok = jnp.asarray(slot_mask[: int(matrix.shape[0])])
         probe_metric = metric if metric in _PROBE_METRICS else "euclidean"
         nprobe = min(nprobe, self.nlists)
         # the kernel can return at most nprobe·L candidates per query
@@ -432,7 +447,7 @@ class IvfState:
             for lo, hi in tile_slices(nq, tile):
                 d, r = _ivf_search(
                     jnp.asarray(pad_tail(qs[lo:hi], tile)), cents, list_rows,
-                    list_mask, matrix,
+                    list_mask, matrix, slot_ok,
                     metric=metric, probe_metric=probe_metric, k=k, nprobe=nprobe,
                 )
                 _start_host_copy(d, r)
@@ -487,6 +502,7 @@ class IvfState:
                         _ivf_search(
                             jnp.zeros((t, dim), jnp.float32), cents, list_rows,
                             list_mask, matrix,
+                            jnp.ones(int(matrix.shape[0]), dtype=bool),
                             metric=metric, probe_metric=probe_metric, k=k,
                             nprobe=nprobe,
                         )
@@ -598,8 +614,12 @@ class IvfState:
 
 
 @functools.partial(jax.jit, static_argnames=("metric", "probe_metric", "k", "nprobe"))
-def _ivf_search(q, cents, list_rows, list_mask, x, metric, probe_metric, k, nprobe):
-    """q [Q, D] → (dists [Q, k], row slots [Q, k]); vmapped per query."""
+def _ivf_search(q, cents, list_rows, list_mask, x, slot_ok, metric, probe_metric, k, nprobe):
+    """q [Q, D] → (dists [Q, k], row slots [Q, k]); vmapped per query.
+    `slot_ok` [cap] masks corpus slots (all-true without a prefilter): the
+    columnar residual-WHERE mask ANDs in here, so top-k is computed among
+    MATCHING rows only (the condition-checker semantics the exact
+    strategies already had)."""
     import jax.numpy as jnp
 
     dc = D.pairwise_distance(q, cents, probe_metric)  # [Q, C]
@@ -607,8 +627,9 @@ def _ivf_search(q, cents, list_rows, list_mask, x, metric, probe_metric, k, npro
 
     def one(qi, pr):
         rows = list_rows[pr].reshape(-1)  # [nprobe*L]
-        mask = list_mask[pr].reshape(-1)
-        cand = x[jnp.clip(rows, 0, x.shape[0] - 1)]  # gather [nprobe*L, D]
+        rows_c = jnp.clip(rows, 0, x.shape[0] - 1)
+        mask = list_mask[pr].reshape(-1) & slot_ok[rows_c]
+        cand = x[rows_c]  # gather [nprobe*L, D]
         d = D.pairwise_distance(qi[None, :], cand, metric)[0]
         d = jnp.where(mask, d, jnp.inf)
         kk = min(k, int(rows.shape[0]))

@@ -752,11 +752,22 @@ class KnnPlan(_KnnExecutorMixin):
                     # Keyed by the matrix/ivf identities so a batch never mixes
                     # slot numberings.
                     key = ("knn-ivf", id(matrix), id(ivf), metric, k, nprobe)
+                    # residual-WHERE prefilter (parity with the exact
+                    # strategies): the mask rides into the probe+rerank
+                    # kernel so top-k is computed among MATCHING rows; the
+                    # key carries the mask content so riders with different
+                    # $param bindings never share a leader's tighter mask
+                    slot_mask = None
+                    if self.prefilter is not None:
+                        pre = self._prefilter_slot_mask(ctx, rids, len(mask))
+                        if pre is not None:
+                            slot_mask = pre[0]
+                            key = key + pre[1]
 
                     def runner(qs):
                         collect = ivf.search_batch_launch(
                             np.stack(qs), matrix, metric, k, nprobe,
-                            owner=mirror._owner,
+                            owner=mirror._owner, slot_mask=slot_mask,
                         )
 
                         def finish():
@@ -809,9 +820,15 @@ class KnnPlan(_KnnExecutorMixin):
                     self.strategy = "ivf-host"
                     ef = self.ef or self.ix["index"].get("efc")
                     data, alive, rids = mirror.host_view()
+                    slot_mask = None
+                    if self.prefilter is not None:
+                        pre = self._prefilter_slot_mask(ctx, rids, len(alive))
+                        if pre is not None:
+                            slot_mask = pre[0]
                     dists, li = ivf.search_host(
                         q[None, :], data, metric, k,
                         default_nprobe(ivf.nlists, ef),
+                        slot_mask=slot_mask,
                     )
                     dists, slots = dists[0], li[0]
                 else:

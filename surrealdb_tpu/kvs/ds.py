@@ -61,6 +61,11 @@ class Datastore:
         from surrealdb_tpu.dbs.capabilities import Capabilities
 
         self.capabilities = Capabilities.default()
+        # cluster mode (surrealdb_tpu/cluster/): when attach()ed, execute()
+        # routes through the distributed scatter/gather executor; the
+        # internal /cluster channel and the executor's own sub-queries run
+        # execute_local() against this node's shard
+        self.cluster = None
 
     @staticmethod
     def _open(path: str) -> BackendDatastore:
@@ -98,10 +103,29 @@ class Datastore:
         vars: Optional[Dict[str, Any]] = None,
     ) -> List[dict]:
         """Parse and run a SurrealQL query string; returns a list of response
-        dicts {status, result|error, time} (reference kvs/ds.rs:768)."""
+        dicts {status, result|error, time} (reference kvs/ds.rs:768). In
+        cluster mode the statement routes through the distributed executor
+        (scatter to shard owners, merge results) instead of running against
+        this node's local shard alone."""
+        if self.cluster is not None:
+            from surrealdb_tpu.dbs.session import Session
+
+            return self.cluster.executor.execute(
+                text, session or Session.owner(), vars
+            )
+        return self.execute_local(text, session, vars)
+
+    def execute_local(
+        self,
+        text: str,
+        session=None,
+        vars: Optional[Dict[str, Any]] = None,
+    ) -> List[dict]:
+        """Single-node execution against THIS node's data — the only entry
+        the cluster executor and the /cluster RPC channel use (routing back
+        through execute() would recurse the scatter)."""
         from surrealdb_tpu import tracing
         from surrealdb_tpu.syn import parse_query
-        from surrealdb_tpu.dbs.executor import Executor
         from surrealdb_tpu.dbs.session import Session
 
         # the executor level of the span tree: a root trace for embedded
@@ -178,6 +202,11 @@ class Datastore:
         from surrealdb_tpu import bg
 
         try:
+            if self.cluster is not None:
+                if self.cluster.client is not None:
+                    self.cluster.client.shutdown()
+                if self.cluster.executor is not None:
+                    self.cluster.executor.shutdown()
             self.column_mirrors.shutdown()
             self.graph_mirrors.shutdown()
             bg.shutdown(owner=id(self))

@@ -436,6 +436,37 @@ class SurrealHandler(BaseHTTPRequestHandler):
             if not self._route_allowed("signup"):
                 return
             return self._auth_route("signup")
+        if path == "/cluster":
+            # internal shard-to-shard channel (surrealdb_tpu/cluster/):
+            # CBOR ops authenticated by the shared cluster secret, NOT by
+            # user auth — the coordinator's public ingress enforced that.
+            # 404 (not 403) when this node is not in a cluster, so a
+            # misrouted public client learns nothing about the topology.
+            if self.ds.cluster is None:
+                return self._send(404, {"error": "not found"})
+            if not self._route_allowed("cluster"):
+                return
+            secret = self.ds.cluster.config.secret
+            if secret:
+                import hmac as _hmac
+
+                # constant-time compare: this header is the ONLY gate on a
+                # system-privilege channel; `!=` short-circuits per byte
+                given = self.headers.get("x-surreal-cluster-key") or ""
+                if not _hmac.compare_digest(given, secret):
+                    return self._send(401, {"error": "bad cluster key"})
+            from surrealdb_tpu.cluster import rpc as _cluster_rpc
+            from surrealdb_tpu.rpc import cbor as _cbor
+
+            try:
+                req = _cbor.decode(self._body())
+            except SurrealError:
+                return self._send(400, {"error": "invalid CBOR body"})
+            if not isinstance(req, dict):
+                return self._send(400, {"error": "cluster request must be a map"})
+            return self._send(
+                200, _cluster_rpc.handle(self.ds, req), "application/cbor"
+            )
         if path == "/ml/import":
             if not self._route_allowed("ml"):
                 return
@@ -997,6 +1028,7 @@ def serve(
     tls_cert: Optional[str] = None,
     tls_key: Optional[str] = None,
     cors_origins="*",
+    cluster_config=None,
 ) -> Server:
     from surrealdb_tpu.kvs.ds import Datastore
 
@@ -1004,6 +1036,12 @@ def serve(
     ds.enable_notifications()
     if capabilities is not None:
         ds.capabilities = capabilities
+    if cluster_config is not None:
+        # sharded serving: this node owns its consistent-hash slice and
+        # coordinates scatter/gather for queries that arrive here
+        from surrealdb_tpu import cluster as _cluster
+
+        _cluster.attach(ds, cluster_config)
     return Server(
         ds, host, port, auth_enabled,
         tls_cert=tls_cert, tls_key=tls_key, cors_origins=cors_origins,

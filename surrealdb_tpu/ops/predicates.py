@@ -35,7 +35,7 @@ import numpy as np
 
 from surrealdb_tpu.sql.ast import ArrayLit, BinaryOp, Expr, Literal, Param, UnaryOp
 from surrealdb_tpu.sql.path import Idiom
-from surrealdb_tpu.sql.value import is_none, is_null
+from surrealdb_tpu.sql.value import Datetime, is_none, is_null
 
 # column tag codes (idx/column_mirror.py writes these)
 TAG_NONE = 0  # missing field or explicit NONE
@@ -45,10 +45,12 @@ TAG_INT = 3
 TAG_FLOAT = 4
 TAG_STR = 5
 TAG_OTHER = 6  # non-scalar / unlowerable value -> per-row fallback
+TAG_DATETIME = 7  # nanos held exactly in the column's int64 plane
 
-# tag -> sql.value type ordinal (value_cmp's cross-type order); OTHER rows
-# never reach an ordinal comparison (they are masked into needs_row first)
-ORD_OF_TAG = np.array([0, 1, 2, 3, 3, 4, 127], dtype=np.int16)
+# tag -> sql.value type ordinal (value_cmp's cross-type order: None < Null <
+# Bool < Number < Strand < Duration < Datetime < ...); OTHER rows never
+# reach an ordinal comparison (they are masked into needs_row first)
+ORD_OF_TAG = np.array([0, 1, 2, 3, 3, 4, 127, 6], dtype=np.int16)
 
 # ints beyond the f64 mantissa can't round-trip the numeric column
 F64_EXACT_INT = 1 << 53
@@ -148,6 +150,26 @@ def _compile_node(ctx, e: Expr, paths: Set[str]) -> Optional[_Node]:
         if op in _CMP_OPS:
             leaf = _cmp_leaf(ctx, e, paths)
             return leaf
+        if op in ("CONTAINS", "∋", "CONTAINSNOT", "∌"):
+            # `field CONTAINS 'sub'`: for STRING cells this is substring
+            # containment; array/object/range/geometry cells are TAG_OTHER
+            # (needs_row re-checks them) and every other scalar tag is
+            # False — exactly _contains() per row. Only string constants
+            # lower: a non-string item can still match inside OTHER-tagged
+            # containers, but never inside a string.
+            path = _lower_path(e.l)
+            if path is None or not _is_const(e.r):
+                return None
+            item = _const_value(ctx, e.r)
+            if not (isinstance(item, str) and type(item) is str):
+                return None
+            if len(path.split(".")) > _depth_limit():
+                return None
+            paths.add(path)
+            leaf = _Leaf(path, "contains", item)
+            if op in ("CONTAINSNOT", "∌"):
+                return _Bool("not", [leaf])
+            return leaf
         if op in ("IN", "INSIDE", "∈", "NOT IN", "NOTINSIDE", "∉"):
             path = _lower_path(e.l)
             if path is None or not _is_const(e.r):
@@ -222,8 +244,9 @@ def _const_value(ctx, e):
 
 def _scalar_const(v) -> bool:
     """Constants the masks can compare against: NONE/NULL, bool, exact-f64
-    number, string. Everything else (things, datetimes, durations, arrays,
-    objects, decimals, huge ints) refuses to lower."""
+    number, string, datetime (nanos compare on the int64 plane). Everything
+    else (things, durations, arrays, objects, decimals, huge ints) refuses
+    to lower."""
     if is_none(v) or is_null(v):
         return True
     if isinstance(v, bool):
@@ -233,6 +256,8 @@ def _scalar_const(v) -> bool:
     if isinstance(v, float):
         return True
     if isinstance(v, str) and type(v) is str:  # Table subclasses str
+        return True
+    if isinstance(v, Datetime):
         return True
     return False
 
@@ -250,6 +275,8 @@ def _eval_node(n: _Node, columns) -> np.ndarray:
     col = columns[n.path]
     if n.op == "truthy":
         return _truthy_mask(col)
+    if n.op == "contains":
+        return (col.tags == TAG_STR) & col.str_contains(n.const)
     if n.op == "in":
         acc = None
         for x in n.const:
@@ -273,6 +300,7 @@ def _truthy_mask(col) -> np.ndarray:
     s = tags == TAG_STR
     if s.any():
         out[s] = col.str_nonempty()[s]
+    out |= tags == TAG_DATETIME  # truthy(datetime) is always True
     return out
 
 
@@ -293,6 +321,8 @@ def _eq_mask(col, c) -> np.ndarray:
         return numeric & (col.nums == cf)
     if isinstance(c, str):
         return (tags == TAG_STR) & col.str_eq(c)
+    if isinstance(c, Datetime):
+        return (tags == TAG_DATETIME) & (col.i64() == c.nanos)
     return np.zeros(len(tags), dtype=bool)
 
 
@@ -326,6 +356,8 @@ def _const_ordinal(c) -> int:
         return 2
     if isinstance(c, (int, float)):
         return 3
+    if isinstance(c, Datetime):
+        return 6  # after strand (4) and duration (5), value_cmp order
     return 4  # str
 
 
@@ -352,6 +384,11 @@ def _same_type_cmp(col, c, same: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         # NaN rows sort below every non-NaN constant
         lt[same] = row_nan[same] | (nums[same] < cf)
         gt[same] = ~row_nan[same] & (nums[same] > cf)
+        return lt, gt
+    if isinstance(c, Datetime):
+        i64 = col.i64()
+        lt[same] = i64[same] < c.nanos
+        gt[same] = i64[same] > c.nanos
         return lt, gt
     # strings: lexicographic (python order == numpy unicode/object order)
     s_lt, s_gt = col.str_cmp(c)

@@ -32,10 +32,15 @@ class Statement:
 
 
 class Query:
-    __slots__ = ("statements",)
+    # `sources` (parallel to `statements`) carries each statement's original
+    # source text when parsed from a string — the cluster executor ships
+    # THAT to peer nodes, because not every statement repr round-trips
+    # (DDL reprs are summaries)
+    __slots__ = ("statements", "sources")
 
-    def __init__(self, statements: List[Statement]):
+    def __init__(self, statements: List[Statement], sources=None):
         self.statements = statements
+        self.sources = sources
 
     def __repr__(self):
         return ";\n".join(repr(s) for s in self.statements) + ";"
@@ -594,8 +599,14 @@ class RelateStatement(Statement):
     def __repr__(self):
         out = "RELATE " + ("ONLY " if self.only else "")
         out += f"{self.from_!r} -> {self.kind!r} -> {self.with_!r}"
+        if self.uniq:
+            out += " UNIQUE"
         if self.data is not None:
             out += f" {self.data!r}"
+        if self.output is not None:
+            # the cluster executor routes RELATE by repr — dropping the
+            # RETURN clause would change what the owner node answers
+            out += f" {self.output!r}"
         return out
 
 

@@ -142,17 +142,22 @@ class Parser:
     # ------------------------------------------------------------- query
     def parse_query(self) -> S.Query:
         stmts: List[S.Statement] = []
+        spans: List[tuple] = []
         while True:
             while self.eat_op(";"):
                 pass
             if self.peek().kind == "EOF":
                 break
+            start = self.peek().pos
             stmts.append(self.parse_statement())
+            spans.append((start, self.peek().pos))
             if self.peek().kind == "EOF":
                 break
             if not self.eat_op(";"):
                 raise self.error("expected ;")
-        return S.Query(stmts)
+        return S.Query(
+            stmts, sources=[self.text[a:b].strip() for a, b in spans]
+        )
 
     # ------------------------------------------------------------- statements
     def parse_statement(self) -> S.Statement:

@@ -268,6 +268,61 @@ def record_span_into(
     tr.add(tr.next_id(), ctx.span_id, name, labels, start, dur, err)
 
 
+# ------------------------------------------------------------- cross-node
+def export_spans() -> List[dict]:
+    """Serialize the ACTIVE trace's finished spans for a cluster RPC
+    response (cluster/rpc.py): times are relative to the trace start so the
+    coordinator can rebase them into its own clock. The still-open ingress
+    root isn't in the list (it finishes after the response is built); its
+    children surface as roots and re-parent under the coordinator's RPC
+    span when grafted."""
+    ctx = _current.get()
+    if ctx is None:
+        return []
+    tr = ctx.trace
+    return [
+        {
+            "id": sid,
+            "parent": parent,
+            "name": name,
+            "labels": {k: str(v) for k, v in labels.items()},
+            "rel_start": start - tr.t0,
+            "dur": dur,
+            "error": err,
+        }
+        for (sid, parent, name, labels, start, dur, err) in list(tr.spans)
+    ]
+
+
+def graft_spans(spans: List[dict], base_start: float, node: str) -> None:
+    """Splice a remote node's exported spans into the ACTIVE trace, under
+    the current span (the coordinator's cluster_rpc span): span ids are
+    remapped into this trace's id space, orphans parent at the graft
+    point, and every span is labeled with the serving node — one request,
+    ONE span tree across the cluster."""
+    ctx = _current.get()
+    if ctx is None or not spans:
+        return
+    tr = ctx.trace
+    idmap: Dict[Any, int] = {}
+    for s in sorted(spans, key=lambda s: s.get("rel_start", 0.0)):
+        try:
+            nid = tr.next_id()
+            idmap[s.get("id")] = nid
+            parent = idmap.get(s.get("parent"), ctx.span_id)
+            tr.add(
+                nid,
+                parent,
+                str(s.get("name", "?")),
+                dict(s.get("labels") or {}, node=node),
+                base_start + float(s.get("rel_start", 0.0)),
+                float(s.get("dur", 0.0)),
+                s.get("error"),
+            )
+        except (TypeError, ValueError):
+            continue  # a malformed remote span must not break the trace
+
+
 # ------------------------------------------------------------------ ingress
 @contextmanager
 def request(

@@ -72,6 +72,8 @@ HIERARCHY: Dict[str, int] = {
     "notification.hub": 58,    # live-query channel map
     "sdk.ws_client": 60,       # SDK WS pending/notification maps
     "net.ws_send": 62,         # per-socket write framing
+    "cluster.client": 64,      # cluster node-health map (leaf-ish: only
+                               # telemetry may nest inside it)
     # storage leaves
     "kvs.version_store": 70,   # MVCC version chains
     "kvs.file": 72,            # file-backend WAL

@@ -1,0 +1,17 @@
+"""GL007 fixture: manual span name drifts from the observe() family."""
+
+import time
+
+from surrealdb_tpu import telemetry, tracing
+
+
+def serve_probe():
+    t0 = time.perf_counter()
+    tok = tracing.push()
+    dur = time.perf_counter() - t0
+    telemetry.observe("fixture_probe", dur)
+    if tok is not None:
+        tracing.pop(tok, "fixture_probe_span", {}, t0, dur)  # drifted name
+    tracing.record_span_into(
+        tracing.current(), "fixture_other", {}, t0, dur
+    )  # also drifted

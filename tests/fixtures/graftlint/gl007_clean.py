@@ -1,0 +1,22 @@
+"""GL007 clean twin: span name == observe metric family."""
+
+import time
+
+from surrealdb_tpu import telemetry, tracing
+
+
+def serve_probe():
+    t0 = time.perf_counter()
+    tok = tracing.push()
+    dur = time.perf_counter() - t0
+    telemetry.observe("fixture_probe", dur)
+    if tok is not None:
+        tracing.pop(tok, "fixture_probe", {}, t0, dur)
+    tracing.record_span_into(tracing.current(), "fixture_probe", {}, t0, dur)
+
+
+def span_only_site():
+    # a function with manual spans but NO observe() pairs with nothing —
+    # trace-only nodes are legitimate (tracing.span_only's role)
+    t0 = time.perf_counter()
+    tracing.record_span_into(tracing.current(), "fixture_note", {}, t0, 0.0)

@@ -1,0 +1,129 @@
+"""bench_diff --bundles + check_bench_artifact schema/6 rules."""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scripts import bench_diff  # noqa: E402
+from scripts import check_bench_artifact as cba  # noqa: E402
+
+
+def _bundle(columns=None, compiles=None, ann=None, dispatch=None):
+    return {
+        "schema": "surrealdb-tpu-bundle/1",
+        "engine": {
+            "column_mirrors": columns or {},
+            "vector_indexes": ann or {},
+            "dispatch": {"stats": dispatch or {}},
+        },
+        "compiles": compiles or {"events": [], "on_demand": 0, "prewarmed": 0},
+    }
+
+
+def test_diff_bundles_flags_staleness_and_compile_drift():
+    old = _bundle(
+        columns={"t.t.p": {"rows": 10, "stale": False, "rebuild_armed": False}},
+        compiles={
+            "events": [{"subsystem": "ivf", "shape": "(8,)", "mode": "prewarm"}],
+            "on_demand": 0, "prewarmed": 1,
+        },
+        ann={"t.t.item.v": {"ann": {"state": "ready"}}},
+    )
+    new = _bundle(
+        columns={"t.t.p": {"rows": 11, "stale": True, "rebuild_armed": True}},
+        compiles={
+            "events": [
+                {"subsystem": "ivf", "shape": "(8,)", "mode": "prewarm"},
+                {"subsystem": "knn_exact", "shape": "(64,)", "mode": "on_demand"},
+            ],
+            "on_demand": 1, "prewarmed": 1,
+        },
+        ann={"t.t.item.v": {"ann": {"state": "training"}}},
+    )
+    rep = bench_diff.diff_bundles(old, new)
+    text = "\n".join(rep["flags"])
+    assert "went STALE" in text
+    assert "on-demand XLA compiles rose" in text
+    assert "shape(s) compiled this round" in text
+    assert "quantizer" in text
+    assert rep["compiles"]["only_in_new"] == ["knn_exact:(64,)"]
+
+
+def test_diff_bundles_quiet_when_nothing_drifts():
+    b = _bundle(columns={"t.t.p": {"rows": 5, "stale": False}})
+    assert bench_diff.diff_bundles(b, json.loads(json.dumps(b)))["flags"] == []
+
+
+def test_bundle_diff_accepts_embedded_artifact_bundle(capsys):
+    art_old = {"schema": "surrealdb-tpu-bench/6", "bundle": _bundle()}
+    art_new = {"schema": "surrealdb-tpu-bench/6", "bundle": _bundle()}
+    rc = bench_diff._main_bundles(art_old, art_new)
+    assert rc == 0
+    assert "0 drift flag(s)" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------------ schema/6
+def _min_v6_artifact(cluster_line):
+    acct = {
+        "errors": {}, "retries": 0, "strategy": {}, "splits": 0,
+        "slow_over_5s": 0, "scan": {}, "error_breakdown": {},
+        "slowest_trace": None,
+        "bg_tasks": {"kinds": {}, "tasks": []},
+        "compiles": {"on_demand": 0, "prewarm": 0, "events": []},
+        "batch": {
+            "submitted": 0, "dispatches": 0, "batched": 0, "mean_width": None,
+            "width_dist": {}, "pipeline_wait_s": 0.0,
+        },
+    }
+    line = dict(
+        metric="cluster_knn_qps_2nodes", value=1.0, unit="qps",
+        vs_baseline=None, config="7", **acct,
+    )
+    line.update(cluster_line)
+    return {
+        "schema": "surrealdb-tpu-bench/6",
+        "scale": 0.1,
+        "configs": ["7"],
+        "results": [
+            line,
+            {"metric": "north_star", "value": None, "unit": "qps", "vs_baseline": None},
+        ],
+        "bundle": {
+            k: {} if k != "slow_queries" else []
+            for k in ("traces", "slow_queries", "errors", "tasks", "compiles", "engine")
+        },
+    }
+
+
+def _validate_doc(tmp_path, doc):
+    p = tmp_path / "art.json"
+    p.write_text(json.dumps(doc))
+    return cba.validate(str(p))
+
+
+def test_v6_cluster_line_requires_parity_and_real_sharding(tmp_path):
+    good = _min_v6_artifact(
+        {"cluster": {"nodes": 2, "per_node_rows": {"n1": 5, "n2": 7}, "parity": True}}
+    )
+    assert _validate_doc(tmp_path, good) == []
+
+    for bad_cluster, needle in [
+        (None, "missing 'cluster'"),
+        ({"nodes": 1, "per_node_rows": {"n1": 12}, "parity": True}, "nodes must be >= 2"),
+        ({"nodes": 2, "per_node_rows": {"n1": 12, "n2": 0}, "parity": True}, "not sharded"),
+        ({"nodes": 2, "per_node_rows": {"n1": 5, "n2": 7}, "parity": False}, "parity"),
+    ]:
+        doc = _min_v6_artifact({"cluster": bad_cluster} if bad_cluster else {})
+        if bad_cluster is None:
+            doc["results"][0].pop("cluster", None)
+        problems = _validate_doc(tmp_path, doc)
+        assert any(needle in p for p in problems), (needle, problems)
+
+
+def test_committed_r10_artifact_validates():
+    path = os.path.join(REPO, "bench_results_r10.json")
+    assert os.path.exists(path)
+    assert cba.validate(path) == []

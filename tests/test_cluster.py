@@ -1,0 +1,413 @@
+"""Cluster mode: sharded serving + scatter/gather executor (surrealdb_tpu/cluster/).
+
+The correctness contract under test: a 2–3 node cluster over one sharded
+dataset returns BYTE-IDENTICAL results to a single node holding the same
+data — for filtered scans, ORDER/LIMIT/GROUP pipelines, exact kNN top-k,
+two-phase BM25, and per-hop graph frontier exchange — plus the operational
+contracts: one request yields ONE span tree covering every serving node,
+and a dead shard owner degrades into a clear per-shard error instead of a
+hang.
+"""
+
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+import jax.numpy  # noqa: F401 — concurrent lazy first-import races otherwise
+
+from surrealdb_tpu import cluster as cluster_mod
+from surrealdb_tpu import cnf, tracing
+from surrealdb_tpu.cluster import ClusterConfig, HashRing, attach, load_config
+from surrealdb_tpu.cluster.placement import placement_key
+from surrealdb_tpu.dbs.session import Session
+from surrealdb_tpu.kvs.ds import Datastore
+from surrealdb_tpu.net.server import serve
+
+
+def ok(resp):
+    assert resp["status"] == "OK", resp
+    return resp["result"]
+
+
+# ------------------------------------------------------------------ harness
+class Cluster:
+    """N in-process nodes (each a full Datastore + HTTP server on an
+    ephemeral port) wired into one hash ring; `ref` is the single-node
+    twin every result is compared against."""
+
+    def __init__(self, n: int = 2, secret: str = "test-secret"):
+        self.servers = [
+            serve("memory", port=0, auth_enabled=False).start_background()
+            for _ in range(n)
+        ]
+        nodes = [
+            {"id": f"n{i + 1}", "url": srv.url}
+            for i, srv in enumerate(self.servers)
+        ]
+        self.datastores = [srv.httpd.RequestHandlerClass.ds for srv in self.servers]
+        for i, ds in enumerate(self.datastores):
+            attach(ds, ClusterConfig(nodes, f"n{i + 1}", secret=secret))
+        self.ref = Datastore("memory")
+        self.s = Session.owner("t", "t")
+
+    @property
+    def coord(self):
+        return self.datastores[0]
+
+    def both(self, sql, vars=None):
+        """Run on the single-node ref AND through the cluster coordinator;
+        assert byte-identical responses."""
+        a = self.ref.execute(sql, self.s, dict(vars) if vars else None)
+        b = self.coord.execute(sql, self.s, dict(vars) if vars else None)
+        assert [r["status"] for r in a] == [r["status"] for r in b], (sql, a, b)
+        assert [r["result"] for r in a] == [r["result"] for r in b], (sql, a, b)
+        return [r["result"] for r in b]
+
+    def both_unordered(self, sql, vars=None):
+        """Graph-expansion parity: edge ids are RANDOM per database, and
+        expansion order follows edge-id key order — so even two identical
+        single nodes order hops differently. Compare as multisets."""
+
+        def norm(v) -> str:
+            if isinstance(v, list):
+                return "[" + ",".join(sorted(norm(x) for x in v)) + "]"
+            if isinstance(v, dict):
+                return "{" + ",".join(f"{k}:{norm(x)}" for k, x in sorted(v.items())) + "}"
+            return repr(v)
+
+        a = self.ref.execute(sql, self.s, dict(vars) if vars else None)
+        b = self.coord.execute(sql, self.s, dict(vars) if vars else None)
+        assert [r["status"] for r in a] == [r["status"] for r in b], (sql, a, b)
+        for ra, rb in zip(a, b):
+            va, vb = ra["result"], rb["result"]
+            if isinstance(va, list) and isinstance(vb, list):
+                assert [norm(x) for x in va] == [norm(x) for x in vb], (sql, va, vb)
+            else:
+                assert norm(va) == norm(vb), (sql, va, vb)
+        return [r["result"] for r in b]
+
+    def close(self):
+        for srv in self.servers:
+            srv.shutdown()
+        for ds in self.datastores:
+            ds.close()
+        self.ref.close()
+
+
+@pytest.fixture()
+def cluster2():
+    c = Cluster(2)
+    yield c
+    c.close()
+
+
+@pytest.fixture()
+def cluster3():
+    c = Cluster(3)
+    yield c
+    c.close()
+
+
+def seed_people(c, n=24):
+    c.both("DEFINE TABLE person SCHEMALESS")
+    for i in range(n):
+        c.both(
+            f"CREATE person:{i} SET val = {i}, band = {i % 3}, "
+            f"name = 'p-{i:03d}'"
+        )
+
+
+# ------------------------------------------------------------------ placement
+def test_hash_ring_is_deterministic_and_spreads():
+    r1 = HashRing(["a", "b", "c"], vnodes=64)
+    r2 = HashRing(["a", "b", "c"], vnodes=64)
+    keys = [placement_key("t", i) for i in range(3000)]
+    assert [r1.owner_of_key(k) for k in keys] == [r2.owner_of_key(k) for k in keys]
+    spread = r1.spread(keys)
+    assert set(spread) == {"a", "b", "c"}
+    assert all(v > 300 for v in spread.values()), spread  # no starved node
+
+
+def test_config_validation(tmp_path):
+    with pytest.raises(Exception):
+        ClusterConfig([], "x")
+    with pytest.raises(Exception):
+        ClusterConfig([{"id": "a", "url": "http://h:1"}], "missing")
+    with pytest.raises(Exception):
+        ClusterConfig([{"id": "a", "url": "not-a-url"}], "a")
+    # multi-node without a shared secret = an unauthenticated system-
+    # privilege channel: refused outright
+    with pytest.raises(Exception, match="secret"):
+        ClusterConfig(
+            [{"id": "a", "url": "http://h:1"}, {"id": "b", "url": "http://h:2"}],
+            "a",
+        )
+    p = tmp_path / "topo.json"
+    p.write_text(
+        '{"nodes": [{"id": "a", "url": "http://h:1"},'
+        ' {"id": "b", "url": "http://h:2"}], "self": "a", "vnodes": 8,'
+        ' "secret": "k"}'
+    )
+    cfg = load_config(str(p))
+    assert cfg.node_id == "a" and cfg.peer_ids() == ["b"]
+    assert load_config(str(p), "b").node_id == "b"
+
+
+# ------------------------------------------------------------------ data plane
+def test_writes_shard_and_results_match_single_node(cluster2):
+    c = cluster2
+    seed_people(c, 24)
+    counts = []
+    for ds in c.datastores:
+        r = ok(ds.execute_local("SELECT count() FROM person GROUP ALL", c.s)[0])
+        counts.append(r[0]["count"] if r else 0)
+    assert sum(counts) == 24 and all(n > 0 for n in counts), counts
+
+    c.both("SELECT * FROM person WHERE val < 9")
+    c.both("SELECT name FROM person WHERE band = 1 ORDER BY val DESC LIMIT 4")
+    c.both("SELECT count() FROM person GROUP ALL")
+    c.both("SELECT band, count() AS n, math::sum(val) AS tot FROM person GROUP BY band")
+    c.both("SELECT VALUE name FROM person WHERE name CONTAINS '-01'")
+    c.both("SELECT * FROM person:3, person:17")
+    c.both("UPDATE person SET flag = true WHERE val > 20")
+    c.both("SELECT * FROM person WHERE flag = true")
+    c.both("DELETE person:5 RETURN BEFORE")
+    c.both("SELECT count() FROM person GROUP ALL")
+
+
+def test_exact_knn_topk_merges_identically(cluster3):
+    c = cluster3
+    c.both(
+        "DEFINE TABLE item SCHEMALESS; "
+        "DEFINE INDEX iemb ON item FIELDS emb MTREE DIMENSION 8"
+    )
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((90, 8)).astype(np.float32)
+    for i in range(90):
+        c.both(f"CREATE item:{i} SET emb = $v, flag = {'true' if i % 2 else 'false'}",
+               {"v": x[i].tolist()})
+    for qi in (3, 40, 77):
+        q = {"q": (x[qi] + 0.01).tolist()}
+        c.both("SELECT id FROM item WHERE emb <|7|> $q", q)
+        c.both(
+            "SELECT id, vector::distance::knn() AS d FROM item "
+            "WHERE emb <|5|> $q ORDER BY d",
+            q,
+        )
+        # residual WHERE: per-shard prefiltered top-k must merge identically
+        c.both("SELECT id FROM item WHERE emb <|6|> $q AND flag = true", q)
+
+
+def test_bm25_two_phase_scores_globally(cluster2):
+    c = cluster2
+    c.both(
+        "DEFINE TABLE doc SCHEMALESS; "
+        "DEFINE ANALYZER simple TOKENIZERS blank,class FILTERS lowercase; "
+        "DEFINE INDEX fbody ON doc FIELDS body SEARCH ANALYZER simple BM25"
+    )
+    words = ["alpha", "beta", "gamma", "delta", "eps", "zeta"]
+    rng = np.random.default_rng(4)
+    for i in range(40):
+        body = " ".join(words[int(w)] for w in rng.integers(0, 6, size=3 + i % 5))
+        c.both(f"CREATE doc:{i} SET body = $b", {"b": body})
+    c.both(
+        "SELECT id, search::score(1) AS sc FROM doc WHERE body @1@ 'alpha beta' "
+        "ORDER BY sc DESC LIMIT 8"
+    )
+    c.both("SELECT id FROM doc WHERE body @@ 'gamma'")
+    # a term nobody holds: empty on both
+    c.both("SELECT id FROM doc WHERE body @@ 'nonexistentterm'")
+
+
+def test_graph_frontier_exchange_per_hop(cluster2):
+    c = cluster2
+    c.both("DEFINE TABLE person SCHEMALESS; DEFINE TABLE knows SCHEMALESS")
+    for i in range(10):
+        c.both(f"CREATE person:{i}")
+    edges = [(0, 1), (0, 4), (1, 2), (2, 3), (4, 5), (5, 6), (1, 5), (6, 0)]
+    for f, t in edges:
+        # edge record ids are randomly generated per node — compare the
+        # RELATE acknowledgment shape, not the ids
+        c.both(f"RELATE person:{f}->knows->person:{t} RETURN NONE")
+    c.both_unordered("SELECT VALUE ->knows->person FROM person:0")
+    c.both_unordered("SELECT VALUE ->knows->person->knows->person FROM person:0")
+    c.both_unordered("SELECT ->knows->person AS friends FROM person:1")
+    c.both_unordered("SELECT VALUE ->knows->person FROM person")
+    c.both_unordered("SELECT VALUE <-knows<-person FROM person:5")
+
+
+def test_ddl_broadcast_and_unsupported_statements(cluster2):
+    c = cluster2
+    ok(c.coord.execute("DEFINE TABLE t SCHEMALESS", c.s)[0])
+    # the index definition must exist on EVERY member
+    for ds in c.datastores:
+        info = ok(ds.execute_local("INFO FOR DB", c.s)[0])
+        assert "t" in info["tables"], info
+    for sql in ("BEGIN", "LIVE SELECT * FROM t", "UPSERT t SET x = 1"):
+        r = c.coord.execute(sql, c.s)[0]
+        assert r["status"] == "ERR", (sql, r)
+        assert "not supported in cluster mode" in str(r["result"]) or "cluster" in str(
+            r["result"]
+        ), r
+
+
+def test_let_binds_across_scattered_statements(cluster2):
+    c = cluster2
+    seed_people(c, 12)
+    out = c.coord.execute(
+        "LET $cut = 6; SELECT VALUE val FROM person WHERE val < $cut", c.s
+    )
+    assert out[1]["status"] == "OK"
+    assert sorted(out[1]["result"]) == list(range(6))
+
+
+# ------------------------------------------------------------------ tracing
+def test_one_trace_spans_every_serving_node(cluster2):
+    c = cluster2
+    seed_people(c, 16)
+    tid = uuid.uuid4().hex
+    with tracing.request("test_client", trace_id=tid):
+        tracing.force_keep()
+        ok(c.coord.execute("SELECT * FROM person WHERE val >= 0", c.s)[0])
+    doc = tracing.get_trace(tid)
+    assert doc is not None
+    by_node = {}
+    for sp in doc["spans"]:
+        by_node.setdefault(sp["labels"].get("node"), []).append(sp["name"])
+    # remote spans grafted with node labels; the tree is ONE document
+    assert "n2" in by_node, sorted(by_node)
+    assert "execute" in by_node["n2"], by_node["n2"]
+    assert any(sp["name"] == "cluster_rpc" for sp in doc["spans"])
+    # grafted spans re-parent INSIDE this tree (no orphan roots)
+    ids = {sp["id"] for sp in doc["spans"]}
+    roots = [sp for sp in doc["spans"] if sp["parent"] is None]
+    assert len(roots) == 1, roots
+    assert all(sp["parent"] in ids for sp in doc["spans"] if sp["parent"] is not None)
+
+
+# ------------------------------------------------------------------ failure
+def test_node_down_is_a_clear_per_shard_error_not_a_hang(cluster2):
+    c = cluster2
+    seed_people(c, 12)
+    saved = cnf.CLUSTER_RPC_TIMEOUT_SECS
+    cnf.CLUSTER_RPC_TIMEOUT_SECS = 2.0
+    try:
+        c.servers[1].shutdown()
+        time.sleep(0.1)
+        t0 = time.perf_counter()
+        r = c.coord.execute("SELECT * FROM person WHERE val >= 0", c.s)[0]
+        dt = time.perf_counter() - t0
+        assert r["status"] == "ERR", r
+        assert "n2" in str(r["result"]) and "unavailable" in str(r["result"]), r
+        assert dt < 10.0, f"node-down query took {dt:.1f}s — hang, not an error"
+        # statements that touch only live shards keep working
+        live_owner_rows = ok(
+            c.coord.ds.execute_local("SELECT VALUE id FROM person", c.s)[0]
+            if hasattr(c.coord, "ds")
+            else c.datastores[0].execute_local("SELECT VALUE id FROM person", c.s)[0]
+        )
+        assert isinstance(live_owner_rows, list)
+    finally:
+        cnf.CLUSTER_RPC_TIMEOUT_SECS = saved
+
+
+def test_cluster_channel_requires_secret(cluster2):
+    import http.client
+    from urllib.parse import urlparse
+
+    from surrealdb_tpu.rpc import cbor as _cbor
+
+    u = urlparse(cluster2.servers[0].url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=5)
+    try:
+        conn.request(
+            "POST", "/cluster", body=_cbor.encode({"op": "ping"}),
+            headers={"Content-Type": "application/cbor", "Connection": "close"},
+        )
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 401
+    finally:
+        conn.close()
+
+
+def test_non_cluster_node_hides_the_channel():
+    srv = serve("memory", port=0, auth_enabled=False).start_background()
+    import http.client
+    from urllib.parse import urlparse
+
+    from surrealdb_tpu.rpc import cbor as _cbor
+
+    try:
+        u = urlparse(srv.url)
+        conn = http.client.HTTPConnection(u.hostname, u.port, timeout=5)
+        try:
+            conn.request(
+                "POST", "/cluster", body=_cbor.encode({"op": "ping"}),
+                headers={"Content-Type": "application/cbor", "Connection": "close"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 404
+        finally:
+            conn.close()
+    finally:
+        srv.shutdown()
+        srv.httpd.RequestHandlerClass.ds.close()
+
+
+# ------------------------------------------------------------------ review fixes (r10)
+def test_partial_shard_answers_are_refused_not_wrong(cluster2):
+    """Shapes whose scattered evaluation would read PARTIAL per-shard data
+    must error clearly — never return a silently-wrong merge."""
+    c = cluster2
+    seed_people(c, 12)
+    c.both("DEFINE TABLE vip SCHEMALESS")
+    for i in (1, 4, 7):
+        c.both(f"CREATE vip:{i} SET n = {i}")
+    c.both("DEFINE TABLE post SCHEMALESS; DEFINE TABLE likes SCHEMALESS")
+    bad = [
+        # subquery in WHERE: per-shard membership sets
+        "SELECT VALUE val FROM person WHERE val IN (SELECT VALUE n FROM vip)",
+        # subquery in LET / RETURN: coordinator-shard-only data
+        "LET $c = (SELECT count() FROM person GROUP ALL)",
+        "RETURN (SELECT count() FROM person GROUP ALL)",
+        # GROUP over a graph projection: per-shard partial aggregates
+        "SELECT count(->likes->post) AS c FROM person GROUP ALL",
+        # subquery in the projection: per-shard inner SELECT
+        "SELECT (SELECT count() FROM vip GROUP ALL) AS c FROM person",
+        # subquery in a WRITE's WHERE/data: per-shard membership sets
+        "UPDATE person SET hot = true WHERE val IN (SELECT VALUE n FROM vip)",
+        "DELETE person WHERE val IN (SELECT VALUE n FROM vip)",
+        "CREATE person:99 SET c = (SELECT count() FROM vip GROUP ALL)",
+        # inbound graph traversal: pointer keys live on OTHER shards
+        "SELECT VALUE id FROM person WHERE <-likes<-person CONTAINS person:0",
+        "SELECT id, <-likes<-person AS followers FROM person",
+    ]
+    for sql in bad:
+        r = c.coord.execute(sql, c.s)[0]
+        assert r["status"] == "ERR", (sql, r)
+        assert "not supported in cluster mode" in str(r["result"]), (sql, r)
+
+
+def test_insert_ignore_keeps_single_node_row_order(cluster2):
+    """IGNORE makes an owner's output SHORTER than its input; the
+    reassembly must still match single-node order (id-keyed alignment,
+    not positional zip)."""
+    c = cluster2
+    c.both("DEFINE TABLE t SCHEMALESS; DEFINE INDEX uid ON t FIELDS u UNIQUE")
+    c.both("CREATE t:5 SET u = 5")
+    rows = [{"id": i, "u": i} for i in (5, 1, 2, 7, 3, 9)]
+    c.both("INSERT IGNORE INTO t $rows", {"rows": rows})
+
+
+def test_multi_table_update_keeps_from_source_order(cluster2):
+    c = cluster2
+    c.both("DEFINE TABLE a SCHEMALESS; DEFINE TABLE b SCHEMALESS")
+    for i in range(8):
+        c.both(f"CREATE a:{i} SET v = {i}")
+        c.both(f"CREATE b:{i} SET v = {i}")
+    # single node returns a's rows then b's; the broadcast merge must too
+    c.both("UPDATE b, a SET touched = true WHERE v < 5")

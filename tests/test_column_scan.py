@@ -445,3 +445,67 @@ def test_columnar_count_matches_row_path_on_things(ds):
     ok(ds.execute("INSERT INTO t $rows", vars={"rows": rows})[-1])
     col, row = both_paths(ds, "SELECT VALUE id FROM t WHERE ref = x:1 AND a < 4")
     assert [str(x) for x in col] == [str(x) for x in row]
+
+
+# ------------------------------------------------------------------ widened fragment (r10)
+def test_datetime_constants_lower_exactly(ds):
+    """Datetime comparisons lower onto the int64 nanos plane — exact even
+    where f64 loses nanosecond precision (epoch nanos >> 2^53)."""
+    ds.execute("DEFINE TABLE ev SCHEMALESS")
+    rows = [
+        {"id": i, "ts_txt": f"2024-03-{1 + i % 27:02d}T10:00:00Z", "n": i}
+        for i in range(80)
+    ]
+    for r in rows:
+        ok(ds.execute(f"CREATE ev:{r['id']} SET ts = d'{r['ts_txt']}', n = {r['n']}")[-1])
+    # mixed rows stay exact via needs_row
+    ok(ds.execute("CREATE ev:900 SET ts = [1,2]; CREATE ev:901 SET n = -1")[-1])
+    for sql in (
+        "SELECT VALUE id FROM ev WHERE ts > d'2024-03-15T00:00:00Z'",
+        "SELECT VALUE id FROM ev WHERE ts = d'2024-03-01T10:00:00Z'",
+        "SELECT VALUE id FROM ev WHERE ts <= d'2024-03-04T10:00:00Z' AND n > 10",
+        "SELECT VALUE id FROM ev WHERE ts != NONE",
+        "SELECT VALUE id FROM ev WHERE ts",  # truthy(datetime) is True
+    ):
+        col, row = both_paths(ds, sql)
+        assert col == row, sql
+    plan = ok(ds.execute("SELECT * FROM ev WHERE ts > d'2024-03-15T00:00:00Z' EXPLAIN")[-1])
+    assert plan[0]["detail"]["plan"]["strategy"] == "columnar-scan"
+
+
+def test_datetime_nanos_precision_on_the_int64_plane(ds):
+    """Two datetimes 1ns apart MUST compare distinct (f64 nanos would tie)."""
+    ds.execute("DEFINE TABLE tick SCHEMALESS")
+    ok(ds.execute(
+        "CREATE tick:1 SET ts = d'2024-01-01T00:00:00.000000001Z';"
+        "CREATE tick:2 SET ts = d'2024-01-01T00:00:00.000000002Z';"
+        "CREATE tick:3 SET ts = d'2024-01-01T00:00:00.000000002Z';"
+        # padding so the table crosses the mirror floor
+        + "".join(f"CREATE tick:{i} SET ts = d'2024-01-02T00:00:00Z';" for i in range(4, 12))
+    )[-1])
+    sql = "SELECT VALUE id FROM tick WHERE ts = d'2024-01-01T00:00:00.000000002Z'"
+    col, row = both_paths(ds, sql)
+    assert col == row == [Thing("tick", 2), Thing("tick", 3)]
+
+
+def test_contains_on_string_columns_lowers(ds):
+    ds.execute("DEFINE TABLE s SCHEMALESS")
+    rows = [
+        {"id": i, "name": f"item-{'xy' if i % 3 else 'qz'}-{i}"} for i in range(60)
+    ]
+    ok(ds.execute("INSERT INTO s $rows", vars={"rows": rows})[-1])
+    # type-mixed cells: arrays/numbers must keep row-path semantics exactly
+    ok(ds.execute("CREATE s:800 SET name = ['qz']; CREATE s:801 SET name = 7")[-1])
+    for sql in (
+        "SELECT VALUE id FROM s WHERE name CONTAINS 'qz'",
+        "SELECT VALUE id FROM s WHERE name CONTAINSNOT 'xy'",
+        "SELECT VALUE id FROM s WHERE name CONTAINS '-1' AND name CONTAINS 'xy'",
+        "SELECT VALUE id FROM s WHERE name CONTAINS ''",
+    ):
+        col, row = both_paths(ds, sql)
+        assert col == row, sql
+    plan = ok(ds.execute("SELECT * FROM s WHERE name CONTAINS 'qz' EXPLAIN")[-1])
+    assert plan[0]["detail"]["plan"]["strategy"] == "columnar-scan"
+    # a non-string needle refuses to lower (row path, same answer)
+    col, row = both_paths(ds, "SELECT VALUE id FROM s WHERE name CONTAINS 3")
+    assert col == row

@@ -25,7 +25,7 @@ def rule_ids(findings):
 
 
 # ------------------------------------------------------------------ per rule
-@pytest.mark.parametrize("rule", ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006"])
+@pytest.mark.parametrize("rule", ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"])
 def test_rule_fires_on_bad_fixture_and_not_on_clean(rule):
     bad = lint(f"{rule.lower()}_bad.py", rules=[rule])
     assert rule in rule_ids(bad), f"{rule} failed to fire on its fixture"
@@ -56,6 +56,13 @@ def test_gl006_distinguishes_dynamic_name_and_labelset():
     assert any("DYNAMIC metric name" in m for m in msgs)
     assert any("inconsistent label sets" in m for m in msgs)
     assert any("'sql'" in m for m in msgs)
+
+
+def test_gl007_matching_name_and_span_only_functions_pass():
+    keys = {f.key for f in lint("gl007_bad.py", rules=["GL007"])}
+    assert any(k.endswith(":fixture_probe_span") for k in keys)
+    assert any(k.endswith(":fixture_other") for k in keys)
+    assert lint("gl007_clean.py", rules=["GL007"]) == []
 
 
 def test_suppression_comment_silences_a_finding(tmp_path):
@@ -112,11 +119,12 @@ def test_cli_exit_codes():
             os.path.join(FIXTURES, "gl004_bad.py"),
             os.path.join(FIXTURES, "gl005_bad.py"),
             os.path.join(FIXTURES, "gl006_bad.py"),
+            os.path.join(FIXTURES, "gl007_bad.py"),
         ],
         cwd=REPO, capture_output=True, text=True, env=env,
     )
     assert bad.returncode == 1, bad.stdout + bad.stderr
-    for rule in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006"):
+    for rule in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"):
         assert rule in bad.stdout, f"{rule} missing from CLI output"
     # --update-baseline refuses a restricted scope (it would silently drop
     # every grandfathered entry the restricted run can't see)
@@ -133,7 +141,7 @@ def test_cli_exit_codes():
 
 def test_every_rule_has_doc_and_registration():
     assert set(rules_mod.RULES) == {
-        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
+        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
     }
     for rid, (fn, doc) in rules_mod.RULES.items():
         assert callable(fn) and doc

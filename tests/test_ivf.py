@@ -195,3 +195,54 @@ def test_mtree_exact_contract(ds, monkeypatch):
     d = ((vecs - q) ** 2).sum(axis=1)
     want = set(np.argsort(d)[:10].tolist())
     assert set(got) == want, (sorted(got), sorted(want))
+
+
+def test_ivf_strategies_consume_columnar_prefilter(ds, monkeypatch):
+    """r10 carried item: the `ivf` (device kernel) and `ivf-host` kNN
+    strategies consume the columnar residual-WHERE mask — top-k computed
+    among MATCHING rows, not post-filtered below k."""
+    import numpy as np
+    from surrealdb_tpu import cnf, telemetry
+    from surrealdb_tpu.dbs.session import Session
+
+    monkeypatch.setattr(cnf, "TPU_ANN_MIN_ROWS", 64)
+    # keep the test off the MESH branch (the suite runs on a virtual
+    # 8-device mesh; ivf-sharded still post-filters — see ROADMAP)
+    monkeypatch.setattr(cnf, "TPU_KNN_ONDEVICE_THRESHOLD", 1 << 60)
+    monkeypatch.setattr(cnf, "COLUMN_MIRROR_MIN_ROWS", 4)
+
+    s = Session.owner()
+    s.ns, s.db = "test", "test"
+    rng = np.random.default_rng(5)
+    vecs = rng.standard_normal((400, 8)).astype(np.float32)
+    ds.execute(
+        "DEFINE TABLE item SCHEMALESS; "
+        "DEFINE INDEX iv ON item FIELDS emb HNSW DIMENSION 8 DIST EUCLIDEAN;", s)
+    ds.execute("INSERT INTO item $rows", s, vars={
+        "rows": [
+            {"id": i, "emb": vecs[i].tolist(), "flag": bool(i % 2)}
+            for i in range(400)
+        ]})
+    q = {"q": (vecs[31] + 0.01).tolist()}
+    sql = "SELECT id FROM item WHERE emb <|8,80|> $q AND flag = true"
+
+    # build mirror + train quantizer (wait_ivf = deterministic)
+    ds.execute("SELECT id FROM item WHERE emb <|4,16|> $q", s, vars=dict(q))
+    mirror = ds.index_stores.get("test", "test", "item", "iv")
+    assert mirror.wait_ivf(60)
+
+    def run_and_check(expected_strategy):
+        out = ds.execute(sql, s, vars=dict(q))
+        rows = out[-1]["result"]
+        ids = [int(str(r["id"]).split(":")[1]) for r in rows]
+        # every result matches the residual WHERE, and the probe found a
+        # full k among matching rows (post-filter would thin this out)
+        assert all(i % 2 for i in ids), ids
+        assert len(ids) == 8, ids
+        assert telemetry.get_counter("knn_strategy", strategy=expected_strategy) > 0
+
+    applied0 = telemetry.get_counter("knn_prefilter", outcome="applied")
+    run_and_check("ivf")  # device kernel path
+    monkeypatch.setattr(cnf, "TPU_DISABLE", True)
+    run_and_check("ivf-host")  # numpy probe+rerank twin
+    assert telemetry.get_counter("knn_prefilter", outcome="applied") >= applied0 + 2
