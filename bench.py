@@ -70,13 +70,19 @@ OUT_PATH = os.environ.get(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), f"bench_results_{ROUND}.json"),
 )
 PROFILE = "--profile" in sys.argv[1:] or os.environ.get("SURREAL_PROFILE") == "1"
-# schema/6 (r10, cluster mode): new config 7 — a 2-node in-process cluster
-# (surrealdb_tpu/cluster/) serving the same sharded dataset; its line
-# carries a `cluster` object (node count, per-node row spread, merged-
-# result parity vs a single node for WHERE/kNN/BM25, and the node ids a
-# single request's span tree covered). Everything schema/5 carried
-# (bg_tasks/compiles accounting + the embedded debug bundle) stays.
-SCHEMA = "surrealdb-tpu-bench/6"
+# schema/7 (r11, ingest pipeline v2): every config line carries
+# `ingest_rate_rows_s` — the CUMULATIVE bulk-load rows/sec through
+# ds.execute() across every ingest the run performed up to that config
+# (rows pre-built; the engine path is what is measured; one shared corpus
+# feeds several configs, so the rate is run-cumulative by construction) —
+# so ingest regressions can't hide in setup time. Config 6
+# additionally carries an `ingest` object: the SUSTAINED mirrored-table
+# phase (bulk op + immediately-serving columnar query per round) measured
+# with the delta feed off (the r10 re-scan semantics) and on, with the
+# ratio and a zero-staleness parity flag. Config 7's cluster object gains
+# ingest fields (rate + routed-bulk-path proof). Everything schema/6
+# carried stays.
+SCHEMA = "surrealdb-tpu-bench/7"
 
 D = 768
 NI = max(int(1_000_000 * SCALE), 1024)  # item corpus (configs 2/4/5)
@@ -389,23 +395,51 @@ def gen_corpus(n, d, seed=42):
 
 
 # ------------------------------------------------------------------ ingest
+# process-wide bulk-load accounting behind every config's
+# `ingest_rate_rows_s` line: rows/sec THROUGH ds.execute() — row payloads
+# are pre-built outside the timed window so the engine path is what is
+# measured, and regressions can't hide in setup time
+_INGEST = {"rows": 0, "secs": 0.0}
+
+
+def ingest_run(ds, s, sql, batches):
+    """Run one bulk statement per batch. Each batch's rows materialize
+    BEFORE its timed window (payload building is client work), and only
+    the execute() is accounted — memory stays bounded at one batch."""
+    n = 0
+    for rows in batches:
+        rows = list(rows)
+        t0 = time.perf_counter()
+        run(ds, s, sql, {"rows": rows})
+        _INGEST["secs"] += time.perf_counter() - t0
+        n += len(rows)
+    _INGEST["rows"] += n
+    return n
+
+
+def ingest_rate():
+    return round(_INGEST["rows"] / _INGEST["secs"], 1) if _INGEST["secs"] else None
+
+
 def ingest_person_graph(ds, s, rng):
     log(f"ingest person graph: {NP_NODES} nodes, {NE} edges")
     run(ds, s, "DEFINE TABLE person SCHEMALESS; DEFINE TABLE knows SCHEMALESS")
     B = 25000
-    for i in range(0, NP_NODES, B):
-        rows = [{"id": j} for j in range(i, min(i + B, NP_NODES))]
-        run(ds, s, "INSERT INTO person $rows", {"rows": rows})
+    ingest_run(
+        ds, s, "INSERT INTO person $rows RETURN NONE",
+        ([{"id": j} for j in range(i, min(i + B, NP_NODES))]
+         for i in range(0, NP_NODES, B)),
+    )
     from surrealdb_tpu.sql.value import Thing
 
     pairs = rng.integers(0, NP_NODES, size=(NE, 2))
-    for i in range(0, NE, B):
-        rows = [
-            {"in": Thing("person", int(a)), "out": Thing("person", int(b))}
-            for a, b in pairs[i : i + B]
-        ]
-        run(ds, s, "INSERT RELATION INTO knows $rows", {"rows": rows})
-    log("person graph done")
+    ingest_run(
+        ds, s, "INSERT RELATION INTO knows $rows RETURN NONE",
+        ([{"in": Thing("person", int(a)), "out": Thing("person", int(b))}
+          for a, b in pairs[i : i + B]]
+         for i in range(0, NE, B)),
+    )
+    log(f"person graph done ({ingest_rate()} rows/s cumulative)")
 
 
 def ingest_items(ds, s, corpus):
@@ -419,10 +453,13 @@ def ingest_items(ds, s, corpus):
     B = 20000
     for i in range(0, NI, B):
         ids = range(i, min(i + B, NI))
-        run(ds, s, "INSERT INTO item $rows", {"rows": vec_rows(corpus[i : i + B], ids, flag_every=4)})
+        ingest_run(
+            ds, s, "INSERT INTO item $rows RETURN NONE",
+            [vec_rows(corpus[i : i + B], ids, flag_every=4)],
+        )
         if i and i % 200_000 == 0:
             log(f"  items {i}/{NI}")
-    log("items done")
+    log(f"items done ({ingest_rate()} rows/s cumulative)")
 
 
 def ingest_hybrid_edges(ds, s, rng):
@@ -434,12 +471,12 @@ def ingest_hybrid_edges(ds, s, rng):
     B = 25000
     srcs = np.repeat(np.arange(EH_REGION), EH_DEG)
     dsts = rng.integers(0, EH_REGION, size=n_edges)
-    for i in range(0, n_edges, B):
-        rows = [
-            {"in": Thing("item", int(a)), "out": Thing("item", int(b))}
-            for a, b in zip(srcs[i : i + B], dsts[i : i + B])
-        ]
-        run(ds, s, "INSERT RELATION INTO rel $rows", {"rows": rows})
+    ingest_run(
+        ds, s, "INSERT RELATION INTO rel $rows RETURN NONE",
+        ([{"in": Thing("item", int(a)), "out": Thing("item", int(b))}
+          for a, b in zip(srcs[i : i + B], dsts[i : i + B])]
+         for i in range(0, n_edges, B)),
+    )
     log("hybrid edges done")
 
 
@@ -468,13 +505,13 @@ def ingest_docs(ds, s, rng):
     for i in range(0, ND, B):
         n = min(B, ND - i)
         words = vocab[rng.choice(VOCAB_N, size=(n, L), p=p)]
-        rows = [
-            {"id": int(i + j), "body": " ".join(words[j])} for j in range(n)
-        ]
-        run(ds, s, "INSERT INTO doc $rows", {"rows": rows})
+        ingest_run(
+            ds, s, "INSERT INTO doc $rows RETURN NONE",
+            [[{"id": int(i + j), "body": " ".join(words[j])} for j in range(n)]],
+        )
         if i and i % 200_000 == 0:
             log(f"  docs {i}/{ND}")
-    log("docs done")
+    log(f"docs done ({ingest_rate()} rows/s cumulative)")
 
 
 # ------------------------------------------------------------------ configs
@@ -908,6 +945,56 @@ def bench_filtered_scan(ds, s):
     cnt = run(ds, s, csql)[-1]["result"]
     count_ms = (time.perf_counter() - t0) * 1e3
 
+    # ---- sustained mirrored-table ingest (the v2 delta-feed headline):
+    # rounds of (bulk INSERT + immediately-serving columnar SELECT) against
+    # the LIVE mirror, measured with the delta feed OFF (r10 semantics:
+    # every bulk op arms a full re-scan rebuild and the next query falls to
+    # the row path) and ON (the delta applies at commit and the very next
+    # query serves columnar). Parity is asserted every round against the
+    # row path — a stale mask serving would fail loudly here.
+    def sustained(delta_on, base):
+        saved = _cnf.COLUMN_DELTA_FEED
+        _cnf.COLUMN_DELTA_FEED = delta_on
+        # batch size ~NI/40 keeps the phase query-proportional (a serving
+        # table ingesting steadily), so the mirror effect is what's
+        # measured rather than raw insert cost
+        B, rounds = max(NI // 40, 256), 4
+        q = "SELECT VALUE id FROM item WHERE flag = true AND val < 10"
+        parity_fails = 0
+        try:
+            # start each phase from a CURRENT mirror (the r10 phase leaves
+            # it stale behind its debounced rebuild window)
+            ds.column_mirrors.wait_rebuild()
+            ds.column_mirrors.build(ds, s.ns, s.db, "item")
+            total, dt = 0, 0.0
+            for rnd in range(rounds):
+                rows = [
+                    {"id": base + rnd * B + j, "val": 5, "flag": j % 2 == 0}
+                    for j in range(B)
+                ]
+                t0 = time.perf_counter()
+                run(ds, s, "INSERT INTO item $rows RETURN NONE", {"rows": rows})
+                got = ids(run(ds, s, q)[-1]["result"])
+                dt += time.perf_counter() - t0
+                total += B
+                # EVERY round checks the immediately-serving result against
+                # the row path (outside the timed window): a stale mask
+                # serving any round is a parity failure, not a slow round
+                _cnf.COLUMN_MIRROR = False
+                want = ids(run(ds, s, q)[-1]["result"])
+                _cnf.COLUMN_MIRROR = saved_mirror
+                if got != want:
+                    parity_fails += 1
+            return total / dt, parity_fails
+        finally:
+            _cnf.COLUMN_DELTA_FEED = saved
+    r10_rate, pf0 = sustained(False, 10_000_000)
+    v2_rate, pf1 = sustained(True, 20_000_000)
+    ds.column_mirrors.wait_rebuild()  # r10-mode armed rebuilds, settle them
+    # the sustained rows stay: they carry no `emb`, so every kNN-driven
+    # config is blind to them, and config 6's own metrics ran above
+    sustained_ratio = round(v2_rate / r10_rate, 2) if r10_rate else None
+
     ratio = col_qps / row_qps if row_qps else None
     emit(
         {
@@ -921,6 +1008,12 @@ def bench_filtered_scan(ds, s):
             "rows_matched": len(ids(col_results[0])),
             "count_only_ms": round(count_ms, 2),
             "count_result": cnt[0]["count"] if cnt else 0,
+            "ingest": {
+                "sustained_rows_s": round(v2_rate, 1),
+                "r10_rows_s": round(r10_rate, 1),
+                "delta_vs_r10": sustained_ratio,
+                "parity_failures": pf0 + pf1,
+            },
         }
     )
     return ratio
@@ -964,6 +1057,9 @@ def bench_cluster(rng):
         corpus = rng.standard_normal((n, d)).astype(np.float32)
         vals = rng.random(n)
         vocab = [f"w{i}" for i in range(60)]
+        from surrealdb_tpu import telemetry as _tm
+
+        bulk_rows0 = sum(_tm.counters_matching("bulk_insert_rows").values())
         t0 = time.perf_counter()
         for lo in range(0, n, 512):
             hi = min(lo + 512, n)
@@ -990,6 +1086,13 @@ def bench_cluster(rng):
                 ]})
                 assert r[0]["status"] == "OK", r
         ingest_s = time.perf_counter() - t0
+        # routed-bulk proof: the coordinator's owner-grouped batches must
+        # execute through try_bulk_insert ON THE REMOTE NODE (in-process
+        # nodes share the telemetry registry): ref wrote 2n rows bulk and
+        # the cluster's two nodes wrote 2n more — anything less means a
+        # shard fell back to the per-row pipeline
+        bulk_rows = sum(_tm.counters_matching("bulk_insert_rows").values()) - bulk_rows0
+        ingest_parity = bulk_rows >= 4 * n
         spread = {}
         for name, node_ds in (("n1", ds1), ("n2", ds2)):
             c = node_ds.execute_local("SELECT count() FROM item GROUP ALL", s)
@@ -1049,16 +1152,24 @@ def bench_cluster(rng):
                 "single_node_qps": round(single_qps, 2),
                 "scale_ratio": round(cl_qps / single_qps, 3) if single_qps else None,
                 "ingest_s": round(ingest_s, 2),
+                # the cluster ingest's own rate (2 tables x n rows through
+                # the coordinator + the single-node twin, one window)
+                "ingest_rate_rows_s": round(4 * n / ingest_s, 1) if ingest_s else None,
                 "cluster": {
                     "nodes": len(nodes),
                     "per_node_rows": spread,
                     "parity": all(parity.values()),
                     "parity_detail": parity,
                     "trace_nodes": trace_nodes,
+                    "ingest_bulk_path": ingest_parity,
+                    "ingest_bulk_rows": int(bulk_rows),
                 },
             }
         )
         assert all(parity.values()), f"cluster parity broken: {parity}"
+        assert ingest_parity, (
+            f"cluster ingest fell off the bulk path: {bulk_rows} < {4 * n}"
+        )
     finally:
         srv1.shutdown()
         srv2.shutdown()
@@ -1195,6 +1306,10 @@ def main() -> None:
                 )
             for i, line in enumerate(RESULTS[n0:]):
                 line["config"] = cfg
+                # run-cumulative bulk-load throughput up to this config
+                # (schema/7): the gate floors it so ingest regressions
+                # can't hide in setup time
+                line.setdefault("ingest_rate_rows_s", ingest_rate())
                 line.update(acct)
                 if i > 0:
                     # the span tree is per-CONFIG evidence: carry it once,

@@ -28,6 +28,15 @@ Env knobs:
                                    (default 20.0)
     SURREAL_BENCH_GATE_SCAN_RATIO  columnar vs row-path speedup floor
                                    (default 5.0 — the ISSUE 4 acceptance bar)
+    SURREAL_BENCH_GATE_INGEST_FLOOR  bulk-load ingest_rate_rows_s floor
+                                   (run-cumulative engine-path rate;
+                                   default 5000.0 — half the ~11-13k rows/s
+                                   the 2-core CI container sustains on the
+                                   vector-indexed item corpus)
+    SURREAL_BENCH_GATE_INGEST_RATIO  sustained mirrored-table delta-feed vs
+                                   r10-rescan speedup floor (default 5.0 —
+                                   the ISSUE 8 acceptance bar; measured
+                                   ~20-30x at smoke scale)
     SURREAL_BENCH_GATE_TIMEOUT     whole-run timeout seconds (default 1200)
 
 Exit code 0 = gate passed; 1 = gate failed (reasons on stderr).
@@ -49,6 +58,8 @@ FLOOR_QPS = float(os.environ.get("SURREAL_BENCH_GATE_FLOOR", "3.0"))
 FLOOR_RECALL = float(os.environ.get("SURREAL_BENCH_GATE_RECALL", "0.6"))
 FLOOR_SCAN_QPS = float(os.environ.get("SURREAL_BENCH_GATE_SCAN_FLOOR", "20.0"))
 FLOOR_SCAN_RATIO = float(os.environ.get("SURREAL_BENCH_GATE_SCAN_RATIO", "5.0"))
+FLOOR_INGEST = float(os.environ.get("SURREAL_BENCH_GATE_INGEST_FLOOR", "5000.0"))
+FLOOR_INGEST_RATIO = float(os.environ.get("SURREAL_BENCH_GATE_INGEST_RATIO", "5.0"))
 TIMEOUT = int(os.environ.get("SURREAL_BENCH_GATE_TIMEOUT", "1200"))
 
 
@@ -160,6 +171,31 @@ def main() -> int:
             "scan": scan_line.get("scan"),
         }
 
+    # ---- ingest floors (schema/7): bulk-load rate on every config line,
+    # plus the sustained mirrored-table delta-feed ratio on config 6 -----
+    ingest_summary = None
+    for r in art["results"]:
+        rate = r.get("ingest_rate_rows_s")
+        if r.get("config") is not None and isinstance(rate, (int, float)):
+            if rate < FLOOR_INGEST:
+                failures.append(
+                    f"config {r['config']} ingest_rate_rows_s {rate} < "
+                    f"floor {FLOOR_INGEST}"
+                )
+    if scan_line is not None:
+        ing = scan_line.get("ingest") or {}
+        ingest_summary = ing
+        iratio = ing.get("delta_vs_r10")
+        if iratio is None or iratio < FLOOR_INGEST_RATIO:
+            failures.append(
+                f"sustained mirrored-table ingest delta_vs_r10 {iratio} < "
+                f"floor {FLOOR_INGEST_RATIO}x"
+            )
+        if ing.get("parity_failures") != 0:
+            failures.append(
+                f"sustained ingest parity failures: {ing.get('parity_failures')}"
+            )
+
     summary = {
         "qps": qps,
         "recall_at_10": recall,
@@ -169,6 +205,8 @@ def main() -> int:
         "splits": line.get("splits"),
         "width_dist": (line.get("batch") or {}).get("width_dist"),
         "filtered_scan": scan_summary,
+        "ingest_rate_rows_s": line.get("ingest_rate_rows_s"),
+        "ingest": ingest_summary,
         "artifact": out,
     }
     print(f"bench_gate: {json.dumps(summary)}")

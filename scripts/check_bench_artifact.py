@@ -23,7 +23,7 @@ import os
 import sys
 from typing import List
 
-SCHEMA = "surrealdb-tpu-bench/6"
+SCHEMA = "surrealdb-tpu-bench/7"
 # earlier rounds' committed artifacts stay validatable under their own rules
 KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/1",
@@ -31,6 +31,7 @@ KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/3",
     "surrealdb-tpu-bench/4",
     "surrealdb-tpu-bench/5",
+    "surrealdb-tpu-bench/6",
     SCHEMA,
 )
 
@@ -61,6 +62,11 @@ CONFIG_KEYS_V5 = CONFIG_KEYS_V4 + ("bg_tasks", "compiles")
 # spread) and CORRECT (merged-result parity vs a single node; parity false
 # means the scatter/gather merge diverged — an invalid artifact)
 CLUSTER_KEYS = ("nodes", "per_node_rows", "parity")
+# schema/7 (ingest pipeline v2): every config line carries the bulk-load
+# throughput behind it; the filtered-scan line's `ingest` object proves the
+# sustained mirrored-table phase ran delta-fed with ZERO staleness parity
+# failures (a stale mask serving is an invalid artifact, not a slow one)
+INGEST_KEYS = ("sustained_rows_s", "r10_rows_s", "delta_vs_r10", "parity_failures")
 BUNDLE_SECTIONS = ("traces", "slow_queries", "errors", "tasks", "compiles", "engine")
 COMPILES_KEYS = ("on_demand", "prewarm", "events")
 BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
@@ -85,7 +91,8 @@ def validate(path: str) -> List[str]:
     if art.get("schema") not in KNOWN_SCHEMAS:
         problems.append(f"schema is {art.get('schema')!r}, expected one of {KNOWN_SCHEMAS}")
     schema = art.get("schema")
-    v6 = schema == SCHEMA
+    v7 = schema == SCHEMA
+    v6 = v7 or schema == "surrealdb-tpu-bench/6"
     v5 = v6 or schema == "surrealdb-tpu-bench/5"
     v4 = v5 or schema == "surrealdb-tpu-bench/4"
     v3 = v4 or schema == "surrealdb-tpu-bench/3"
@@ -190,6 +197,35 @@ def validate(path: str) -> List[str]:
                         f"{where} ({metric}): cluster.parity must be true "
                         "(merged results diverged from the single-node run)"
                     )
+        if v7:
+            rate = r.get("ingest_rate_rows_s")
+            if not isinstance(rate, (int, float)) or rate <= 0:
+                problems.append(
+                    f"{where} ({metric}): schema/7 requires a positive "
+                    "ingest_rate_rows_s on every config line"
+                )
+        if v7 and metric.startswith("filtered_scan"):
+            ing = r.get("ingest")
+            if not isinstance(ing, dict):
+                problems.append(
+                    f"{where} ({metric}): missing the sustained 'ingest' object"
+                )
+            else:
+                for key in INGEST_KEYS:
+                    if key not in ing:
+                        problems.append(f"{where} ({metric}): ingest missing {key!r}")
+                if ing.get("parity_failures") not in (0,):
+                    problems.append(
+                        f"{where} ({metric}): ingest.parity_failures must be 0 "
+                        "(a delta-fed mirror served a stale mask)"
+                    )
+        if v7 and metric.startswith("cluster_"):
+            cl = r.get("cluster")
+            if isinstance(cl, dict) and cl.get("ingest_bulk_path") is not True:
+                problems.append(
+                    f"{where} ({metric}): cluster.ingest_bulk_path must be true "
+                    "(a shard's INSERT fell back to the per-row pipeline)"
+                )
         if v4 and metric.startswith("filtered_scan"):
             for key in FILTERED_SCAN_KEYS:
                 if key not in r:

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Full-suite concurrency-sanitizer gate: the ENTIRE tier-1 suite under
+# SURREAL_SANITIZE=1 (instrumented locks record the acquisition graph),
+# then the static lock-order cross-check against utils/locks.HIERARCHY.
+# Mines edges the tier1.sh smoke subset cannot reach — the group-commit
+# flusher, column-mirror delta applies, cluster pumps under load.
+# Thin entry point for `scripts/tier1.sh --sanitize-full`.
+exec "$(dirname "$0")/tier1.sh" --sanitize-full

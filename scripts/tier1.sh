@@ -21,8 +21,36 @@
 #
 # Opt-in perf companion (run when touching the dispatch/kNN hot path):
 #   python scripts/bench_gate.py   # smoke-scale concurrent-kNN floor gate
+#
+# Opt-in FULL-suite sanitizer (mines lock-order edges the smoke subset
+# cannot reach — e.g. the group-commit flusher and delta-feed apply sites):
+#   scripts/tier1.sh --sanitize-full     (or scripts/sanitize_full.sh)
+# runs the ENTIRE tier-1 suite under SURREAL_SANITIZE=1 and cross-checks
+# the observed acquisition graph against locks.HIERARCHY. Slower than the
+# normal gates (instrumented locks across every test); not part of the
+# default run.
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+if [ "$1" = "--sanitize-full" ]; then
+  rm -f /tmp/_t1_locks_full.json
+  timeout -k 10 1500 env JAX_PLATFORMS=cpu \
+    SURREAL_SANITIZE=1 SURREAL_SANITIZE_OUT=/tmp/_t1_locks_full.json \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_t1_sanitize_full.log
+  full_rc=${PIPESTATUS[0]}
+  if [ ! -s /tmp/_t1_locks_full.json ]; then
+    echo "GATE FAILED: sanitize-full produced no lock dump (rc=$full_rc)"
+    exit 1
+  fi
+  python -m scripts.graftlint --no-lint --lock-order /tmp/_t1_locks_full.json
+  lock_rc=$?
+  [ "$full_rc" -ne 0 ] && echo "GATE FAILED: sanitize-full pytest (rc=$full_rc)"
+  [ "$lock_rc" -ne 0 ] && echo "GATE FAILED: sanitize-full lock-order cross-check"
+  [ "$full_rc" -ne 0 ] && exit "$full_rc"
+  exit "$lock_rc"
+fi
 
 # ---- gate 0: static analysis ------------------------------------------------
 python -m scripts.graftlint
@@ -53,6 +81,7 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
   tests/test_locks_sanitizer.py tests/test_dispatch.py \
   tests/test_flight_recorder.py tests/test_column_scan.py \
   tests/test_kvs.py tests/test_e2e_crud.py tests/test_cluster.py \
+  tests/test_bulk_ingest_v2.py \
   -q -p no:cacheprovider -p no:xdist -p no:randomly >/tmp/_t1_sanitize.log 2>&1
 san_rc=$?
 [ "$san_rc" -ne 0 ] && tail -20 /tmp/_t1_sanitize.log

@@ -66,8 +66,38 @@ def show_changes(ctx, stm) -> List[dict]:
             for m in muts:
                 if m.get("delete"):
                     changes.append({"delete": {"id": m["id"]}})
+                elif "bulk_ids" in m:
+                    # batch entry (bulk ingest): the entry stores record ids
+                    # only; expand each to its committed document via a
+                    # versioned read pinned at the entry's own commit
+                    # version, so replay shows exactly the bulk-op values
+                    # even after later updates. Backends without MVCC
+                    # version tracking expand with the current value.
+                    changes.extend(_expand_bulk(txn, ns, db, tb, k, m["bulk_ids"]))
                 else:
                     changes.append({"update": m.get("update")})
         if changes:
             out.append({"versionstamp": vs_to_u64(vs), "changes": changes})
+    return out
+
+
+def _expand_bulk(txn, ns: str, db: str, tb: str, entry_key: bytes, ids) -> List[dict]:
+    """Reader-side expansion of a bulk changefeed entry: one `{update: doc}`
+    per surviving record id. Records whose pinned version was GC'd past the
+    MVCC horizon expand with the oldest retained value (same best-effort
+    contract as retention GC); records deleted before their bulk entry was
+    read are skipped."""
+    ver = txn.tr.version_of(entry_key)
+    out: List[dict] = []
+    for id_ in ids:
+        k = keys.thing(ns, db, tb, id_)
+        raw = txn.tr.get(k, ver)
+        if raw is None and ver is not None:
+            # pinned version GC'd past the MVCC horizon: fall back to the
+            # oldest retained value (retention-GC contract) — None there
+            # too means the record is genuinely gone
+            raw = txn.tr.oldest_retained(k)
+        if raw is None:
+            continue
+        out.append({"update": unpack(raw)})
     return out

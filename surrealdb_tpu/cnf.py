@@ -163,6 +163,31 @@ COLUMN_REBUILD_DEBOUNCE_SECS = _env_float("SURREAL_COLUMN_REBUILD_DEBOUNCE", 0.5
 # checker semantics); IVF strategies keep post-filtering
 KNN_COLUMN_PREFILTER = _env_bool("SURREAL_KNN_COLUMN_PREFILTER", True)
 
+# Bulk-ingest pipeline v2 (doc/bulk.py + kvs/ds.py GroupCommit).
+# Mirror delta-feed: a bulk statement's decoded column blocks append
+# straight onto an up-to-date column mirror at commit (under the version/
+# snapshot staleness protocol) instead of arming a full re-scan rebuild;
+# a delta that cannot apply (schema drift, non-clean base, interleaved
+# row-level writes) falls back to the debounced rebuild.
+COLUMN_DELTA_FEED = _env_bool("SURREAL_COLUMN_DELTA_FEED", True)
+# Group commit: write-transaction commits route through a per-datastore
+# coalescer thread that drains all queued commits in one pass — one
+# commit-lock hold, combined per-table version bumps and ONE combined
+# column-delta application per flush. Durability/visibility semantics are
+# UNCHANGED: commit() still returns only after this transaction's backend
+# commit (and conflict check) completed; the coalescer batches work, it
+# does not defer acknowledgement.
+GROUP_COMMIT = _env_bool("SURREAL_GROUP_COMMIT", True)
+# how long an idle coalescer thread lingers before exiting (it respawns on
+# the next write commit); bounds the per-stream thread churn
+GROUP_COMMIT_LINGER_SECS = _env_float("SURREAL_GROUP_COMMIT_LINGER", 0.2)
+# widest flush one drain may take (txns beyond it wait for the next pass)
+GROUP_COMMIT_MAX_TXNS = _env_int("SURREAL_GROUP_COMMIT_MAX_TXNS", 64)
+# Changefeed batching: a bulk op with a changefeed buffers ONE batch entry
+# (record ids + the commit's MVCC version) instead of one mutation dict per
+# row; SHOW CHANGES expands it reader-side (cf/reader.py).
+CHANGEFEED_BATCH = _env_bool("SURREAL_CHANGEFEED_BATCH", True)
+
 # Row-scan deadline amortization: scan_table/scan_range check the statement
 # deadline every N rows instead of every row (a monotonic clock read per row
 # is measurable GIL-held work on a million-row scan)

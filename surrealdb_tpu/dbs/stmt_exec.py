@@ -258,6 +258,20 @@ def relate_compute(ctx, stm) -> Any:
     froms = _relate_endpoints(ctx, stm.from_)
     withs = _relate_endpoints(ctx, stm.with_)
     kind_v = target_value(ctx, stm.kind)
+    # bulk fast path: a big literal/array endpoint product over a plain
+    # edge table routes through the batched edge writer (doc/bulk.py),
+    # the same path INSERT RELATION takes; None falls through per-row
+    if (
+        isinstance(kind_v, (Table, str))
+        and len(froms) * len(withs) >= cnf.BULK_INSERT_MIN
+    ):
+        from surrealdb_tpu.doc.bulk import try_bulk_relate
+
+        pairs = [(f, w) for f in froms for w in withs]
+        with _with_timeout(ctx, stm) as c:
+            bulk_out = try_bulk_relate(c, stm, pairs, str(kind_v))
+        if bulk_out is not None:
+            return _only(stm, bulk_out)
     it = Iterator(ctx, stm, "relate")
     for f in froms:
         for w in withs:

@@ -202,6 +202,7 @@ class ColumnMirror:
 
     __slots__ = (
         "ids",
+        "enc_keys",
         "columns",
         "nested_unsafe",
         "overflow",
@@ -209,6 +210,8 @@ class ColumnMirror:
         "built_version",
         "built_store_version",
         "build_time",
+        "delta_fed",
+        "_order",
         "_virtual",
         "_id_index",
         "_slot_perm",
@@ -216,6 +219,7 @@ class ColumnMirror:
 
     def __init__(self):
         self.ids: List[Any] = []  # row -> record id (key-scan order)
+        self.enc_keys: List[bytes] = []  # row -> enc_value_key(id)
         self.columns: Dict[str, Column] = {}
         # top-level fields holding a list/record-link in ANY row: a nested
         # path under them can't default to all-NONE (get_path distributes
@@ -226,10 +230,27 @@ class ColumnMirror:
         self.built_version = -1
         self.built_store_version = -1
         self.build_time = 0.0
+        self.delta_fed = False  # rows appended by a bulk delta (not key order)
+        # row indices in key order when delta-fed (None = already key order);
+        # computed lazily on the first scan that streams rows out
+        self._order: Optional[np.ndarray] = None
         self._virtual: Dict[str, Column] = {}
         self._id_index: Optional[Dict[str, int]] = None
         # (id(rids list), n_slots) -> row permutation for the kNN prefilter
         self._slot_perm: Optional[Tuple[int, int, np.ndarray]] = None
+
+    def key_order(self) -> Optional[np.ndarray]:
+        """Row indices in record-key order, or None when rows are already
+        key-ordered (every fully-built mirror; delta appends break it).
+        Scans stream surviving rows in this order so columnar output stays
+        byte-identical to the row path's key-ordered scan."""
+        if not self.delta_fed:
+            return None
+        if self._order is None:
+            self._order = np.argsort(
+                np.asarray(self.enc_keys, dtype=object), kind="stable"
+            )
+        return self._order
 
     def columns_for(self, paths: Set[str]) -> Optional[Dict[str, Column]]:
         """Resolve every path to a column; a path never seen is all-NONE
@@ -441,6 +462,142 @@ class ColumnMirrors:
             bg.cancel(tid, "cancelled: datastore closed")
         self.wait_rebuild(timeout)
 
+    # ------------------------------------------------------------ delta feed
+    def apply_bulk(self, key3, parts, n_bumps: int, commit_version) -> bool:
+        """Append a bulk op's decoded rows straight onto an up-to-date
+        mirror (the ingest delta-feed): `parts` is the commit-ordered list
+        of (ids, enc_keys, docs) blocks this flush wrote to the table and
+        `n_bumps` how many version bumps those commits performed. Applies
+        ONLY when the mirror was exactly current before this flush
+        (built_version == current - n_bumps) — then the merged mirror
+        installs at the CURRENT version and serves immediately, and the
+        100k-row re-scan rebuild never queues. Any other shape (schema
+        drift past the field budget, interleaved row-level writes, no
+        commit version from the backend) returns False and the caller
+        falls back to the debounced rebuild. Must run under the datastore
+        commit lock — the version capture is only atomic there."""
+        from surrealdb_tpu import telemetry
+
+        def _decline(reason: str) -> bool:
+            telemetry.inc("column_mirror_delta", outcome=reason)
+            return False
+
+        if not cnf.COLUMN_DELTA_FEED:
+            return _decline("disabled")
+        if commit_version is None:
+            return _decline("no_commit_version")
+        ds = self._ds() if self._ds is not None else None
+        if ds is not None:
+            _locks.assert_held(ds.commit_lock, "column_mirror.delta apply")
+        with self._lock:
+            m = self._mirrors.get(key3)
+            cur = self.versions.get(key3, 0)
+        if m is None:
+            return _decline("no_mirror")
+        if m.built_version != cur - n_bumps:
+            return _decline("stale_base")
+        if m.overflow:
+            return _decline("overflow_base")
+        ids: List[Any] = []
+        enc_keys: List[bytes] = []
+        docs: List[Any] = []
+        for p_ids, p_keys, p_docs in parts:
+            ids.extend(p_ids)
+            enc_keys.extend(p_keys)
+            docs.extend(p_docs)
+        bn = len(docs)
+        if bn == 0:
+            return _decline("empty")
+        blk, blk_unsafe = _build_block(docs)
+        if blk.overflow:
+            return _decline("overflow_block")
+        paths = set(m.columns) | set(blk.columns)
+        if len(paths) > max(cnf.COLUMN_MIRROR_MAX_FIELDS, 1):
+            return _decline("overflow_union")
+        nm = ColumnMirror()
+        nm.n = m.n + bn
+        nm.ids = m.ids + ids
+        nm.enc_keys = m.enc_keys + enc_keys
+        nm.delta_fed = True
+        # incremental key order: the old prefix is already key-ordered (or
+        # carries a computed order), so merging the B appended keys costs
+        # O(N + B log N) here instead of a full O(N log N) object argsort
+        # on the next scan — sustained ingest would otherwise re-sort the
+        # whole table's keys after every bulk statement
+        old_order = m.key_order()
+        old_keys = np.asarray(m.enc_keys, dtype=object)
+        if old_order is not None:
+            old_rows = old_order
+            old_keys = old_keys[old_order]
+        else:
+            old_rows = np.arange(m.n, dtype=np.int64)
+        blk_keys = np.asarray(enc_keys, dtype=object)
+        bidx = np.argsort(blk_keys, kind="stable")
+        pos = np.searchsorted(old_keys, blk_keys[bidx])
+        nm._order = np.insert(old_rows, pos, m.n + bidx)
+        nm.built_version = cur
+        nm.built_store_version = commit_version
+        nm.build_time = m.build_time
+        nm.nested_unsafe = m.nested_unsafe | blk.nested_unsafe
+        cols: Dict[str, Column] = {}
+        for p in paths:
+            a = m.columns.get(p)
+            b = blk.columns.get(p)
+            tags = np.concatenate(
+                [
+                    a.tags if a is not None else np.zeros(m.n, dtype=np.int8),
+                    b.tags if b is not None else np.zeros(bn, dtype=np.int8),
+                ]
+            )
+            nums = np.concatenate(
+                [
+                    a.nums if a is not None else np.zeros(m.n, dtype=np.float64),
+                    b.nums if b is not None else np.zeros(bn, dtype=np.float64),
+                ]
+            )
+            strs = None
+            if (a is not None and a._strs is not None) or (
+                b is not None and b._strs is not None
+            ):
+                strs = np.full(nm.n, "", dtype=object)
+                if a is not None and a._strs is not None:
+                    strs[: m.n] = a._strs
+                if b is not None and b._strs is not None:
+                    strs[m.n :] = b._strs
+            i64 = None
+            if (a is not None and a._i64 is not None) or (
+                b is not None and b._i64 is not None
+            ):
+                i64 = np.zeros(nm.n, dtype=np.int64)
+                if a is not None and a._i64 is not None:
+                    i64[: m.n] = a._i64
+                if b is not None and b._i64 is not None:
+                    i64[m.n :] = b._i64
+            if a is None and "." in p and p.split(".", 1)[0] in m.nested_unsafe:
+                # a nested path first seen in this batch, under a parent that
+                # held lists/record-links in old rows: those old cells are
+                # not provably NONE — re-check them per row
+                tags[: m.n] = TAG_OTHER
+            cols[p] = Column(tags, nums, strs, i64)
+        # nested columns under a parent that held a list/record-link in a
+        # BATCH row abstain there (same marking the full build applies) —
+        # including columns only the old mirror materialized
+        for parent, rows_u in blk_unsafe.items():
+            off = np.asarray(rows_u, dtype=np.int64) + m.n
+            for p, col in cols.items():
+                if p.startswith(parent + "."):
+                    col.tags[off] = TAG_OTHER
+        nm.columns = cols
+        with self._lock:
+            if self.versions.get(key3, 0) != cur:
+                return _decline("raced")
+            self._mirrors[key3] = nm
+        telemetry.inc("column_mirror_delta", outcome="applied")
+        telemetry.observe_hist(
+            "column_mirror_delta_rows", bn, buckets=telemetry.COUNT_BUCKETS
+        )
+        return True
+
     # ------------------------------------------------------------ serve
     def serveable(self, ctx, key3) -> Optional[ColumnMirror]:
         """The mirror, iff it is provably exact for this reader's snapshot;
@@ -517,6 +674,8 @@ class ColumnMirrors:
         # lists and fetches through Things — all-NONE would be wrong)
         unsafe_rows: Dict[str, List[int]] = {}
         ids: List[Any] = []
+        enc_keys: List[bytes] = []
+        npre = len(pre)
         cap = 1024
         row = 0
         for chunk in txn.batch(pre, prefix_end(pre), cnf.NORMAL_FETCH_SIZE):
@@ -526,6 +685,7 @@ class ColumnMirrors:
                     for b in builders.values():
                         b.grow(cap)
                 ids.append(keys.decode_thing_id(k, ns, db, tb))
+                enc_keys.append(k[npre:])
                 doc = unpack(raw)
                 if isinstance(doc, dict):
                     for name, v in doc.items():
@@ -535,12 +695,36 @@ class ColumnMirrors:
                         )
                 row += 1
         mirror.ids = ids
+        mirror.enc_keys = enc_keys
         mirror.n = row
         mirror.columns = {p: b.finalize(row) for p, b in builders.items()}
         for parent, rows_u in unsafe_rows.items():
             for p, col in mirror.columns.items():
                 if p.startswith(parent + "."):
                     col.tags[rows_u] = TAG_OTHER
+
+
+def _build_block(docs) -> Tuple[ColumnMirror, Dict[str, List[int]]]:
+    """Classify one bulk batch's decoded rows into a block of columns (the
+    delta-feed unit): the same `_put_cell` machinery the full build scan
+    runs, minus the KV scan and unpack — the bulk path already decoded the
+    rows once. Returns (block, unsafe parent -> block rows)."""
+    blk = ColumnMirror()
+    max_fields = max(cnf.COLUMN_MIRROR_MAX_FIELDS, 1)
+    nested_depth = cnf.COLUMN_MIRROR_MAX_DEPTH
+    builders: Dict[str, _ColBuilder] = {}
+    unsafe_rows: Dict[str, List[int]] = {}
+    cap = max(len(docs), 1)
+    for row, doc in enumerate(docs):
+        if isinstance(doc, dict):
+            for name, v in doc.items():
+                _put_cell(
+                    builders, name, v, row, cap, max_fields,
+                    nested_depth, blk, unsafe_rows,
+                )
+    blk.n = len(docs)
+    blk.columns = {p: b.finalize(blk.n) for p, b in builders.items()}
+    return blk, unsafe_rows
 
 
 def _put_cell(builders, name, v, row, cap, max_fields, nested_depth, mirror, unsafe_rows):
@@ -630,7 +814,14 @@ class ColumnScanPlan:
         ns, db = ctx.ns_db()
         txn = ctx.txn()
         ids = mirror.ids
-        cand = np.nonzero(mask | needs_row)[0]
+        want = mask | needs_row
+        order = mirror.key_order()
+        if order is None:
+            cand = np.nonzero(want)[0]
+        else:
+            # delta-appended rows sit past the key-ordered prefix: stream
+            # survivors in record-key order so output matches the row path
+            cand = order[want[order]]
         block = max(cnf.COLUMN_BLOCK_SIZE, 1)
         from surrealdb_tpu.sql.value import truthy
 

@@ -568,13 +568,18 @@ class IvfState:
 
     def search_batch_sharded(
         self, qs: np.ndarray, mesh, matrix, metric: str, k: int, nprobe: int,
-        tile: Optional[int] = None,
+        tile: Optional[int] = None, slot_mask: Optional[np.ndarray] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Batched sharded probe+rerank over a mesh-sharded mirror matrix.
-        Same contract as search_batch; misses surface as +inf/-1."""
+        Same contract as search_batch; misses surface as +inf/-1.
+        `slot_mask` is the columnar residual prefilter over corpus slots:
+        it rides into the kernel row-sharded alongside the corpus so top-k
+        is computed among MATCHING rows only."""
         from surrealdb_tpu.parallel.mesh import sharded_ivf_search
         from surrealdb_tpu.utils.num import pad_tail, tile_slices
+        import jax as _jax
         import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as _P
 
         from surrealdb_tpu.utils.num import dispatch_tile
 
@@ -584,6 +589,19 @@ class IvfState:
         probe_metric = metric if metric in _PROBE_METRICS else "euclidean"
         nprobe = min(nprobe, self.nlists)
         qs = np.asarray(qs, dtype=np.float32)
+        cap = int(matrix.shape[0])
+        if slot_mask is not None:
+            sm = np.asarray(slot_mask, dtype=bool)
+            if sm.shape[0] < cap:  # pad slots are dead anyway
+                sm = np.concatenate([sm, np.zeros(cap - sm.shape[0], dtype=bool)])
+            sm = sm[:cap]
+        else:
+            # placed ONCE here (not per tile-slice launch inside the loop,
+            # and never as a replicated jnp.ones the shard_map must reshard)
+            sm = np.ones(cap, dtype=bool)
+        slot_dev = _jax.device_put(
+            sm, NamedSharding(mesh, _P(mesh.axis_names[0]))
+        )
         tile = dispatch_tile(qs.shape[0], tile)
         dd = np.full((qs.shape[0], k), np.inf, dtype=np.float32)
         rr = np.full((qs.shape[0], k), -1, dtype=np.int64)
@@ -592,6 +610,7 @@ class IvfState:
                 mesh, cents, list_rows, list_mask, matrix,
                 jnp.asarray(pad_tail(qs[lo:hi], tile)),
                 k, nprobe, metric=metric, probe_metric=probe_metric,
+                slot_ok=slot_dev,
             )
             k_out = int(np.asarray(d).shape[1])
             dd[lo:hi, :k_out] = np.asarray(d)[: hi - lo]

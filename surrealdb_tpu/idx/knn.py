@@ -181,8 +181,52 @@ class VectorMirror:
             self.dirty = True
             self.gen += 1
 
-    def _grow(self, dim: int) -> None:
-        cap = max(_pow2(self.n_slots + 1), cnf.TPU_BATCH_MIN_TILE)
+    def apply_many(self, rids, vecs) -> None:
+        """One committed bulk block ([B, D] float32): the all-new-rows fast
+        path appends the whole block under ONE lock hold with one array
+        copy — the per-row path cost B lock round-trips and B numpy row
+        writes per bulk statement. Rows that already have a slot (or a
+        building mirror) fall back to the per-row apply, which is always
+        correct."""
+        with self._lock:
+            if self._pending is not None:
+                self._pending.extend(zip(rids, vecs))
+                return
+            if not self.built:
+                return
+            vecs = np.asarray(vecs, dtype=np.float32)
+            if (
+                vecs.ndim != 2
+                or len(rids) != vecs.shape[0]
+                or self.data is None
+                or (self.data.shape[1] not in (vecs.shape[1], 1) and self.n_slots)
+            ):
+                for rid, vec in zip(rids, vecs):
+                    self.apply(rid, vec)
+                return
+            n0, B = self.n_slots, len(rids)
+            if len(self.rids) != n0 or any(
+                _rid_key(r) in self.slot_of for r in rids
+            ):
+                for rid, vec in zip(rids, vecs):
+                    self.apply(rid, vec)
+                return
+            if n0 + B > self.data.shape[0] or vecs.shape[1] != self.data.shape[1]:
+                self._grow(vecs.shape[1], need=n0 + B)
+            self.data[n0 : n0 + B] = vecs
+            self.alive[n0 : n0 + B] = True
+            self.rids.extend(rids)
+            for i, r in enumerate(rids):
+                self.slot_of[_rid_key(r)] = n0 + i
+            if self.ivf is not None:
+                for i in range(B):
+                    self.ivf.add(n0 + i, vecs[i])
+            self.n_slots = n0 + B
+            self.dirty = True
+            self.gen += 1
+
+    def _grow(self, dim: int, need: Optional[int] = None) -> None:
+        cap = max(_pow2(max(self.n_slots + 1, need or 0)), cnf.TPU_BATCH_MIN_TILE)
         d = max(dim, self.data.shape[1])
         data = np.zeros((cap, d), dtype=np.float32)
         data[: self.data.shape[0], : self.data.shape[1]] = self.data
@@ -591,7 +635,14 @@ class KnnPlan(_KnnExecutorMixin):
         want = (ns, db, self.tb, self.ix["name"])
         overlay = {}
         for ns_, db_, tb_, name_, rid, vec in deltas:
-            if (ns_, db_, tb_, name_) == want:
+            if (ns_, db_, tb_, name_) != want:
+                continue
+            if isinstance(rid, list):
+                # bulk block (vector_bulk_delta): rid is the rid LIST and
+                # vec the [B, D] matrix — expand to per-row entries
+                for r, v in zip(rid, vec):
+                    overlay[_rid_key(r)] = (r, v)
+            else:
                 overlay[(_rid_key(rid))] = (rid, vec)
         return overlay or None
 
@@ -641,7 +692,7 @@ class KnnPlan(_KnnExecutorMixin):
                 # trains in the background (or for big-k queries where IVF
                 # can't pay off) the exact per-shard distance+top-k path
                 # (sharded_knn) serves instead — never a latency cliff.
-                matrix, _, rids = mirror.device_snapshot(mesh)
+                matrix, mask, rids = mirror.device_snapshot(mesh)
                 mask_dev = mirror.device_sharded_mask()
                 want_ivf = approx_ok and n >= cnf.TPU_ANN_MIN_ROWS and self.k * 4 <= n
                 ivf = mirror.ensure_ivf(matrix) if want_ivf else None
@@ -652,13 +703,24 @@ class KnnPlan(_KnnExecutorMixin):
                     ef = self.ef or self.ix["index"].get("efc")
                     nprobe = default_nprobe(ivf.nlists, ef)
                     key = ("knn-ivf-sharded", id(matrix), id(ivf), metric, k, nprobe)
+                    # columnar residual prefilter (parity with ivf/ivf-host):
+                    # the slot mask shards alongside the corpus rows and the
+                    # dispatch key carries the MASK CONTENT so riders with
+                    # different $param bindings never share a leader's mask
+                    slot_mask = None
+                    if self.prefilter is not None:
+                        pre = self._prefilter_slot_mask(ctx, rids, len(mask))
+                        if pre is not None:
+                            slot_mask = pre[0]
+                            key = key + pre[1]
 
                     def runner(qs):
                         qm = np.stack(qs)
 
                         def collect():
                             dd, rr = ivf.search_batch_sharded(
-                                qm, mesh, matrix, metric, k, nprobe
+                                qm, mesh, matrix, metric, k, nprobe,
+                                slot_mask=slot_mask,
                             )
                             return list(zip(dd, rr))
 

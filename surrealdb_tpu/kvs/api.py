@@ -54,6 +54,19 @@ class BackendTransaction(ABC):
     def exists(self, key: bytes) -> bool:
         return self.get(key) is not None
 
+    def version_of(self, key: bytes):
+        """MVCC version of the newest committed value for `key`, when the
+        backend tracks versions (mem does); None disables version-pinned
+        features (changefeed bulk-entry expansion reads current values)."""
+        return None
+
+    def oldest_retained(self, key: bytes):
+        """Oldest committed value still retained for `key` (None when the
+        key is absent or its oldest retained entry is a tombstone). The
+        changefeed reader's fallback when a pinned version was GC'd past
+        the MVCC horizon — best-effort, same contract as retention GC."""
+        return None
+
     def put(self, key: bytes, val: bytes) -> None:
         """Insert only-if-absent."""
         self._check_open(True)

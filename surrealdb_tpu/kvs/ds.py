@@ -8,15 +8,275 @@ registry, and the live-query notification channel.
 
 from __future__ import annotations
 
+import contextvars
+import threading
+import time as _time
+import weakref
 from surrealdb_tpu.utils import locks as _locks
 import uuid as _uuid
 from typing import Any, Dict, List, Optional
 
+from surrealdb_tpu import cnf
 from surrealdb_tpu.err import KvsError
 from .api import BackendDatastore
 from .mem import MemDatastore
 from .tx import Transaction
 from .vs import Oracle, SystemClock
+
+_gc_tls = threading.local()  # .in_flusher: group-commit re-entrancy guard
+
+
+class _CommitSlot:
+    """One queued commit's outcome channel."""
+
+    __slots__ = ("done", "error")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+
+
+class _ColumnSink:
+    """Combines one group-commit flush's column-mirror work: per-table
+    version-bump counts and bulk delta blocks across every member txn,
+    applied in ONE pass after all backend commits — a 5-statement bulk
+    stream appends to the mirror once, not five times."""
+
+    def __init__(self):
+        self.cm = None
+        self.cv = None  # newest member commit version (serve floor)
+        self.bumps: Dict[tuple, int] = {}
+        self.parts: Dict[tuple, list] = {}
+        self.poisoned: set = set()  # tables some member wrote row-at-a-time
+        self.touched: set = set()
+
+    def add(self, txn, touched) -> None:
+        if txn._column_mirrors is not None:
+            self.cm = txn._column_mirrors
+        cv = getattr(txn.tr, "commit_version", None)
+        if cv is not None:
+            self.cv = cv if self.cv is None else max(self.cv, cv)
+        self.touched |= touched
+        for t in touched:
+            self.bumps[t] = self.bumps.get(t, 0) + 1
+        delta_tables = set()
+        for key3, ids, eks, docs in txn.column_deltas:
+            if key3 not in txn.touched_row_tables:
+                self.parts.setdefault(key3, []).append((ids, eks, docs))
+                delta_tables.add(key3)
+        for t in touched:
+            # a touched table whose writes this member did NOT fully express
+            # as a bulk block can never delta-apply in this flush
+            if t not in delta_tables or cv is None:
+                self.poisoned.add(t)
+
+    def flush(self) -> None:
+        cm = self.cm
+        if cm is None:
+            return
+        applied = set()
+        for key3, parts in self.parts.items():
+            if key3 in self.poisoned:
+                continue
+            try:
+                ok = cm.apply_bulk(key3, parts, self.bumps.get(key3, 1), self.cv)
+            except Exception:
+                ok = False  # commit is durable; rebuild fallback below
+            if ok:
+                applied.add(key3)
+        left = self.touched - applied
+        if left:
+            cm.schedule_rebuild(left)
+
+
+class GroupCommit:
+    """Bounded-latency write-commit coalescer (the ingest group-commit).
+
+    Write transactions submit themselves and block until a per-datastore
+    flusher thread (flight-recorder-visible as `bg:group_commit:flush`)
+    drains the queue: each flush commits every queued backend txn under ONE
+    commit-lock hold, then applies the combined column-mirror deltas and
+    per-table rebuild scheduling once for the whole group. Commit
+    SEMANTICS are unchanged — submit() returns only after this txn's own
+    backend commit (or conflict error) completed; the coalescer batches
+    work, it never defers acknowledgement or visibility. The flusher is
+    ephemeral: it exits after GROUP_COMMIT_LINGER_SECS idle and respawns
+    on the next write commit, so idle datastores hold no thread."""
+
+    def __init__(self, ds):
+        self._ds = weakref.ref(ds)
+        self._lock = _locks.Lock("kvs.group_commit")
+        self._wake = threading.Event()  # raw: pure wakeup, no state guarded
+        self._queue: List[tuple] = []  # [(txn, contextvars ctx, slot)]
+        self._live = False  # a flusher incarnation is (being) spawned
+        self._gen = 0  # incarnation counter (crash recovery, see _body)
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # ------------------------------------------------------------ submit
+    def submit(self, txn) -> bool:
+        """Queue a write commit and wait for its flush; False = caller
+        must commit inline (coalescer off/closed, or already on the
+        flusher thread — an on_commit callback committing a txn)."""
+        if not cnf.GROUP_COMMIT or getattr(_gc_tls, "in_flusher", False):
+            return False
+        slot = _CommitSlot()
+        ctx = contextvars.copy_context()
+        entry = (txn, ctx, slot)
+        with self._lock:
+            if self._closed:
+                return False
+            self._queue.append(entry)
+            spawn = not self._live
+            if spawn:
+                self._live = True
+                self._gen += 1
+                gen = self._gen
+        if spawn:
+            try:
+                self._spawn(gen)
+            except BaseException:
+                # our txn must NOT stay queued behind a raised commit —
+                # a later flusher would durably commit a transaction whose
+                # owner was told the commit failed
+                with self._lock:
+                    if entry in self._queue:
+                        self._queue.remove(entry)
+                raise
+        self._wake.set()
+        while not slot.done.wait(0.25):
+            # self-rescue: if the flusher died (spawn failure, crash)
+            # without serving us, drain the queue on this thread
+            with self._lock:
+                rescue = not self._live and any(
+                    s is slot for _, _, s in self._queue
+                )
+                if rescue:
+                    self._live = True
+                    self._gen += 1
+                    rgen = self._gen
+            if rescue:
+                _gc_tls.in_flusher = True
+                try:
+                    self._drain(linger=0.0)
+                finally:
+                    _gc_tls.in_flusher = False
+                    with self._lock:
+                        if self._gen == rgen and self._live:
+                            self._live = False
+        if slot.error is not None:
+            raise slot.error
+        return True
+
+    # ------------------------------------------------------------ flusher
+    def _spawn(self, gen: int) -> None:
+        from surrealdb_tpu import bg
+
+        ds = self._ds()
+        try:
+            t = bg.spawn_service(
+                "group_commit", "flush", self._body, gen,
+                owner=id(ds) if ds is not None else None,
+            )
+            with self._lock:
+                self._thread = t
+        except BaseException:
+            with self._lock:
+                if self._gen == gen:
+                    self._live = False  # submitters self-rescue
+            raise
+
+    def _body(self, gen: int) -> None:
+        _gc_tls.in_flusher = True
+        try:
+            self._drain(cnf.GROUP_COMMIT_LINGER_SECS)
+        finally:
+            _gc_tls.in_flusher = False
+            # crash recovery: an exception escaping _drain must not leave
+            # _live latched True — submitters would poll forever with no
+            # flusher alive. Gen-guarded so a crashed incarnation's cleanup
+            # can't clobber a successor spawned after a normal exit.
+            with self._lock:
+                if self._gen == gen and self._live:
+                    self._live = False
+
+    def _drain(self, linger: float) -> None:
+        cap = max(cnf.GROUP_COMMIT_MAX_TXNS, 1)
+        while True:
+            # clear BEFORE reading the queue: a submitter appends before it
+            # sets the event, so either the drain below sees its txn or the
+            # wait below sees its wakeup — no lost-signal linger stall
+            self._wake.clear()
+            with self._lock:
+                batch = self._queue[:cap]
+                del self._queue[: len(batch)]
+            if batch:
+                try:
+                    self._flush(batch)
+                except BaseException as e:
+                    # a crash past the drain must still resolve every
+                    # drained slot — these txns are no longer in the queue,
+                    # so the submitter self-rescue can never reach them
+                    for _, _, slot in batch:
+                        if slot.error is None:
+                            slot.error = e
+                        slot.done.set()
+                    raise
+                continue
+            if linger <= 0 or self._closed or not self._wake.wait(linger):
+                with self._lock:
+                    if not self._queue:
+                        self._live = False
+                        return
+                    # work arrived between timeout and lock: keep going
+
+    def _flush(self, batch: List[tuple]) -> None:
+        from surrealdb_tpu import telemetry
+
+        ds = self._ds()
+        sink = _ColumnSink()
+        lock = ds.commit_lock if ds is not None else None
+        # ONE commit-lock hold for the whole group: per-member version
+        # bumps + backend commits, then one combined delta application.
+        # The span feeds the txn_group_commit duration histogram (and the
+        # flight recorder names the thread bg:group_commit:flush).
+        with telemetry.span("txn_group_commit"):
+            if lock is not None:
+                lock.acquire()
+            try:
+                for txn, ctx, slot in batch:
+                    try:
+                        # the submitter's contextvars (trace/span identity)
+                        # ride along: txn_commit spans attribute to the
+                        # right request, not to the flusher thread
+                        ctx.run(txn.commit_direct, sink)
+                    except BaseException as e:  # per-member outcome channel
+                        slot.error = e
+                try:
+                    sink.flush()
+                except Exception:
+                    # derived-state upkeep is best-effort past this point:
+                    # commits are durable, stale mirrors can't serve
+                    # (version mismatch), and the flusher must stay alive
+                    pass
+            finally:
+                if lock is not None:
+                    lock.release()
+                for _, _, slot in batch:
+                    slot.done.set()
+        telemetry.observe_hist(
+            "txn_group_commit_width", len(batch), buckets=telemetry.COUNT_BUCKETS
+        )
+
+    # ------------------------------------------------------------ teardown
+    def close(self, timeout: float = 5.0) -> None:
+        """Flush anything queued and retire the flusher thread."""
+        with self._lock:
+            self._closed = True
+            t = self._thread
+        self._wake.set()
+        if t is not None and t.is_alive():
+            t.join(timeout)
 
 
 class Datastore:
@@ -52,6 +312,8 @@ class Datastore:
         # concurrently committing transactions can't apply graph/vector
         # deltas in the opposite order of their backend commits (advisor r2)
         self.commit_lock = _locks.Lock("kvs.commit")
+        # bounded-latency write-commit coalescer (bulk-ingest group commit)
+        self.group_commit = GroupCommit(self)
         # live queries: uuid(hex) -> LiveSubscription (registered in M10)
         self.notifications = None  # set by enable_notifications()
         self.auth_enabled = False
@@ -86,6 +348,7 @@ class Datastore:
         txn._index_stores = self.index_stores
         txn._column_mirrors = self.column_mirrors
         txn._commit_lock = self.commit_lock
+        txn._group = self.group_commit
         return txn
 
     # ------------------------------------------------------------ notifications
@@ -207,6 +470,7 @@ class Datastore:
                     self.cluster.client.shutdown()
                 if self.cluster.executor is not None:
                     self.cluster.executor.shutdown()
+            self.group_commit.close()
             self.column_mirrors.shutdown()
             self.graph_mirrors.shutdown()
             bg.shutdown(owner=id(self))

@@ -188,6 +188,10 @@ class MemTransaction(BackendTransaction):
             if self.writes:
                 store.version += 1
                 ver = store.version
+                # the MVCC version this commit's writes landed at: the
+                # column-mirror delta feed uses it as the served snapshot
+                # floor, the changefeed batch reader as its expansion point
+                self.commit_version = ver
                 new_keys = []
                 for key, val in self.writes.items():
                     chain = data.get(key)
@@ -201,6 +205,22 @@ class MemTransaction(BackendTransaction):
                     # merges wholesale when it is large relative to the list
                     store.sorted_keys.update(new_keys)
         self._finish()
+
+    def version_of(self, key: bytes) -> Optional[int]:
+        """MVCC version of the newest committed chain entry for `key`
+        (None when absent) — the changefeed reader resolves a bulk entry's
+        expansion point from the entry key's own commit version."""
+        with self.store.lock:
+            chain = self.store.data.get(key)
+            return chain[-1][0] if chain else None
+
+    def oldest_retained(self, key: bytes) -> Optional[bytes]:
+        """Oldest committed value still in `key`'s chain (gc() compacts
+        chains from the front) — the changefeed bulk-entry expansion
+        fallback when its pinned version predates the GC horizon."""
+        with self.store.lock:
+            chain = self.store.data.get(key)
+            return chain[0][1] if chain else None
 
     def cancel(self) -> None:
         if not self.done:

@@ -41,8 +41,16 @@ class Transaction:
         # column mask can never serve (idx/column_mirror.py protocol)
         self.touched_tables: set = set()
         self.touched_scopes: set = set()
+        # tables written ROW-AT-A-TIME (set_record/del_record/raw deletes):
+        # a bulk column delta for such a table is not the complete picture
+        # of this txn's writes, so the delta-feed must decline it
+        self.touched_row_tables: set = set()
+        # bulk ingest delta-feed blocks: (key3, ids, enc_keys, docs) handed
+        # to ColumnMirrors.apply_bulk after a successful backend commit
+        self.column_deltas: List[tuple] = []
         self._graph_mirrors = graph_mirrors
         self._column_mirrors = None  # set by Datastore.transaction
+        self._group = None  # set by Datastore.transaction (GroupCommit)
         self._index_stores = None  # set by Datastore.transaction
         # callbacks run strictly after a successful commit (mirror drops on
         # REMOVE …— running them at statement time would let a concurrent
@@ -86,6 +94,19 @@ class Transaction:
             pass  # interpreter shutdown: modules may already be torn down
 
     def commit(self) -> None:
+        # write commits coalesce through the datastore's GroupCommit flusher
+        # (kvs/ds.py): same semantics — this call still returns only after
+        # THIS transaction's backend commit (or conflict error) — but a
+        # stream/burst of bulk commits drains as one flush: one commit-lock
+        # hold, combined per-table version bumps and ONE combined column
+        # delta application
+        group = self._group
+        if group is not None and self.write and not self.done:
+            if group.submit(self):
+                return
+        self.commit_direct()
+
+    def commit_direct(self, column_sink=None) -> None:
         from surrealdb_tpu import telemetry
 
         # the kvs level of the request's span tree (+ a write-labeled
@@ -106,12 +127,19 @@ class Transaction:
                 or self.touched_tables
                 or self.touched_scopes
             ):
-                with self._commit_lock:
-                    self._commit_and_apply()
-            else:
-                self._commit_and_apply()
+                if column_sink is not None:
+                    # group-commit leader: already inside the commit lock
+                    from surrealdb_tpu.utils import locks as _locks
 
-    def _commit_and_apply(self) -> None:
+                    _locks.assert_held(self._commit_lock, "group commit drain")
+                    self._commit_and_apply(column_sink)
+                else:
+                    with self._commit_lock:
+                        self._commit_and_apply()
+            else:
+                self._commit_and_apply(column_sink)
+
+    def _commit_and_apply(self, column_sink=None) -> None:
         cm = self._column_mirrors
         if cm is not None and (self.touched_tables or self.touched_scopes):
             # BEFORE the backend commit (and under the datastore commit
@@ -125,17 +153,32 @@ class Transaction:
                 )
             cm.invalidate(self.touched_tables, self.touched_scopes)
         self.tr.commit()
-        if cm is not None and self.touched_tables:
-            cm.schedule_rebuild(self.touched_tables)
-        self.touched_tables = set()
+        touched, self.touched_tables = self.touched_tables, set()
         self.touched_scopes = set()
+        if cm is not None and touched:
+            if column_sink is not None:
+                # group-commit leader combines the whole flush's deltas
+                # into one application pass after every backend commit
+                column_sink.add(self, touched)
+            else:
+                self._apply_column_deltas(cm, touched)
+        self.column_deltas = []
         if self.graph_deltas and self._graph_mirrors is not None:
             self._graph_mirrors.apply_deltas(self.graph_deltas)
             self.graph_deltas = []
         if self.vector_deltas and self._index_stores is not None:
             for ns, db, tb, name, rid, vec in self.vector_deltas:
                 mirror = self._index_stores.get(ns, db, tb, name)
-                if mirror is not None and hasattr(mirror, "apply"):
+                if mirror is None:
+                    continue
+                if isinstance(rid, list):
+                    # bulk block: one lock hold + one [B, D] array append
+                    if hasattr(mirror, "apply_many"):
+                        mirror.apply_many(rid, vec)
+                    elif hasattr(mirror, "apply"):
+                        for r, v in zip(rid, vec):
+                            mirror.apply(r, v)
+                elif hasattr(mirror, "apply"):
                     # apply() buffers during a build and no-ops when unbuilt
                     mirror.apply(rid, vec)
             self.vector_deltas = []
@@ -152,6 +195,35 @@ class Transaction:
         for fn in self._on_commit:
             fn()
         self._on_commit = []
+
+    def _apply_column_deltas(self, cm, touched) -> None:
+        """Post-commit mirror upkeep for this txn's bulk blocks: tables whose
+        delta applied cleanly serve the mirror immediately and skip the
+        debounced re-scan rebuild; everything else falls back to it."""
+        applied: set = set()
+        if self.column_deltas:
+            cv = getattr(self.tr, "commit_version", None)
+            by_tb: Dict[tuple, List[tuple]] = {}
+            for key3, ids, eks, docs in self.column_deltas:
+                by_tb.setdefault(key3, []).append((ids, eks, docs))
+            for key3, parts in by_tb.items():
+                try:
+                    ok = (
+                        key3 in touched
+                        and key3 not in self.touched_row_tables
+                        and cm.apply_bulk(key3, parts, 1, cv)
+                    )
+                except Exception:
+                    # a delta-apply failure must never fail the COMMIT —
+                    # the KV write is already durable; fall back to the
+                    # debounced rebuild (the stale mirror cannot serve:
+                    # its version no longer matches)
+                    ok = False
+                if ok:
+                    applied.add(key3)
+        left = touched - applied
+        if left:
+            cm.schedule_rebuild(left)
 
     def on_commit(self, fn) -> None:
         """Defer a side effect until this transaction has committed."""
@@ -174,10 +246,11 @@ class Transaction:
             len(self.vector_deltas),
             len(self.ft_deltas),
             len(self._on_commit),
+            len(self.column_deltas),
         )
 
     def rollback_to(self, sp) -> None:
-        n_undo, cf_lens, ng, nv, nf, noc = sp
+        n_undo, cf_lens, ng, nv, nf, noc, ncd = sp
         tr = self.tr
         undo = getattr(tr, "undo", None)
         if undo is not None:
@@ -198,6 +271,7 @@ class Transaction:
         self.vector_deltas = self.vector_deltas[:nv]
         self.ft_deltas = self.ft_deltas[:nf]
         self._on_commit = self._on_commit[:noc]
+        self.column_deltas = self.column_deltas[:ncd]
         # catalog entries written in the rolled-back span (ensure_tb etc.)
         # would otherwise survive in the cache while their KV rows are gone
         self.cache.clear()
@@ -209,6 +283,20 @@ class Transaction:
     def vector_delta(self, ns, db, tb, name, rid, vec) -> None:
         """Record one vector-row mutation for post-commit mirror upkeep."""
         self.vector_deltas.append((ns, db, tb, name, rid, vec))
+
+    def vector_bulk_delta(self, ns, db, tb, name, rids, vecs) -> None:
+        """Record one bulk-ingested vector block ([B, D] f32) — applied as
+        ONE mirror append (VectorMirror.apply_many) instead of B per-row
+        lock round-trips."""
+        self.vector_deltas.append((ns, db, tb, name, list(rids), vecs))
+
+    def bulk_column_delta(self, ns, db, tb, ids, enc_keys, docs) -> None:
+        """Record one bulk op's decoded rows for the column-mirror delta
+        feed (idx/column_mirror.py apply_bulk): the batch was decoded once
+        by doc/bulk.py, so the mirror appends typed blocks at commit
+        instead of arming a full re-scan rebuild."""
+        self.touched_tables.add((ns, db, tb))
+        self.column_deltas.append(((ns, db, tb), ids, enc_keys, docs))
 
     def ft_delta(self, ns, db, tb, name, rid, did, old_tf, new_tf, new_len) -> None:
         """Record one full-text document mutation for post-commit mirror
@@ -623,9 +711,16 @@ class Transaction:
 
     # ------------------------------------------------------------ records
     def touch_table(self, ns: str, db: str, tb: str) -> None:
-        """Mark a table's record keyspace as written by this transaction
-        (columnar-mirror invalidation; raw-write paths like the bulk ingest
-        call this explicitly)."""
+        """Mark a table's record keyspace as written row-at-a-time by this
+        transaction (columnar-mirror invalidation; raw-write paths like the
+        view maintainer call this explicitly)."""
+        self.touched_tables.add((ns, db, tb))
+        self.touched_row_tables.add((ns, db, tb))
+
+    def touch_table_bulk(self, ns: str, db: str, tb: str) -> None:
+        """Mark a table written ONLY through the bulk block path: versions
+        still bump at commit, but the write-set stays representable as a
+        column delta (touch_table would poison the delta feed)."""
         self.touched_tables.add((ns, db, tb))
 
     def touch_scope(self, scope: tuple) -> None:
@@ -638,10 +733,12 @@ class Transaction:
 
     def set_record(self, ns: str, db: str, tb: str, id_: Any, doc: dict) -> None:
         self.touched_tables.add((ns, db, tb))
+        self.touched_row_tables.add((ns, db, tb))
         self.tr.set(keys.thing(ns, db, tb, id_), pack(doc))
 
     def del_record(self, ns: str, db: str, tb: str, id_: Any) -> None:
         self.touched_tables.add((ns, db, tb))
+        self.touched_row_tables.add((ns, db, tb))
         self.tr.delete(keys.thing(ns, db, tb, id_))
 
     def record_exists(self, ns: str, db: str, tb: str, id_: Any) -> bool:
@@ -650,6 +747,16 @@ class Transaction:
     # ------------------------------------------------------------ changefeed
     def buffer_change(self, ns: str, db: str, tb: str, mutation: dict) -> None:
         self.cf_buffer.setdefault((ns, db, tb), []).append(mutation)
+
+    def buffer_bulk_change(self, ns: str, db: str, tb: str, rids) -> None:
+        """ONE compact changefeed mutation for a whole bulk op: the record
+        ids only, not a per-row copy of every document. SHOW CHANGES
+        expands it reader-side (cf/reader.py) with a versioned read at the
+        entry's own commit version, so replay values are exactly the
+        committed documents."""
+        self.cf_buffer.setdefault((ns, db, tb), []).append(
+            {"bulk_ids": [r.id for r in rids]}
+        )
 
     def complete_changes(self) -> None:
         """Write buffered changefeed mutations under versionstamped keys

@@ -182,12 +182,13 @@ def _ivf_searcher(mesh, k, nprobe, kk, k_out, metric, probe_metric, axis):
             P(axis, None, None),  # per-shard list rows [n_dev, C, L]
             P(axis, None, None),  # per-shard list masks
             P(axis, None),        # corpus rows, sharded
+            P(axis),              # per-slot residual prefilter, sharded
             P(None, None),        # queries, replicated
         ),
         out_specs=(P(None, None), P(None, None)),
         check_vma=False,
     )
-    def _search(c, lr3, lm3, x_local, q):
+    def _search(c, lr3, lm3, x_local, sok_local, q):
         lr, lm = lr3[0], lm3[0]  # this shard's [C, L] slab
         shard_rows = x_local.shape[0]
         dc = pairwise_distance(q, c, probe_metric)  # [Q, C]
@@ -196,8 +197,12 @@ def _ivf_searcher(mesh, k, nprobe, kk, k_out, metric, probe_metric, axis):
 
         def one(qi, pr):
             rows = lr[pr].reshape(-1)  # [nprobe*L] local row offsets
-            m = lm[pr].reshape(-1)
-            cand = x_local[jnp.clip(rows, 0, shard_rows - 1)]
+            rows_c = jnp.clip(rows, 0, shard_rows - 1)
+            # the columnar residual-WHERE mask ANDs in per local slot, so
+            # top-k is computed among MATCHING rows only (parity with the
+            # single-chip ivf/ivf-host strategies)
+            m = lm[pr].reshape(-1) & sok_local[rows_c]
+            cand = x_local[rows_c]
             d = pairwise_distance(qi[None, :], cand, metric)[0]
             d = jnp.where(m, d, jnp.inf)
             neg, idx = jax.lax.top_k(-d, kk)
@@ -226,6 +231,7 @@ def sharded_ivf_search(
     metric: str = "euclidean",
     probe_metric: str = "euclidean",
     axis: str = "data",
+    slot_ok: "jax.Array" = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Sharded IVF ANN search (the mesh composition of idx/ivf.py).
 
@@ -236,15 +242,21 @@ def sharded_ivf_search(
     per-shard top-k — same O(k*devices) collective as sharded_knn, but
     sublinear per-shard work (the fix for VERDICT r3 weak #1: ANN now
     composes with multi-chip sharding instead of falling back to exact).
+    `slot_ok` [corpus rows] is the per-slot residual prefilter (columnar
+    WHERE mask), sharded alongside the corpus; None searches every slot.
     Returns (dists [Q, k_out], global slots [Q, k_out]); k_out ≤ k when the
     probed lists cannot yield k candidates.
     """
+    import jax.numpy as jnp
+
     n_dev = mesh.shape[axis]
     L = int(list_rows.shape[2])
     kk = min(k, nprobe * L)
     k_out = min(k, n_dev * kk)
+    if slot_ok is None:
+        slot_ok = jnp.ones(int(corpus.shape[0]), dtype=bool)
     run = _ivf_searcher(mesh, k, nprobe, kk, k_out, metric, probe_metric, axis)
-    return run(cents, list_rows, list_mask, corpus, queries)
+    return run(cents, list_rows, list_mask, corpus, slot_ok, queries)
 
 
 # ------------------------------------------------------------------ graph

@@ -57,6 +57,8 @@ HIERARCHY: Dict[str, int] = {
     "idx.graph.build": 10,     # graph-CSR build serialization
     "dispatch.bucket": 20,     # per-bucket queue hand-off
     "dispatch.queue": 22,      # dispatch counters/bucket map
+    "kvs.group_commit": 28,    # group-commit queue (taken standalone, before
+                               # the flusher ever enters kvs.commit)
     "kvs.commit": 30,          # datastore commit: backend commit + mirror deltas
     # state registries (held briefly, may take leaf locks)
     "idx.store": 40,           # index-store registry (RLock, re-entrant reads)

@@ -127,3 +127,47 @@ def test_committed_r10_artifact_validates():
     path = os.path.join(REPO, "bench_results_r10.json")
     assert os.path.exists(path)
     assert cba.validate(path) == []
+
+
+# ------------------------------------------------------------------ schema/7
+def _min_v7_artifact():
+    doc = _min_v6_artifact(
+        {"cluster": {
+            "nodes": 2, "per_node_rows": {"n1": 5, "n2": 7}, "parity": True,
+            "ingest_bulk_path": True,
+        }}
+    )
+    doc["schema"] = "surrealdb-tpu-bench/7"
+    doc["configs"] = ["6", "7"]
+    line = doc["results"][0]
+    line["ingest_rate_rows_s"] = 12000.0
+    scan_line = dict(line)
+    scan_line.pop("cluster")
+    scan_line.update(
+        metric="filtered_scan_1000rows", config="6",
+        row_path_qps=1.0, same_results=True, rows_matched=3,
+        ingest={"sustained_rows_s": 30000.0, "r10_rows_s": 1200.0,
+                "delta_vs_r10": 25.0, "parity_failures": 0},
+    )
+    doc["results"].insert(1, scan_line)
+    return doc
+
+
+def test_v7_requires_ingest_rate_and_clean_sustained_parity(tmp_path):
+    assert _validate_doc(tmp_path, _min_v7_artifact()) == []
+
+    doc = _min_v7_artifact()
+    doc["results"][0].pop("ingest_rate_rows_s")
+    assert any("ingest_rate_rows_s" in p for p in _validate_doc(tmp_path, doc))
+
+    doc = _min_v7_artifact()
+    doc["results"][1]["ingest"]["parity_failures"] = 1
+    assert any("parity_failures" in p for p in _validate_doc(tmp_path, doc))
+
+    doc = _min_v7_artifact()
+    doc["results"][1].pop("ingest")
+    assert any("'ingest' object" in p for p in _validate_doc(tmp_path, doc))
+
+    doc = _min_v7_artifact()
+    doc["results"][0]["cluster"]["ingest_bulk_path"] = False
+    assert any("ingest_bulk_path" in p for p in _validate_doc(tmp_path, doc))

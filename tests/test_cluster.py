@@ -411,3 +411,27 @@ def test_multi_table_update_keeps_from_source_order(cluster2):
         c.both(f"CREATE b:{i} SET v = {i}")
     # single node returns a's rows then b's; the broadcast merge must too
     c.both("UPDATE b, a SET touched = true WHERE v < 5")
+
+
+def test_cluster_routed_insert_executes_bulk_on_remote(cluster2):
+    """Owner-grouped INSERT batches ship as one RPC per owner; the REMOTE
+    node must execute them through try_bulk_insert (in-process nodes share
+    the telemetry registry, so the bulk counters prove the routed path)."""
+    from surrealdb_tpu import telemetry
+
+    c = cluster2
+    c.both("DEFINE TABLE big SCHEMALESS")
+    n = 400  # well above BULK_INSERT_MIN even after the 2-way owner split
+    rows = [{"id": i, "v": i} for i in range(n)]
+    rows0 = sum(telemetry.counters_matching("bulk_insert_rows").values())
+    c.both("INSERT INTO big $rows", {"rows": rows})
+    delta = sum(telemetry.counters_matching("bulk_insert_rows").values()) - rows0
+    # ref wrote n rows bulk; the cluster's two shard owners wrote n more —
+    # anything less means a shard fell back to the per-row pipeline
+    assert delta >= 2 * n, delta
+    spread = []
+    for ds_ in c.datastores:
+        r = ds_.execute_local("SELECT count() FROM big GROUP ALL", c.s)[0]["result"]
+        spread.append(r[0]["count"] if r else 0)
+    assert sum(spread) == n and all(x > 0 for x in spread), spread
+    c.both("SELECT count() FROM big GROUP ALL")
