@@ -63,7 +63,7 @@ import time
 import numpy as np
 
 SCALE = float(os.environ.get("SURREAL_BENCH_SCALE", "1.0"))
-CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6,7").split(","))
+CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6,7,8").split(","))
 ROUND = os.environ.get("SURREAL_BENCH_ROUND", "r10")
 OUT_PATH = os.environ.get(
     "SURREAL_BENCH_OUT",
@@ -82,7 +82,15 @@ PROFILE = "--profile" in sys.argv[1:] or os.environ.get("SURREAL_PROFILE") == "1
 # ratio and a zero-staleness parity flag. Config 7's cluster object gains
 # ingest fields (rate + routed-bulk-path proof). Everything schema/6
 # carried stays.
-SCHEMA = "surrealdb-tpu-bench/7"
+# schema/8 (r12, fault tolerance): new config 8 — a CHAOS window over a
+# 3-node replicated (SURREAL_CLUSTER_RF) cluster that kills one node
+# mid-window and keeps reading: its line carries a `chaos` object
+# (nodes/rf/killed_node, failover_reads, degraded_responses, errors,
+# wrong_answers — MUST be 0 — and recovery_s, the time from the kill to
+# the next successful read). Config 7's cluster object gains `rf` and its
+# row-spread accounting is replication-aware. The embedded debug bundle
+# grew its eighth section (`faults`: failpoint trip counters).
+SCHEMA = "surrealdb-tpu-bench/8"
 
 D = 768
 NI = max(int(1_000_000 * SCALE), 1024)  # item corpus (configs 2/4/5)
@@ -1089,16 +1097,19 @@ def bench_cluster(rng):
         # routed-bulk proof: the coordinator's owner-grouped batches must
         # execute through try_bulk_insert ON THE REMOTE NODE (in-process
         # nodes share the telemetry registry): ref wrote 2n rows bulk and
-        # the cluster's two nodes wrote 2n more — anything less means a
-        # shard fell back to the per-row pipeline
+        # the cluster wrote 2n more onto EACH of the rf replicas —
+        # anything less means a shard fell back to the per-row pipeline
+        from surrealdb_tpu import cnf as _cnf
+
+        rf = max(min(_cnf.CLUSTER_RF, len(nodes)), 1)
         bulk_rows = sum(_tm.counters_matching("bulk_insert_rows").values()) - bulk_rows0
-        ingest_parity = bulk_rows >= 4 * n
+        ingest_parity = bulk_rows >= (2 + 2 * rf) * n
         spread = {}
         for name, node_ds in (("n1", ds1), ("n2", ds2)):
             c = node_ds.execute_local("SELECT count() FROM item GROUP ALL", s)
             rows_held = c[0]["result"][0]["count"] if c[0]["result"] else 0
             spread[name] = int(rows_held)
-        assert sum(spread.values()) == n, spread
+        assert sum(spread.values()) == n * rf, spread
 
         # ---- merged-result parity (the correctness contract)
         where_sql = "SELECT * FROM item WHERE val < 0.25"
@@ -1157,6 +1168,7 @@ def bench_cluster(rng):
                 "ingest_rate_rows_s": round(4 * n / ingest_s, 1) if ingest_s else None,
                 "cluster": {
                     "nodes": len(nodes),
+                    "rf": rf,
                     "per_node_rows": spread,
                     "parity": all(parity.values()),
                     "parity_detail": parity,
@@ -1177,6 +1189,144 @@ def bench_cluster(rng):
         ds2.close()
         ref.close()
     return None  # scale-out ratio, not a vs-CPU speedup: keep out of the geomean
+
+
+def bench_chaos(rng):
+    """Config 8: the chaos window — a 3-node replicated cluster serving a
+    scan+kNN read mix while one node is KILLED mid-window. The contract
+    measured: reads keep answering (failover onto replicas, `degraded`
+    flag), every answer stays byte-identical to the single-node twin
+    (wrong_answers MUST be 0), errors stay bounded, and recovery_s — the
+    time from the kill to the next successful read — stays small. This is
+    the artifact line that makes 'the cluster survives a node loss' a
+    number instead of a claim."""
+    from surrealdb_tpu import cluster as _cluster, cnf as _cnf
+    from surrealdb_tpu import telemetry as _tm
+    from surrealdb_tpu.dbs.session import Session
+    from surrealdb_tpu.kvs.ds import Datastore
+    from surrealdb_tpu.net.server import serve as _serve
+
+    n = max(min(int(2048 * SCALE), 2048), 192)
+    d = min(D, 32)
+    s = Session.owner("bench", "bench")
+    ref = Datastore("memory")
+    servers = [
+        _serve("memory", port=0, auth_enabled=False).start_background()
+        for _ in range(3)
+    ]
+    nodes = [
+        {"id": f"n{i + 1}", "url": srv.url} for i, srv in enumerate(servers)
+    ]
+    dss = [srv.httpd.RequestHandlerClass.ds for srv in servers]
+    for i, ds_ in enumerate(dss):
+        _cluster.attach(ds_, _cluster.ClusterConfig(nodes, f"n{i + 1}", secret="bench"))
+    rf = max(min(_cnf.CLUSTER_RF, len(nodes)), 1)
+    killed_idx = 1
+    killed = False
+    saved_timeout = _cnf.CLUSTER_RPC_TIMEOUT_SECS
+    # recovery_s is bounded by ONE rpc timeout (slow failures never retry,
+    # the breaker eats the rest) — keep the window snappy
+    _cnf.CLUSTER_RPC_TIMEOUT_SECS = min(saved_timeout, 2.0)
+    try:
+        ddl = (
+            "DEFINE TABLE item SCHEMALESS; "
+            f"DEFINE INDEX iemb ON item FIELDS emb MTREE DIMENSION {d}"
+        )
+        for target in (ref.execute, dss[0].execute):
+            for r in target(ddl, s):
+                assert r["status"] == "OK", r
+        corpus = rng.standard_normal((n, d)).astype(np.float32)
+        t_ing = time.perf_counter()
+        for lo in range(0, n, 512):
+            hi = min(lo + 512, n)
+            rows = [
+                {"id": i, "emb": corpus[i].tolist(), "val": float(i % 97)}
+                for i in range(lo, hi)
+            ]
+            for target in (ref.execute, dss[0].execute):
+                r = target("INSERT INTO item $rows RETURN NONE", s, {"rows": rows})
+                assert r[0]["status"] == "OK", r
+        ingest_s = time.perf_counter() - t_ing
+
+        scan_sql = "SELECT id FROM item WHERE val < 20"
+        knn_sql = "SELECT id FROM item WHERE emb <|8|> $q"
+        reads = 60
+        qs = corpus[rng.integers(0, n, size=reads)] + 0.01
+        # ground truth from the single-node twin, precomputed so the
+        # chaos window measures ONLY the cluster's behavior
+        expect_scan = ref.execute(scan_sql, s)[0]["result"]
+        expect_knn = [
+            ref.execute(knn_sql, s, {"q": qs[i].tolist()})[0]["result"]
+            for i in range(reads)
+        ]
+        dss[0].execute(knn_sql, s, {"q": qs[0].tolist()})  # warm the path
+
+        fo0 = sum(_tm.counters_matching("cluster_failover_total").values())
+        errors = degraded = wrong = failover_reads = 0
+        t_kill = recovery_s = None
+        t0 = time.perf_counter()
+        for i in range(reads):
+            if i == reads // 2:
+                log(f"chaos: killing node n{killed_idx + 1} mid-window")
+                servers[killed_idx].shutdown()
+                killed = True
+                t_kill = time.perf_counter()
+            if i % 2 == 0:
+                r = dss[0].execute(knn_sql, s, {"q": qs[i].tolist()})[0]
+                want = expect_knn[i]
+            else:
+                r = dss[0].execute(scan_sql, s)[0]
+                want = expect_scan
+            if r["status"] != "OK":
+                errors += 1
+                continue
+            if r.get("degraded"):
+                degraded += 1
+            if t_kill is not None and recovery_s is None:
+                recovery_s = time.perf_counter() - t_kill
+            if r["result"] != want:
+                wrong += 1
+        window_s = time.perf_counter() - t0
+        failover_reads = (
+            sum(_tm.counters_matching("cluster_failover_total").values()) - fo0
+        )
+        qps = reads / window_s if window_s else 0.0
+        emit(
+            {
+                "metric": f"chaos_reads_3nodes_rf{rf}_{n}x{d}",
+                "value": round(qps, 2),
+                "unit": "qps",
+                "vs_baseline": None,
+                "window_s": round(window_s, 2),
+                # this config's own bulk loads (single-node twin + the
+                # replicated cluster write path, one window)
+                "ingest_rate_rows_s": round((1 + rf) * n / ingest_s, 1)
+                if ingest_s
+                else None,
+                "chaos": {
+                    "nodes": len(nodes),
+                    "rf": rf,
+                    "killed_node": f"n{killed_idx + 1}",
+                    "reads": reads,
+                    "failover_reads": int(failover_reads),
+                    "degraded_responses": degraded,
+                    "errors": errors,
+                    "wrong_answers": wrong,
+                    "recovery_s": round(recovery_s, 3) if recovery_s is not None else None,
+                },
+            }
+        )
+        assert wrong == 0, f"chaos window produced {wrong} wrong answers"
+        assert rf < 2 or degraded > 0, "node kill produced no degraded reads"
+    finally:
+        _cnf.CLUSTER_RPC_TIMEOUT_SECS = saved_timeout
+        for i, srv in enumerate(servers):
+            if not (killed and i == killed_idx):
+                srv.shutdown()
+        for ds_ in dss:
+            ds_.close()
+        ref.close()
+    return None  # a survival property, not a vs-CPU speedup
 
 
 def bench_ml_scan(ds, s, rng):
@@ -1327,6 +1477,8 @@ def main() -> None:
         need_corpus()
     if "7" in CONFIGS:
         run_cfg("7", lambda: bench_cluster(rng))
+    if "8" in CONFIGS:
+        run_cfg("8", lambda: bench_chaos(rng))
     if "5" in CONFIGS:
         run_cfg("5", lambda: bench_ml_scan(ds, s, rng))
     if "6" in CONFIGS:

@@ -23,7 +23,7 @@ import os
 import sys
 from typing import List
 
-SCHEMA = "surrealdb-tpu-bench/7"
+SCHEMA = "surrealdb-tpu-bench/8"
 # earlier rounds' committed artifacts stay validatable under their own rules
 KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/1",
@@ -32,6 +32,7 @@ KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/4",
     "surrealdb-tpu-bench/5",
     "surrealdb-tpu-bench/6",
+    "surrealdb-tpu-bench/7",
     SCHEMA,
 )
 
@@ -67,7 +68,18 @@ CLUSTER_KEYS = ("nodes", "per_node_rows", "parity")
 # sustained mirrored-table phase ran delta-fed with ZERO staleness parity
 # failures (a stale mask serving is an invalid artifact, not a slow one)
 INGEST_KEYS = ("sustained_rows_s", "r10_rows_s", "delta_vs_r10", "parity_failures")
+# schema/8 (fault tolerance): a chaos_* config line must carry the `chaos`
+# object proving the window actually killed a node (killed_node), kept
+# answering (failover/degraded accounting, bounded errors, recovery time)
+# and NEVER answered wrong (wrong_answers == 0 is a validity rule, not a
+# perf floor); /8 bundles also carry the failpoint engine's `faults`
+# section as their eighth section
+CHAOS_KEYS = (
+    "nodes", "rf", "killed_node", "reads", "failover_reads",
+    "degraded_responses", "errors", "wrong_answers", "recovery_s",
+)
 BUNDLE_SECTIONS = ("traces", "slow_queries", "errors", "tasks", "compiles", "engine")
+BUNDLE_SECTIONS_V8 = BUNDLE_SECTIONS + ("locks", "faults")
 COMPILES_KEYS = ("on_demand", "prewarm", "events")
 BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
 BATCH_KEYS_V3 = BATCH_KEYS + ("width_dist", "pipeline_wait_s")
@@ -91,7 +103,8 @@ def validate(path: str) -> List[str]:
     if art.get("schema") not in KNOWN_SCHEMAS:
         problems.append(f"schema is {art.get('schema')!r}, expected one of {KNOWN_SCHEMAS}")
     schema = art.get("schema")
-    v7 = schema == SCHEMA
+    v8 = schema == SCHEMA
+    v7 = v8 or schema == "surrealdb-tpu-bench/7"
     v6 = v7 or schema == "surrealdb-tpu-bench/6"
     v5 = v6 or schema == "surrealdb-tpu-bench/5"
     v4 = v5 or schema == "surrealdb-tpu-bench/4"
@@ -112,7 +125,7 @@ def validate(path: str) -> List[str]:
         if not isinstance(bundle, dict):
             problems.append("schema/5 artifact missing the embedded debug bundle")
         else:
-            for sec in BUNDLE_SECTIONS:
+            for sec in (BUNDLE_SECTIONS_V8 if v8 else BUNDLE_SECTIONS):
                 if sec not in bundle:
                     problems.append(f"bundle: missing section {sec!r}")
     for key in ("scale", "configs", "results"):
@@ -226,6 +239,30 @@ def validate(path: str) -> List[str]:
                     f"{where} ({metric}): cluster.ingest_bulk_path must be true "
                     "(a shard's INSERT fell back to the per-row pipeline)"
                 )
+        if v8 and metric.startswith("chaos_"):
+            ch = r.get("chaos")
+            if not isinstance(ch, dict):
+                problems.append(f"{where} ({metric}): missing 'chaos' object")
+            else:
+                for key in CHAOS_KEYS:
+                    if key not in ch:
+                        problems.append(f"{where} ({metric}): chaos missing {key!r}")
+                if ch.get("wrong_answers") not in (0,):
+                    problems.append(
+                        f"{where} ({metric}): chaos.wrong_answers must be 0 "
+                        "(a degraded read returned a wrong answer)"
+                    )
+                if not ch.get("killed_node"):
+                    problems.append(
+                        f"{where} ({metric}): chaos.killed_node empty — the "
+                        "window never actually lost a node"
+                    )
+                if isinstance(ch.get("rf"), int) and ch["rf"] >= 2:
+                    if not ch.get("degraded_responses"):
+                        problems.append(
+                            f"{where} ({metric}): a replicated chaos window "
+                            "with a killed node must show degraded responses"
+                        )
         if v4 and metric.startswith("filtered_scan"):
             for key in FILTERED_SCAN_KEYS:
                 if key not in r:

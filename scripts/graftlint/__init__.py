@@ -27,6 +27,11 @@ to enforce from memory:
   GL007  manual span names (tracing.pop / record_span_into) drifting
          from the telemetry.observe() family recorded in the same
          function — a drifted name breaks the trace<->metric join
+  GL008  fault-handling hygiene (the failpoint engine's static twin):
+         `while True` retry loops whose handler continues with no
+         sleep/backoff (a CPU-speed hammer on a failing dependency),
+         and broad `except Exception: pass` swallows that erase the
+         evidence every recovery path needs
 
 Workflow:
 

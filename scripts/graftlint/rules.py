@@ -1,4 +1,4 @@
-"""graftlint rules GL001–GL006 (see package docstring for the catalog).
+"""graftlint rules GL001–GL008 (see package docstring for the catalog).
 
 Each rule is `fn(modules: List[Module]) -> List[Finding]`. Rules are
 deliberately HEURISTIC — they encode this codebase's conventions, not a
@@ -489,4 +489,78 @@ def gl007(modules: List[Module]) -> List[Finding]:
                         f"GL007:{m.rel}:{m.enclosing_def(node)}:{name}",
                     )
                 )
+    return out
+
+
+# ------------------------------------------------------------------ GL008
+# Fault-handling hygiene (the failpoint engine's static companion): a retry
+# loop with no backoff hammers whatever just failed, and a bare
+# `except Exception: pass` erases the evidence every recovery path needs.
+GL008_BROAD_TYPES = frozenset({"Exception", "BaseException"})
+GL008_PACING_CALLS = frozenset({"sleep", "wait"})
+
+
+def _gl008_is_const_true(test: ast.AST) -> bool:
+    return isinstance(test, ast.Constant) and test.value is True
+
+
+def _gl008_has_pacing(node: ast.AST) -> bool:
+    """A sleep()/Event.wait()-class call anywhere in the loop body — the
+    minimum evidence of backoff between retry attempts."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            _, attr = _call_name(sub)
+            if attr in GL008_PACING_CALLS:
+                return True
+    return False
+
+
+@_rule("GL008", "retry loop without backoff/attempt cap; bare except-swallow")
+def gl008(modules: List[Module]) -> List[Finding]:
+    out: List[Finding] = []
+    for m in modules:
+        for node in ast.walk(m.tree):
+            # (a) swallow: a broad handler whose whole body is `pass` —
+            # the failure is erased, not handled (narrow the type, log it,
+            # or record it somewhere a human can find)
+            if isinstance(node, ast.ExceptHandler):
+                t = node.type
+                broad = t is None or (
+                    isinstance(t, ast.Name) and t.id in GL008_BROAD_TYPES
+                )
+                if broad and all(isinstance(b, ast.Pass) for b in node.body):
+                    out.append(
+                        Finding(
+                            "GL008", m.rel, node.lineno, node.col_offset,
+                            "bare `except Exception: pass` swallows the "
+                            "failure with no trace — narrow the type, or "
+                            "record it (telemetry/bg record/log) before "
+                            "continuing",
+                            f"GL008:{m.rel}:{m.enclosing_def(node)}:swallow",
+                        )
+                    )
+            # (b) unbounded retry loop with no pacing: `while True` whose
+            # exception handler `continue`s straight back into the attempt
+            # with no sleep/wait anywhere in the loop — a tight hammer on
+            # whatever just failed
+            elif isinstance(node, ast.While) and _gl008_is_const_true(node.test):
+                retries = any(
+                    isinstance(sub, ast.Try)
+                    and any(
+                        any(isinstance(x, ast.Continue) for x in ast.walk(h))
+                        for h in sub.handlers
+                    )
+                    for sub in ast.walk(node)
+                )
+                if retries and not _gl008_has_pacing(node):
+                    out.append(
+                        Finding(
+                            "GL008", m.rel, node.lineno, node.col_offset,
+                            "`while True` retry loop with no backoff — a "
+                            "failing dependency gets hammered at CPU speed; "
+                            "add exponential backoff (and an attempt cap) "
+                            "or bound the loop",
+                            f"GL008:{m.rel}:{m.enclosing_def(node)}:retry",
+                        )
+                    )
     return out

@@ -220,6 +220,13 @@ def run(task_id: int, rename_thread: bool = True):
                 cur.name = f"bg:{t.kind}:{t.target}" if t.target else f"bg:{t.kind}"
     err: Optional[BaseException] = None
     try:
+        if t is not None:
+            # chaos hook: every background-task body is an injection site
+            # (`bg.<kind>` family) — an injected error/panic resolves the
+            # record as failed exactly like a real body crash would
+            from surrealdb_tpu import faults
+
+            faults.fire(f"bg.{t.kind}")
         yield t
     except BaseException as e:
         err = e
@@ -287,6 +294,7 @@ def spawn_service(
     fn: Callable,
     *args,
     owner: Optional[int] = None,
+    restart: bool = False,
 ) -> threading.Thread:
     """Register + start a long-lived WORKER LOOP (WS notification pump,
     WS request-pool worker, SDK reader, server tick loop): a daemon thread
@@ -295,7 +303,16 @@ def spawn_service(
     exists for ATTRIBUTION (deterministic `bg:<kind>:<target>` thread name,
     flight-recorder visibility, stack-dump identification). The entry
     flips to done/failed when the loop exits. Returns the Thread (callers
-    that join on their own teardown need it)."""
+    that join on their own teardown need it).
+
+    `restart=True` supervises the loop: a body that dies on an UNCAUGHT
+    exception (including a panic-class BaseException) is re-run on the
+    same thread after an exponential backoff (cnf.BG_SERVICE_BACKOFF_*,
+    capped; reset after a healthy run) with `bg_service_restarts{kind}`
+    counting each revival — a crashed pump degrades to a hiccup instead of
+    dying silently. A NORMAL return (connection closed, stop flag) always
+    ends the loop; supervisable services must encode shutdown as a return,
+    not an exception."""
     tid = register(kind, target, owner=owner, deadline=float("inf"))
     with _lock:
         rec = _tasks.get(tid)
@@ -303,11 +320,32 @@ def spawn_service(
             rec.service = True
 
     def body():
-        try:
-            with run(tid):
-                fn(*args)
-        except Exception:
-            pass  # the registry record carries the error
+        from surrealdb_tpu import cnf, telemetry
+
+        backoff = max(cnf.BG_SERVICE_BACKOFF_BASE_SECS, 0.01)
+        while True:
+            started = time.monotonic()
+            try:
+                with run(tid):
+                    fn(*args)
+                return  # normal exit: the service is done for good
+            except BaseException:
+                # the registry record carries the error either way
+                if not restart:
+                    return
+                with _lock:
+                    rec = _tasks.get(tid)
+                    if rec is not None:
+                        rec.retries += 1
+                telemetry.inc("bg_service_restarts", kind=kind)
+                if time.monotonic() - started >= max(
+                    cnf.BG_SERVICE_HEALTHY_RESET_SECS, 1.0
+                ):
+                    backoff = max(cnf.BG_SERVICE_BACKOFF_BASE_SECS, 0.01)
+                time.sleep(min(backoff, max(cnf.BG_SERVICE_BACKOFF_MAX_SECS, 0.01)))
+                backoff = min(
+                    backoff * 2, max(cnf.BG_SERVICE_BACKOFF_MAX_SECS, 0.01)
+                )
 
     t = threading.Thread(
         target=body,

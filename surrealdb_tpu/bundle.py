@@ -11,12 +11,16 @@ versioned document — the artifact you attach to any perf report:
 5. `compiles`      — the XLA compile-event log (compile_log.py):
                      prewarm vs on-demand, per-shape cache hits;
 6. `engine`        — dispatch stats + width distribution, column-mirror /
-                     graph-CSR / vector-mirror staleness states, and
-                     per-subsystem mirror memory watermarks;
+                     graph-CSR / vector-mirror staleness states,
+                     per-subsystem mirror memory watermarks, and — on a
+                     cluster node — the cluster view (replication factor,
+                     per-node probe/breaker state, admission counters);
 7. `locks`         — the concurrency sanitizer's report (utils/locks.py):
                      observed lock-acquisition edges, order cycles and
                      guarded-state violations (populated under
-                     SURREAL_SANITIZE=1; enabled=false otherwise).
+                     SURREAL_SANITIZE=1; enabled=false otherwise);
+8. `faults`        — the failpoint engine's state (faults.py): armed
+                     sites, per-site trip counters, the chaos seed.
 
 Served by `GET /debug/bundle` (system-user-gated) and embedded via
 `INFO FOR ROOT` (`system.bundle`); bench.py embeds one per artifact so a
@@ -30,18 +34,19 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, Optional
 
-BUNDLE_SCHEMA = "surrealdb-tpu-bundle/1"
+BUNDLE_SCHEMA = "surrealdb-tpu-bundle/2"
 
 # the sections every consumer may rely on
 SECTIONS = (
-    "traces", "slow_queries", "errors", "tasks", "compiles", "engine", "locks",
+    "traces", "slow_queries", "errors", "tasks", "compiles", "engine",
+    "locks", "faults",
 )
 
 
 def debug_bundle(
     ds=None, trace_limit: int = 50, full_traces: int = 10
 ) -> Dict[str, Any]:
-    from surrealdb_tpu import bg, compile_log, telemetry, tracing
+    from surrealdb_tpu import bg, compile_log, faults, telemetry, tracing
     from surrealdb_tpu.utils import locks
 
     ids = tracing.trace_ids()
@@ -65,6 +70,7 @@ def debug_bundle(
         "compiles": compile_log.snapshot(),
         "engine": _engine_state(ds),
         "locks": locks.report(),
+        "faults": faults.snapshot(),
     }
     return out
 
@@ -92,6 +98,30 @@ def _engine_state(ds) -> Dict[str, Any]:
         out["memory_bytes"] = telemetry.mirror_memory_bytes(ds)
     except Exception:  # noqa: BLE001 — a bundle must never fail its caller
         out["memory_bytes"] = {}
+    try:
+        out["cluster"] = _cluster_state(ds)
+    except Exception:  # noqa: BLE001
+        out["cluster"] = None
+    return out
+
+
+def _cluster_state(ds) -> Optional[Dict[str, Any]]:
+    """Cluster fault-tolerance view: per-node probe/breaker state (the
+    thing you read when a `degraded` flag shows up) + admission counters."""
+    node = getattr(ds, "cluster", None)
+    if node is None:
+        return None
+    from surrealdb_tpu import cnf
+
+    out: Dict[str, Any] = {
+        "node_id": node.node_id,
+        "members": [n["id"] for n in node.config.nodes],
+        "rf": max(min(cnf.CLUSTER_RF, len(node.config.nodes)), 1),
+    }
+    if node.client is not None:
+        out["nodes"] = node.client.probe_state()
+    if node.executor is not None:
+        out["admission"] = node.executor.admission.stats()
     return out
 
 

@@ -26,6 +26,12 @@ def gc_all(ds) -> int:
     )
     deleted = 0
     with bg.run(task_id, rename_thread=False):
+        from surrealdb_tpu import faults
+
+        # chaos hook: a GC sweep that dies must surface through the task
+        # registry (failed) and the tick loop's supervision — never wedge
+        # the commit lock or leak its transaction
+        faults.fire("cf.gc")
         txn = ds.transaction(write=True)
         try:
             now = ds.clock.now_nanos()

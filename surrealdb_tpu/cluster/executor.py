@@ -14,24 +14,53 @@ Every statement arriving at a cluster node routes through here:
   scores exactly as one corpus; score-merged rows feed the local pipeline.
 - **Graph idioms** (`SELECT ->e->t FROM ...`) exchange frontier sets per
   hop: each hop broadcasts the frontier, every node expands the records it
-  holds, and the per-id maps union into the next frontier.
-- **Writes** route by record ownership (consistent hash): CREATE/UPSERT/
-  INSERT to the owner (ids pre-generated so placement is deterministic),
-  RELATE to the `from` record's owner (edges colocate with their source),
-  UPDATE/DELETE broadcast (non-owners match nothing). DDL broadcasts so
+  holds, and the per-id maps merge (max-multiplicity across nodes, so a
+  replicated pointer key counts once) into the next frontier.
+- **Writes** replicate by record ownership: CREATE/UPSERT/INSERT land on
+  the hash owner PLUS its RF-1 ring successors (cnf.CLUSTER_RF, ids
+  pre-generated so placement is deterministic), RELATE on the `from`
+  record's replica set (edges colocate with their source on every copy),
+  UPDATE/DELETE broadcast (non-holders match nothing). DDL broadcasts so
   schema exists on every member.
 
+Fault tolerance (the RF-replication payoff):
+
+- **Replica reads**: scatter reads tolerate up to RF-1 down nodes — every
+  record a dead node owned has a live replica that already answered, so the
+  gathered rows (deduplicated by record id) are still COMPLETE. The
+  response carries a `degraded: true` flag and `cluster_failover_total`
+  counts the covered failures. Beyond RF-1 down nodes the read errors
+  clearly (coverage can no longer be proven).
+- **Bounded retries**: IDEMPOTENT ops (reads, stats, expand, ping) retry on
+  node failure with exponential backoff + jitter, capped per call
+  (CLUSTER_RETRY_MAX) and per statement (CLUSTER_RETRY_BUDGET). Writes
+  NEVER retry — a timed-out write may have applied, and a blind re-send
+  would double-apply.
+- **Degraded writes**: a write acks once every LIVE replica applied it; a
+  down replica is tolerated (degraded, counted) and catches up only via
+  rebalance (ROADMAP). With one node down a freshly-acked write still has
+  ≥1 live copy, so a SINGLE failure never loses acknowledged data.
+- **Admission control**: at most CLUSTER_MAX_INFLIGHT statements execute
+  concurrently; a bounded wait queue absorbs bursts and everything beyond
+  it sheds immediately with a retryable error (`cluster_shed_total`) —
+  overload degrades to bounded latency, not collapse.
+
 Unsupported in cluster mode (clear errors, never wrong answers): explicit
-transactions, LIVE/KILL, FETCH, and UPSERT on a bare table target.
+transactions, LIVE/KILL, FETCH, UPSERT on a bare table target, and — with
+replication — write RETURN shapes that cannot be deduplicated by record id
+(RETURN VALUE/DIFF/NULL on broadcast writes).
 """
 
 from __future__ import annotations
 
 import contextvars
+import random as _random
+import threading
 import time as _time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
+from surrealdb_tpu import cnf
 from surrealdb_tpu.err import SurrealError
 from surrealdb_tpu.sql.ast import (
     FunctionCall,
@@ -53,6 +82,7 @@ from surrealdb_tpu.sql.statements import (
     CreateStatement,
     DefineStatement,
     DeleteStatement,
+    Field,
     InfoStatement,
     InsertStatement,
     KillStatement,
@@ -79,11 +109,16 @@ from surrealdb_tpu.sql.value import (
 )
 
 from . import merge as _merge
-from .client import ClusterError
+from .client import ClusterError, NodeUnavailableError
 
 _DIST = "__cluster_dist"
 _SCORE = "__cluster_score"
 _ROWS = "__cluster_rows"
+_RID = "__cluster_rid"
+
+
+class ClusterOverloadedError(ClusterError):
+    """Admission control shed this statement — retryable by construction."""
 
 
 def _fmt_time(seconds: float) -> str:
@@ -102,6 +137,91 @@ def _err(msg: str) -> dict:
     return {"status": "ERR", "result": msg}
 
 
+class _StmtCtx:
+    """Per-statement fault accounting: the shared retry budget every
+    scatter draws from, and the degraded/failed-node view that ends up on
+    the response. Mutated from pool threads — guarded by a raw lock."""
+
+    __slots__ = ("degraded", "failed_nodes", "_budget", "_lock")
+
+    def __init__(self, budget: int):
+        self.degraded = False
+        self.failed_nodes: set = set()
+        self._budget = max(int(budget), 0)
+        self._lock = threading.Lock()
+
+    def take_retry(self) -> bool:
+        with self._lock:
+            if self._budget <= 0:
+                return False
+            self._budget -= 1
+            return True
+
+    def note_failover(self, node_id: str) -> None:
+        with self._lock:
+            self.failed_nodes.add(node_id)
+            self.degraded = True
+
+
+_STMT: "contextvars.ContextVar[Optional[_StmtCtx]]" = contextvars.ContextVar(
+    "cluster_stmt", default=None
+)
+
+
+class _Admission:
+    """Semaphore-bounded statement admission with a bounded wait queue:
+    inflight <= CLUSTER_MAX_INFLIGHT, at most CLUSTER_ADMIT_QUEUE waiters
+    (each waiting at most CLUSTER_ADMIT_WAIT_SECS), everything else sheds
+    fast — the coordinator's latency stays bounded under overload."""
+
+    def __init__(self):
+        self._cv = threading.Condition(threading.Lock())
+        self._inflight = 0
+        self._waiters = 0
+
+    def acquire(self) -> None:
+        from surrealdb_tpu import telemetry
+
+        cap = max(cnf.CLUSTER_MAX_INFLIGHT, 1)
+        with self._cv:
+            if self._inflight < cap:
+                self._inflight += 1
+                return
+            if self._waiters >= max(cnf.CLUSTER_ADMIT_QUEUE, 0):
+                reason = "queue_full"
+            else:
+                self._waiters += 1
+                try:
+                    deadline = _time.monotonic() + max(
+                        cnf.CLUSTER_ADMIT_WAIT_SECS, 0.0
+                    )
+                    while self._inflight >= cap:
+                        left = deadline - _time.monotonic()
+                        if left <= 0:
+                            break
+                        self._cv.wait(left)
+                    if self._inflight < cap:
+                        self._inflight += 1
+                        return
+                    reason = "wait_timeout"
+                finally:
+                    self._waiters -= 1
+        telemetry.inc("cluster_shed_total", reason=reason)
+        raise ClusterOverloadedError(
+            "coordinator overloaded: statement shed by admission control "
+            f"({reason}); the request is safe to retry"
+        )
+
+    def release(self) -> None:
+        with self._cv:
+            self._inflight -= 1
+            self._cv.notify()
+
+    def stats(self) -> Dict[str, int]:
+        with self._cv:
+            return {"inflight": self._inflight, "waiting": self._waiters}
+
+
 class ClusterExecutor:
     def __init__(self, ds, node):
         self.ds = ds
@@ -114,6 +234,7 @@ class ClusterExecutor:
             max_workers=max(4 * len(node.config.nodes), 8),
             thread_name_prefix="cluster-scatter",
         )
+        self.admission = _Admission()
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)
@@ -130,7 +251,12 @@ class ClusterExecutor:
             sources = ast.sources or [repr(s) for s in ast.statements]
             for stm, src in zip(ast.statements, sources):
                 t0 = _time.perf_counter()
+                ctx = _StmtCtx(cnf.CLUSTER_RETRY_BUDGET)
+                token = _STMT.set(ctx)
+                admitted = False
                 try:
+                    self.admission.acquire()
+                    admitted = True
                     resp = self._route(stm, src, session, vars)
                 except ClusterError as e:
                     resp = _err(str(e))
@@ -138,6 +264,15 @@ class ClusterExecutor:
                     resp = _err(str(e))
                 except Exception as e:  # noqa: BLE001 — mirror Executor's guard
                     resp = _err(f"Internal error: {type(e).__name__}: {e}")
+                finally:
+                    _STMT.reset(token)
+                    if admitted:
+                        self.admission.release()
+                if ctx.degraded:
+                    # the answer is complete (replicas covered) but a node
+                    # was down — callers polling for cluster health read it
+                    # here instead of diffing counters
+                    resp["degraded"] = True
                 resp["time"] = _fmt_time(_time.perf_counter() - t0)
                 out.append(resp)
             return out
@@ -204,7 +339,19 @@ class ClusterExecutor:
     def _all_nodes(self) -> List[str]:
         return [n["id"] for n in self.node.config.nodes]
 
-    def _call(self, node_id: str, op: str, req: Dict[str, Any]) -> Dict[str, Any]:
+    def _rf(self) -> int:
+        """Effective replication factor: the knob clamped to membership."""
+        return max(min(cnf.CLUSTER_RF, len(self.node.config.nodes)), 1)
+
+    def _down_nodes(self) -> set:
+        client = self.node.client
+        return set(client.down_nodes()) if client is not None else set()
+
+    def _replicas(self, tb: str, rid) -> List[str]:
+        """The record's replica set (primary first, ring order)."""
+        return self.node.ring.owners_of(tb, rid, self._rf())
+
+    def _call_once(self, node_id: str, op: str, req: Dict[str, Any]) -> Dict[str, Any]:
         """One cluster op; the self node short-circuits in-process (its
         spans nest naturally — no export/graft round trip)."""
         from surrealdb_tpu import telemetry
@@ -216,13 +363,75 @@ class ClusterExecutor:
                 return _rpc._OPS[op](self.ds, req)
         return self.node.client.call(node_id, op, req)
 
-    def _fan_out(self, node_ids: List[str], op: str, req: Dict[str, Any]) -> Dict[str, dict]:
-        """Scatter one op to several nodes concurrently; raises the first
-        node failure (a down shard owner must surface as a per-shard error,
-        not a partial answer). Contextvars are copied into the pool threads
-        so every remote call records into the coordinating request's trace."""
+    def _call(
+        self, node_id: str, op: str, req: Dict[str, Any], idempotent: bool = False
+    ) -> Dict[str, Any]:
+        """One cluster op with the bounded retry policy: IDEMPOTENT ops
+        retry on node failure with exponential backoff + jitter, capped per
+        call and by the statement's shared retry budget. Writes never
+        retry (a timed-out write may have applied — re-sending would
+        double-apply); breaker fast-fails never retry (pointless); SLOW
+        failures (the attempt burned a meaningful slice of the RPC
+        deadline — the node is hanging, not glitching) never retry either:
+        replica failover covers them at zero extra latency, while a blind
+        retry would double the time a dead node costs."""
+        from surrealdb_tpu import telemetry
+
+        attempt = 0
+        while True:
+            t0 = _time.monotonic()
+            try:
+                return self._call_once(node_id, op, req)
+            except NodeUnavailableError as e:
+                ctx = _STMT.get(None)
+                slow = (_time.monotonic() - t0) >= 0.5 * max(
+                    cnf.CLUSTER_RPC_TIMEOUT_SECS, 0.1
+                )
+                if (
+                    not idempotent
+                    or slow
+                    or not getattr(e, "retryable", True)
+                    or attempt >= max(cnf.CLUSTER_RETRY_MAX, 0)
+                    or ctx is None
+                    or not ctx.take_retry()
+                ):
+                    raise
+                delay = min(
+                    max(cnf.CLUSTER_RETRY_BASE_SECS, 0.001) * (2 ** attempt),
+                    max(cnf.CLUSTER_RETRY_MAX_SECS, 0.001),
+                )
+                # full jitter halves the thundering-herd re-arrival spike
+                _time.sleep(delay * (0.5 + 0.5 * _random.random()))
+                attempt += 1
+                telemetry.inc("cluster_retries", op=op)
+
+    def _fan_out(
+        self,
+        node_ids: List[str],
+        op: str,
+        req: Dict[str, Any],
+        idempotent: bool = False,
+        tolerate_down: bool = False,
+    ) -> Dict[str, dict]:
+        """Scatter one op to several nodes concurrently. With
+        `tolerate_down` (replicated reads) up to RF-1 distinct DOWN nodes
+        are survivable: their records have live replicas that already
+        answered, so the partial gather is still complete — the statement
+        flags `degraded` and `cluster_failover_total` counts the failover.
+        Everything else (op errors, too many nodes down) raises.
+        Contextvars are copied into the pool threads so every remote call
+        records into the coordinating request's trace."""
+        from surrealdb_tpu import telemetry
+
         if len(node_ids) == 1:
-            return {node_ids[0]: self._call(node_ids[0], op, req)}
+            nid = node_ids[0]
+            try:
+                return {nid: self._call(nid, op, req, idempotent=idempotent)}
+            except NodeUnavailableError as e:
+                if not self._tolerable(tolerate_down, e):
+                    raise
+                telemetry.inc("cluster_failover_total", op=op)
+                return {}
 
         out: Dict[str, dict] = {}
         # one context COPY per target, captured on the submitting thread:
@@ -230,7 +439,8 @@ class ClusterExecutor:
         # are GIL-atomic) without sharing a Context
         futs = {
             nid: self._pool.submit(
-                contextvars.copy_context().run, self._call, nid, op, req
+                contextvars.copy_context().run,
+                self._call, nid, op, req, idempotent,
             )
             for nid in node_ids
         }
@@ -238,14 +448,43 @@ class ClusterExecutor:
         for nid, fut in futs.items():
             try:
                 out[nid] = fut.result()
+            except NodeUnavailableError as e:
+                if self._tolerable(tolerate_down, e):
+                    telemetry.inc("cluster_failover_total", op=op)
+                else:
+                    errs.append(e)
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 errs.append(e)
         if errs:
             raise errs[0]
         return out
 
+    def _tolerable(self, tolerate_down: bool, e: NodeUnavailableError) -> bool:
+        """A node failure is survivable when replication can prove the
+        answer still covers: at most RF-1 DISTINCT nodes down across this
+        statement. Records the failover into the statement context."""
+        if not tolerate_down:
+            return False
+        rf = self._rf()
+        if rf <= 1:
+            return False
+        ctx = _STMT.get(None)
+        if ctx is None:
+            return False
+        nid = getattr(e, "node_id", None)
+        with ctx._lock:
+            failed = set(ctx.failed_nodes)
+            if nid is not None:
+                failed.add(nid)
+        if len(failed) > rf - 1:
+            return False
+        if nid is not None:
+            ctx.note_failover(nid)
+        return True
+
     def _scatter_sql(
         self, node_ids: List[str], sql: str, session, vars,
+        idempotent: bool = False, tolerate_down: bool = False,
     ) -> Dict[str, List[dict]]:
         """Run one statement on several nodes; returns node -> responses.
         Any remote statement-level ERR raises (partial scatters must not
@@ -256,7 +495,10 @@ class ClusterExecutor:
             "db": session.db,
             "vars": vars or None,
         }
-        gathered = self._fan_out(node_ids, "query", req)
+        gathered = self._fan_out(
+            node_ids, "query", req,
+            idempotent=idempotent, tolerate_down=tolerate_down,
+        )
         out: Dict[str, List[dict]] = {}
         for nid, resp in gathered.items():
             results = resp.get("results") or []
@@ -268,15 +510,60 @@ class ClusterExecutor:
             out[nid] = results
         return out
 
-    def _gather_rows(self, per_node: Dict[str, List[dict]]) -> List[Any]:
+    def _gather_rows(
+        self, per_node: Dict[str, List[dict]], dedup: bool = False,
+        dedup_key: str = "id",
+    ) -> List[Any]:
+        """Concatenate per-node result rows in node-sorted order. With
+        replication (`dedup`) rows that carry a record id appear once per
+        holding replica. Identical copies keep the first (node-sorted,
+        deterministic). Copies that DIFFER — a replica missed a write and
+        is serving stale data — keep the one from the EARLIEST replica in
+        the record's ring order: that is the write-reporter rule, so an
+        acknowledged write is always served whenever its reporter answered
+        (the ring lookup is paid only on actual divergence, and
+        `cluster_read_divergence` counts it so the stale copy is an
+        operator-visible repair item, not a silent coin flip). Rows
+        without a usable id pass through."""
+        from surrealdb_tpu import telemetry
+
         rows: List[Any] = []
+        if not dedup:
+            for nid in sorted(per_node):
+                for resp in per_node[nid]:
+                    r = resp.get("result")
+                    if isinstance(r, list):
+                        rows.extend(r)
+                    elif r is not None and not is_none(r):
+                        rows.append(r)
+            return rows
+        by_id: Dict[str, Tuple[int, str]] = {}  # repr(id) -> (out idx, src node)
         for nid in sorted(per_node):
             for resp in per_node[nid]:
                 r = resp.get("result")
-                if isinstance(r, list):
-                    rows.extend(r)
-                elif r is not None and not is_none(r):
-                    rows.append(r)
+                batch = r if isinstance(r, list) else (
+                    [r] if r is not None and not is_none(r) else []
+                )
+                for row in batch:
+                    rid = row.get(dedup_key) if isinstance(row, dict) else None
+                    if not isinstance(rid, Thing):
+                        rows.append(row)
+                        continue
+                    key = repr(rid)
+                    if key not in by_id:
+                        by_id[key] = (len(rows), nid)
+                        rows.append(row)
+                        continue
+                    idx, kept_nid = by_id[key]
+                    if nid == kept_nid or row == rows[idx]:
+                        continue
+                    telemetry.inc("cluster_read_divergence")
+                    rank = {
+                        n: i for i, n in enumerate(self._replicas(rid.tb, rid.id))
+                    }
+                    if rank.get(nid, len(rank)) < rank.get(kept_nid, len(rank)):
+                        rows[idx] = row
+                        by_id[key] = (idx, nid)
         return rows
 
     def _local_stm(self, src: str, session, vars) -> dict:
@@ -312,11 +599,12 @@ class ClusterExecutor:
                 out.append(v)
         return out
 
-    def _owner(self, tb: str, rid) -> str:
-        return self.node.ring.owner_of(tb, rid)
-
     # ------------------------------------------------------------ DDL
     def _ddl_broadcast(self, src: str, session, vars) -> dict:
+        """Schema changes require EVERY member — a DDL applied to a subset
+        leaves the membership schema-diverged, which no later read can
+        detect. A down node therefore errors the DDL (reads/writes degrade;
+        schema does not)."""
         from surrealdb_tpu import telemetry
 
         with telemetry.span("cluster_scatter", kind="ddl"):
@@ -330,8 +618,11 @@ class ClusterExecutor:
 
     # ------------------------------------------------------------ writes
     def _write_broadcast(self, stm, src: str, session, vars) -> dict:
-        """UPDATE/DELETE: every member applies the statement to its shard
-        (non-owners match nothing); merged rows return in scan order.
+        """UPDATE/DELETE: every member applies the statement to its local
+        copies (non-holders match nothing); merged rows dedup by record id
+        (each record answers once per holding replica) and return in scan
+        order. A down node is tolerated within RF-1 — its replicas applied
+        the write; the dead copy catches up only via rebalance (degraded).
 
         Deliberately broadcast even for id-addressed targets: edge records
         colocate with their FROM record's owner (not their hash owner), so
@@ -339,9 +630,20 @@ class ClusterExecutor:
         correctness over the N-1 no-op RPCs."""
         from surrealdb_tpu import telemetry
 
+        rf = self._rf()
+        out_kind = getattr(getattr(stm, "output", None), "kind", None)
+        if rf > 1 and out_kind in ("fields", "diff", "null"):
+            return _err(
+                "RETURN VALUE/DIFF/NULL on a broadcast write cannot be "
+                "deduplicated across replicas — use RETURN AFTER, BEFORE "
+                "or NONE in cluster mode"
+            )
         with telemetry.span("cluster_scatter", kind="write"):
-            per_node = self._scatter_sql(self._all_nodes(), src, session, vars)
-        rows = self._gather_rows(per_node)
+            per_node = self._scatter_sql(
+                self._all_nodes(), src, session, vars,
+                tolerate_down=rf > 1,
+            )
+        rows = self._gather_rows(per_node, dedup=rf > 1)
         if rows and all(isinstance(r, dict) and "id" in r for r in rows):
             # FROM-source rank first (a multi-table UPDATE returns table by
             # table on a single node), key order within each source
@@ -352,10 +654,80 @@ class ClusterExecutor:
             return _ok(rows[0] if rows else NONE)
         return _ok(rows)
 
+    def _write_replicas(
+        self, replicas: List[str], sql: str, session, vars,
+    ) -> List[Any]:
+        """One routed write against a record's replica set: every LIVE
+        replica must apply it; a down replica is tolerated (degraded —
+        rebalance owns the catch-up) as long as at least one copy landed.
+        The FIRST live replica in ring order is the reporter whose output
+        rows become the statement result (so RETURN shapes need no
+        cross-replica dedup). Writes never retry."""
+        from surrealdb_tpu import telemetry
+
+        req = {"sql": sql, "ns": session.ns, "db": session.db, "vars": vars or None}
+        gathered: Dict[str, dict] = {}
+        down: List[NodeUnavailableError] = []
+        futs = {
+            nid: self._pool.submit(
+                contextvars.copy_context().run,
+                self._call, nid, "query", req, False,
+            )
+            for nid in replicas
+        }
+        for nid, fut in futs.items():
+            try:
+                gathered[nid] = fut.result()
+            except NodeUnavailableError as e:
+                down.append(e)
+        if not gathered:
+            raise down[0] if down else SurrealError("write reached no replica")
+        if down:
+            ctx = _STMT.get(None)
+            for e in down:
+                telemetry.inc("cluster_failover_total", op="write")
+                if ctx is not None and getattr(e, "node_id", None) is not None:
+                    ctx.note_failover(e.node_id)
+        reporter = next(nid for nid in replicas if nid in gathered)
+        results = gathered[reporter].get("results") or []
+        for r in results:
+            if r.get("status") != "OK":
+                # the statement fails — but another replica may ALREADY
+                # have applied it durably: that is a divergence (a 'failed'
+                # write that reads can serve), and it must be counted, not
+                # silent, exactly like the mirror case below
+                for nid, resp in gathered.items():
+                    if nid != reporter and all(
+                        x.get("status") == "OK"
+                        for x in resp.get("results") or []
+                    ):
+                        telemetry.inc("cluster_write_divergence")
+                        break
+                raise SurrealError(f"cluster node {reporter!r}: {r.get('result')}")
+        # a NON-reporter replica that answered but failed the op leaves a
+        # diverged copy behind: the write still acks (the canonical copy
+        # landed) but degrades — rebalance owns the repair
+        for nid, resp in gathered.items():
+            if nid == reporter:
+                continue
+            if any(r.get("status") != "OK" for r in resp.get("results") or []):
+                telemetry.inc("cluster_failover_total", op="write")
+                ctx = _STMT.get(None)
+                if ctx is not None:
+                    ctx.note_failover(nid)
+        rows: List[Any] = []
+        for resp in results:
+            r = resp.get("result")
+            if isinstance(r, list):
+                rows.extend(r)
+            elif r is not None and not is_none(r):
+                rows.append(r)
+        return rows
+
     def _create_route(self, stm, session, vars, verb: str) -> dict:
-        """CREATE / UPSERT: each target record routes to its hash owner;
-        bare-table CREATE pre-generates the id so placement is
-        deterministic."""
+        """CREATE / UPSERT: each target record lands on its whole replica
+        set (hash owner + RF-1 successors); bare-table CREATE pre-generates
+        the id so placement is deterministic."""
         from surrealdb_tpu import telemetry
 
         targets = self._flatten_targets(self._eval_exprs(stm.what, session, vars))
@@ -380,10 +752,11 @@ class ClusterExecutor:
             with telemetry.span("cluster_scatter", kind="write"):
                 for t in things:
                     stm.what = [Literal(t)]
-                    per_node = self._scatter_sql(
-                        [self._owner(t.tb, t.id)], repr(stm), session, vars
+                    rows.extend(
+                        self._write_replicas(
+                            self._replicas(t.tb, t.id), repr(stm), session, vars
+                        )
                     )
-                    rows.extend(self._gather_rows(per_node))
         finally:
             stm.what = saved_what
         if getattr(stm, "only", False):
@@ -406,8 +779,8 @@ class ClusterExecutor:
         tb = str(into[0])
         rows = self._insert_rows(stm, session, vars)
         # pre-assign missing ids so placement is deterministic, then route
-        # each row to its owner
-        by_owner: Dict[str, List[Tuple[int, dict]]] = {}
+        # each row to its replica set (owner + RF-1 ring successors)
+        by_replicas: Dict[Tuple[str, ...], List[Tuple[int, dict]]] = {}
         for i, row in enumerate(rows):
             if not isinstance(row, dict):
                 return _err("cluster INSERT rows must be objects")
@@ -416,7 +789,12 @@ class ClusterExecutor:
                 src = row.get("in")
                 if not isinstance(src, Thing):
                     return _err("cluster INSERT RELATION rows need an `in` record id")
-                owner = self._owner(src.tb, src.id)
+                # pre-assign the EDGE id too: each replica executing the
+                # routed batch must materialize the same edge record
+                rid = row.get("id")
+                if rid is None or is_none(rid):
+                    row["id"] = generate_record_id()
+                replicas = self._replicas(src.tb, src.id)
             else:
                 rid = row.get("id")
                 if rid is None or is_none(rid):
@@ -424,8 +802,8 @@ class ClusterExecutor:
                     rid = row["id"]
                 if isinstance(rid, Thing):
                     rid = rid.id
-                owner = self._owner(tb, rid)
-            by_owner.setdefault(owner, []).append((i, row))
+                replicas = self._replicas(tb, rid)
+            by_replicas.setdefault(tuple(replicas), []).append((i, row))
         from surrealdb_tpu.sql.value import escape_ident
 
         # InsertStatement repr does not round-trip (Data repr prints a
@@ -439,12 +817,11 @@ class ClusterExecutor:
         )
         indexed: List[Tuple[int, Any]] = []
         with telemetry.span("cluster_scatter", kind="write"):
-            for owner, batch in by_owner.items():
-                per_node = self._scatter_sql(
-                    [owner], sql, session,
+            for replicas, batch in by_replicas.items():
+                got = self._write_replicas(
+                    list(replicas), sql, session,
                     dict(vars or {}, **{_ROWS: [r for _, r in batch]}),
                 )
-                got = self._gather_rows(per_node)
                 indexed.extend(_align_insert_rows(tb, batch, got))
         indexed.sort(key=lambda p: p[0])
         return _ok([r for _, r in indexed])
@@ -476,31 +853,55 @@ class ClusterExecutor:
         raise SurrealError(f"cluster INSERT cannot route {data.kind!r} payloads")
 
     def _relate_route(self, stm, session, vars) -> dict:
-        """RELATE routes to the FROM record's owner — an edge record and
-        its pointer keys colocate with the source record, which is what
-        makes outbound graph expansion local-per-shard."""
+        """RELATE lands on the FROM record's replica set — an edge record
+        and its pointer keys colocate with every copy of the source record,
+        which is what keeps outbound graph expansion answerable after the
+        source's primary dies.
+
+        Edge ids are pre-generated ON THE COORDINATOR, one per
+        (from, with) pair: letting each replica mint its own random edge
+        id would leave the copies permanently diverged (the same edge
+        under two names), so the product expands here and every replica
+        executes the identical `RELATE from->edge:id->with` statement."""
         from surrealdb_tpu import telemetry
 
         froms = self._flatten_targets(self._eval_exprs([stm.from_], session, vars))
+        withs = self._flatten_targets(self._eval_exprs([stm.with_], session, vars))
+        for t in froms + withs:
+            if not isinstance(t, Thing):
+                return _err("cluster RELATE requires record-id FROM/WITH targets")
+        kind_v = self._eval_exprs([stm.kind], session, vars)[0]
+        if isinstance(kind_v, Thing):
+            edge_of = lambda f, w: kind_v  # explicit edge id: keep it
+        elif isinstance(kind_v, (Table, str)):
+            tb_kind = str(kind_v)
+            edge_of = lambda f, w: Thing(tb_kind, generate_record_id())
+        else:
+            return _err(f"cluster RELATE cannot route via {kind_v!r}")
+
+        by_replicas: Dict[Tuple[str, ...], List[Tuple[Thing, Thing, Thing]]] = {}
         for f in froms:
-            if not isinstance(f, Thing):
-                return _err("cluster RELATE requires record-id FROM targets")
-        by_owner: Dict[str, List[Thing]] = {}
-        for f in froms:
-            by_owner.setdefault(self._owner(f.tb, f.id), []).append(f)
-        saved = stm.from_
+            replicas = tuple(self._replicas(f.tb, f.id))
+            for w in withs:
+                by_replicas.setdefault(replicas, []).append((f, edge_of(f, w), w))
+        saved = (stm.from_, stm.with_, stm.kind)
         rows: List[Any] = []
         try:
             with telemetry.span("cluster_scatter", kind="write"):
-                for owner, batch in by_owner.items():
-                    stm.from_ = Param("__cluster_from")
-                    per_node = self._scatter_sql(
-                        [owner], repr(stm), session,
-                        dict(vars or {}, __cluster_from=batch),
+                for replicas, pairs in by_replicas.items():
+                    stmts = []
+                    for f, e, w in pairs:
+                        stm.from_, stm.kind, stm.with_ = (
+                            Literal(f), Literal(e), Literal(w),
+                        )
+                        stmts.append(repr(stm))
+                    rows.extend(
+                        self._write_replicas(
+                            list(replicas), "; ".join(stmts), session, vars,
+                        )
                     )
-                    rows.extend(self._gather_rows(per_node))
         finally:
-            stm.from_ = saved
+            stm.from_, stm.with_, stm.kind = saved
         if getattr(stm, "only", False):
             return _ok(rows[0] if rows else NONE)
         return _ok(rows)
@@ -632,16 +1033,40 @@ class ClusterExecutor:
     # ---- strategies
     def _colocated_select(self, stm, session, vars) -> dict:
         """Scatter the FULL statement (minus ORDER/LIMIT/START), gather the
-        already-projected rows, then apply ordering/limit locally."""
-        saved = (stm.order, stm.limit, stm.start)
+        already-projected rows, then apply ordering/limit locally. With
+        replication every holding replica answers, so the scattered
+        projection gains an `id AS __cluster_rid` carrier to dedup by —
+        VALUE-mode projections have nowhere to put it and refuse."""
+        rf = self._rf()
+        dedup = rf > 1
+        if dedup and getattr(stm, "value_mode", False):
+            return _err(
+                "SELECT VALUE over colocated projections cannot carry the "
+                "replica-dedup record id — project a field list in cluster "
+                "mode (replication is on)"
+            )
+        saved = (stm.order, stm.limit, stm.start, stm.fields)
         try:
             stm.order = stm.limit = stm.start = None
-            per_node = self._scatter_sql(self._all_nodes(), repr(stm), session, vars)
+            if dedup:
+                stm.fields = list(stm.fields) + [
+                    Field(_carrier_idiom("id"), alias=_carrier_idiom(_RID))
+                ]
+            per_node = self._scatter_sql(
+                self._all_nodes(), repr(stm), session, vars,
+                idempotent=True, tolerate_down=dedup,
+            )
         finally:
-            stm.order, stm.limit, stm.start = saved
-        rows = self._gather_rows(per_node)
+            stm.order, stm.limit, stm.start, stm.fields = saved
+        rows = self._gather_rows(per_node, dedup=dedup, dedup_key=_RID)
         if rows and all(isinstance(r, dict) and "id" in r for r in rows):
             rows = _merge.sort_rows_scan_order(rows, self._from_tables(stm, session, vars))
+        elif dedup and rows and all(isinstance(r, dict) and _RID in r for r in rows):
+            rows = _merge.sort_rows_scan_order_by(
+                rows, _RID, self._from_tables(stm, session, vars)
+            )
+        if dedup:
+            rows = _merge.strip_cluster_fields(rows)
         if not (stm.order or stm.limit or stm.start):
             if getattr(stm, "only", False):
                 return _ok(rows[0] if rows else NONE)
@@ -659,6 +1084,7 @@ class ClusterExecutor:
     def _scatter_select(self, stm, session, vars, knn=None, matches=None) -> dict:
         """The universal gather-then-replay strategy (see module doc)."""
         cond = getattr(stm, "cond", None)
+        rf = self._rf()
         extra_proj = ""
         scatter_vars = dict(vars or {})
         if knn is not None:
@@ -685,7 +1111,9 @@ class ClusterExecutor:
         if cond is not None:
             inner += f" WHERE {cond!r}"
         # LIMIT pushdown: safe only when the statement neither reorders nor
-        # aggregates (each shard then over-fetches exactly the global cap)
+        # aggregates (each shard then over-fetches exactly the global cap —
+        # still sound under replication: a record's local rank on any
+        # holding node is never worse than its global rank)
         push = self._static_limit(stm, session, vars)
         if (
             push is not None
@@ -698,8 +1126,11 @@ class ClusterExecutor:
         ):
             inner += f" LIMIT {push}"
 
-        per_node = self._scatter_sql(self._all_nodes(), inner, session, scatter_vars)
-        rows = self._gather_rows(per_node)
+        per_node = self._scatter_sql(
+            self._all_nodes(), inner, session, scatter_vars,
+            idempotent=True, tolerate_down=rf > 1,
+        )
+        rows = self._gather_rows(per_node, dedup=rf > 1)
         if knn is not None:
             rows = _merge.merge_topk(rows, int(knn.k), _DIST)
         elif matches is not None:
@@ -748,11 +1179,16 @@ class ClusterExecutor:
 
     def _ft_global_stats(self, stm, matches, session, vars) -> Optional[dict]:
         """Phase one of distributed BM25: merge every member's local corpus
-        statistics into the global df/dc/avgdl the shards will score with."""
+        statistics into the global df/dc/avgdl the shards will score with.
+        Under replication each node reports stats only for the docs it is
+        the FIRST LIVE replica of (the coordinator ships its liveness
+        view), so a doc counts exactly once — and a dead node's docs are
+        covered by their surviving replicas."""
         tables = self._from_tables(stm, session, vars)
         if len(tables) != 1 or not isinstance(matches.l, Idiom):
             return None
         query = self._eval_exprs([matches.r], session, vars)[0]
+        rf = self._rf()
         req = {
             "ns": session.ns,
             "db": session.db,
@@ -760,11 +1196,28 @@ class ClusterExecutor:
             "field": repr(matches.l),
             "query": str(query),
         }
-        gathered = self._fan_out(self._all_nodes(), "ft_stats", req)
-        return _merge.merge_ft_stats(list(gathered.values()))
+        for attempt in range(2):
+            targets = self._all_nodes()
+            if rf > 1:
+                down = self._down_nodes()
+                live = [n for n in targets if n not in down] or targets
+                req = dict(req, live=live, rf=rf)
+                targets = live
+            try:
+                gathered = self._fan_out(
+                    targets, "ft_stats", req, idempotent=True
+                )
+                return _merge.merge_ft_stats(list(gathered.values()))
+            except NodeUnavailableError:
+                # a believed-live node died mid-phase: the failed call just
+                # marked it down — re-plan responsibilities once and retry
+                if rf <= 1 or attempt:
+                    raise
+        return None  # unreachable (the loop returns or raises)
 
     # ---- graph frontier exchange
     def _graph_select(self, stm, session, vars, idiom: Idiom) -> dict:
+        rf = self._rf()
         targets = self._flatten_targets(self._eval_exprs(stm.what, session, vars))
         sources: List[Thing] = []
         for t in targets:
@@ -777,8 +1230,9 @@ class ClusterExecutor:
 
         # per-hop frontier exchange: broadcast each level's unique ids;
         # every member expands the pointers IT holds (empty elsewhere), and
-        # the per-id lists concatenate in node order — deterministic, and
-        # each pointer key exists on exactly one member
+        # the per-id lists merge across nodes by MAX MULTIPLICITY — a
+        # pointer key held by several replicas counts once, while distinct
+        # edges on distinct nodes all survive (deterministic: node order)
         hop_maps: List[Dict[str, Any]] = []
         frontier: List[Thing] = list(dict.fromkeys(sources))
         for part in idiom.parts:
@@ -792,13 +1246,19 @@ class ClusterExecutor:
                 "what": list(part.what or []),
                 "ids": frontier,
             }
-            gathered = self._fan_out(self._all_nodes(), "expand", req)
+            gathered = self._fan_out(
+                self._all_nodes(), "expand", req,
+                idempotent=True, tolerate_down=rf > 1,
+            )
             exp: Dict[str, Any] = {}
+            per_id_lists: Dict[str, List[list]] = {}
             for nid in sorted(gathered):
                 for k, v in (gathered[nid].get("map") or {}).items():
                     if not isinstance(v, list) or not v:
                         continue
-                    exp.setdefault(k, []).extend(v)
+                    per_id_lists.setdefault(k, []).append(v)
+            for k, lists in per_id_lists.items():
+                exp[k] = _merge.merge_hop_lists(lists)
             hop_maps.append(exp)
             nxt: List[Thing] = []
             seen = set()
@@ -842,10 +1302,14 @@ class ClusterExecutor:
     def _table_ids(self, tb: str, session) -> List[Thing]:
         from surrealdb_tpu.sql.value import escape_ident
 
+        rf = self._rf()
         per_node = self._scatter_sql(
-            self._all_nodes(), f"SELECT id FROM {escape_ident(tb)}", session, None
+            self._all_nodes(), f"SELECT id FROM {escape_ident(tb)}", session, None,
+            idempotent=True, tolerate_down=rf > 1,
         )
-        rows = _merge.sort_rows_scan_order(self._gather_rows(per_node), [tb])
+        rows = _merge.sort_rows_scan_order(
+            self._gather_rows(per_node, dedup=rf > 1), [tb]
+        )
         return [r["id"] for r in rows if isinstance(r, dict) and isinstance(r.get("id"), Thing)]
 
 
@@ -922,8 +1386,6 @@ def _find_operator(expr, klass):
 
 
 def _star_field():
-    from surrealdb_tpu.sql.statements import Field
-
     return Field(None, all_=True)
 
 
@@ -943,8 +1405,6 @@ def _rewrite_expr(expr):
 
 
 def _rewrite_field(f):
-    from surrealdb_tpu.sql.statements import Field
-
     if getattr(f, "all", False) or f.expr is None:
         return f
     new = _rewrite_expr(f.expr)

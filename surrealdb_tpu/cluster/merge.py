@@ -49,6 +49,52 @@ def sort_rows_scan_order(rows: List[Any], from_tables: List[str]) -> List[Any]:
     return sorted(rows, key=lambda r: (table_rank(r, ranks), id_sort_key(r)))
 
 
+def sort_rows_scan_order_by(
+    rows: List[Any], key_field: str, from_tables: List[str]
+) -> List[Any]:
+    """sort_rows_scan_order for PROJECTED rows that carry their record id
+    in a carrier field (`__cluster_rid`) instead of `id` — the colocated
+    scatter under replication."""
+    ranks = {tb: i for i, tb in enumerate(from_tables)}
+
+    def shim(r):
+        rid = r.get(key_field) if isinstance(r, dict) else None
+        return {"id": rid} if rid is not None else r
+
+    return sorted(
+        rows, key=lambda r: (table_rank(shim(r), ranks), id_sort_key(shim(r)))
+    )
+
+
+def merge_hop_lists(lists: List[list]) -> list:
+    """Merge one frontier id's per-node expansion lists by MAX MULTIPLICITY:
+    a value appears as often as the single node that reported it most. A
+    pointer key replicated on RF nodes therefore counts ONCE (each replica
+    reports it once), while distinct edges held by different nodes all
+    survive (each is the sole reporter of its own value), and a legitimate
+    within-node duplicate (a self-loop's `<->` endpoints) is preserved.
+    Deterministic: callers pass lists in sorted node order."""
+    from collections import Counter
+
+    if len(lists) == 1:
+        return list(lists[0])
+    need: Counter = Counter()
+    for lst in lists:
+        c = Counter(repr(v) for v in lst)
+        for k, n in c.items():
+            if n > need[k]:
+                need[k] = n
+    out: list = []
+    got: Counter = Counter()
+    for lst in lists:
+        for v in lst:
+            k = repr(v)
+            if got[k] < need[k]:
+                got[k] += 1
+                out.append(v)
+    return out
+
+
 def merge_topk(rows: List[dict], k: int, dist_field: str) -> List[dict]:
     """Per-shard kNN candidates -> global top-k by ascending distance
     (id-keyed tie-break). Rows missing the distance sort last."""

@@ -60,6 +60,27 @@ class HashRing:
             i = 0  # wrap
         return self._owners[self._points[i]]
 
+    def owners_of(self, tb: str, rid: Any, rf: int) -> List[str]:
+        """The record's replica set: primary + the next rf-1 DISTINCT nodes
+        clockwise from its ring position (replication walks the same ring
+        as placement, so membership changes move replicas the same ~1/N a
+        consistent hash promises)."""
+        return self.owners_of_key(placement_key(tb, rid), rf)
+
+    def owners_of_key(self, key: bytes, rf: int) -> List[str]:
+        rf = max(min(int(rf), len(self.node_ids)), 1)
+        h = _h64(key)
+        i = bisect.bisect_right(self._points, h)
+        out: List[str] = []
+        for step in range(len(self._points)):
+            p = self._points[(i + step) % len(self._points)]
+            nid = self._owners[p]
+            if nid not in out:
+                out.append(nid)
+                if len(out) == rf:
+                    break
+        return out
+
     def spread(self, keys) -> Dict[str, int]:
         """{node: owned count} over an iterable of placement keys (tests /
         INFO surface)."""

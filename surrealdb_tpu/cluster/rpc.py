@@ -30,11 +30,14 @@ def handle(ds, req: Dict[str, Any]) -> Dict[str, Any]:
     coordinator can distinguish node-down from op-failed."""
     from surrealdb_tpu import telemetry, tracing
 
+    from surrealdb_tpu import faults
+
     op = str(req.get("op", ""))
     fn = _OPS.get(op)
     try:
         if fn is None:
             raise SurrealError(f"unknown cluster op {op!r}")
+        faults.fire("cluster.rpc.handle")
         with telemetry.span("cluster_serve", op=op):
             out = fn(ds, req)
     except SurrealError as e:
@@ -103,15 +106,36 @@ def _op_ft_stats(ds, req):
     """Local corpus statistics for one search index + query: doc count,
     total doc length, per-term document frequency — phase one of the
     two-phase distributed BM25 (global stats, then globally-scored
-    postings)."""
+    postings).
+
+    Under replication (`rf` > 1 with a `live` node list in the request)
+    each node reports ONLY the docs it is the first live replica of — so a
+    doc replicated RF ways still counts once in the merged global stats,
+    and a dead node's docs are covered by their surviving replicas."""
     from surrealdb_tpu.dbs.executor import Executor
     from surrealdb_tpu.dbs.context import Context
     from surrealdb_tpu.idx.ft_index import FtIndex
     from surrealdb_tpu.idx.ft_mirror import FtMirror
 
+    from .placement import placement_key
+
     ns, db = req.get("ns"), req.get("db")
     tb, field = str(req.get("tb", "")), str(req.get("field", ""))
     query = str(req.get("query", ""))
+    doc_ok = None
+    filter_key = None
+    rf = int(req.get("rf") or 1)
+    live = [str(n) for n in (req.get("live") or [])]
+    node = getattr(ds, "cluster", None)
+    if rf > 1 and live and node is not None:
+        ring, self_id = node.ring, node.node_id
+        filter_key = (tuple(sorted(live)), rf)  # the mask's only inputs
+
+        def doc_ok(rid):  # first-live-replica responsibility (see above)
+            owners = ring.owners_of_key(placement_key(rid.tb, rid.id), rf)
+            serving = next((n for n in owners if n in live), None)
+            return serving == self_id
+
     sess = _session(req)
     ex = Executor(ds, sess)
     ctx = Context(ex, sess)
@@ -134,7 +158,7 @@ def _op_ft_stats(ds, req):
         mirror = ds.index_stores.get_or_create(ns, db, tb, ix["name"], FtMirror)
         mirror.ensure_built(ctx, ix)
         terms = FtIndex.for_index(None, ix).analyzer(ctx).terms(query)
-        dc, tl, df = mirror.term_stats(terms)
+        dc, tl, df = mirror.term_stats(terms, doc_ok=doc_ok, filter_key=filter_key)
         return {"dc": dc, "tl": tl, "df": df, "terms": terms}
     finally:
         ex._cancel()

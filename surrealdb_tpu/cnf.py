@@ -195,9 +195,54 @@ SCAN_DEADLINE_INTERVAL = _env_int("SURREAL_SCAN_DEADLINE_INTERVAL", 256)
 
 # Cluster mode (surrealdb_tpu/cluster/): inter-node RPC deadline — a dead
 # shard owner surfaces as a per-shard error after this long instead of a
-# hung query — and the liveness-probe pump interval per remote node.
+# hung query — and the liveness-probe pump interval per remote node (the
+# probe backs off exponentially up to PROBE_MAX while a node stays down).
 CLUSTER_RPC_TIMEOUT_SECS = _env_float("SURREAL_CLUSTER_RPC_TIMEOUT", 10.0)
 CLUSTER_PROBE_INTERVAL_SECS = _env_float("SURREAL_CLUSTER_PROBE_INTERVAL", 2.0)
+CLUSTER_PROBE_MAX_INTERVAL_SECS = _env_float("SURREAL_CLUSTER_PROBE_MAX_INTERVAL", 30.0)
+# Replication factor: record writes land on the hash-ring owner plus RF-1
+# distinct successors, and scatter reads tolerate up to RF-1 down nodes
+# (answers dedup by record id and flag `degraded`). Clamped to the
+# membership size; RF=1 restores the r10 single-copy behavior.
+CLUSTER_RF = _env_int("SURREAL_CLUSTER_RF", 2)
+# Bounded retry policy for IDEMPOTENT internal-channel ops (reads retry,
+# writes never double-apply): per-call attempt cap, exponential backoff
+# base/cap (jittered), and a per-STATEMENT retry budget shared by every
+# scatter the statement fans out.
+CLUSTER_RETRY_MAX = _env_int("SURREAL_CLUSTER_RETRY_MAX", 2)
+CLUSTER_RETRY_BASE_SECS = _env_float("SURREAL_CLUSTER_RETRY_BASE", 0.05)
+CLUSTER_RETRY_MAX_SECS = _env_float("SURREAL_CLUSTER_RETRY_MAX_BACKOFF", 1.0)
+CLUSTER_RETRY_BUDGET = _env_int("SURREAL_CLUSTER_RETRY_BUDGET", 4)
+# Per-node circuit breaker on the internal channel: this many consecutive
+# RPC failures open the breaker (calls fail fast, no socket); after the
+# cooldown one half-open trial (or a liveness-probe success) closes it.
+CLUSTER_BREAKER_THRESHOLD = _env_int("SURREAL_CLUSTER_BREAKER_THRESHOLD", 3)
+CLUSTER_BREAKER_COOLDOWN_SECS = _env_float("SURREAL_CLUSTER_BREAKER_COOLDOWN", 5.0)
+# Coordinator admission control: at most MAX_INFLIGHT statements execute
+# concurrently; up to ADMIT_QUEUE more wait up to ADMIT_WAIT seconds, and
+# everything beyond that sheds fast with a retryable error — overload
+# degrades to bounded latency instead of collapse.
+CLUSTER_MAX_INFLIGHT = _env_int("SURREAL_CLUSTER_MAX_INFLIGHT", 64)
+CLUSTER_ADMIT_QUEUE = _env_int("SURREAL_CLUSTER_ADMIT_QUEUE", 128)
+CLUSTER_ADMIT_WAIT_SECS = _env_float("SURREAL_CLUSTER_ADMIT_WAIT", 2.0)
+
+# Failpoint fault-injection engine (surrealdb_tpu/faults.py):
+# "site=action[:prob][:count],..." spec string + the seed that makes a
+# chaos schedule reproducible (None = unseeded).
+FAILPOINTS = os.environ.get("SURREAL_FAILPOINTS", "")
+FAULTS_SEED = (
+    _env_int("SURREAL_FAULTS_SEED", 0)
+    if os.environ.get("SURREAL_FAULTS_SEED") is not None
+    else None
+)
+
+# bg service-task supervision (bg.spawn_service(restart=True)): a service
+# loop that dies on an UNCAUGHT exception is restarted with exponential
+# backoff capped here; a loop that stayed healthy this long resets the
+# backoff ladder.
+BG_SERVICE_BACKOFF_BASE_SECS = _env_float("SURREAL_BG_SERVICE_BACKOFF_BASE", 0.2)
+BG_SERVICE_BACKOFF_MAX_SECS = _env_float("SURREAL_BG_SERVICE_BACKOFF_MAX", 30.0)
+BG_SERVICE_HEALTHY_RESET_SECS = _env_float("SURREAL_BG_SERVICE_HEALTHY_RESET", 60.0)
 
 # Changefeeds
 CHANGEFEED_GC_INTERVAL_SECS = _env_int("SURREAL_CHANGEFEED_GC_INTERVAL", 10)

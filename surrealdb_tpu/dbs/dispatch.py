@@ -307,11 +307,17 @@ class DispatchQueue:
             # on-demand XLA compile inside the launch is attributed to the
             # FIRST rider's trace (compile_log.attribution): exactly one
             # trace carries the compile span, the rest see a cache hit.
+            # The failpoint sits INSIDE the transient/deterministic triage:
+            # an injected `error-transient` exercises the real bisect-retry
+            # machinery, an injected plain error the rider fail-out.
             with tracing.detached(), compile_log.attribution(
                 batch[0].trace_ctx
             ), telemetry.span(
                 "dispatch_launch"
             ), telemetry.trace_annotation("dispatch_launch"):
+                from surrealdb_tpu import faults
+
+                faults.fire("dispatch.launch")
                 res = runner(payloads)
         except Exception as e:
             # transient device-side failures happen on tunneled/remote

@@ -476,12 +476,16 @@ class ColumnMirrors:
         commit version from the backend) returns False and the caller
         falls back to the debounced rebuild. Must run under the datastore
         commit lock — the version capture is only atomic there."""
-        from surrealdb_tpu import telemetry
+        from surrealdb_tpu import faults, telemetry
 
         def _decline(reason: str) -> bool:
             telemetry.inc("column_mirror_delta", outcome=reason)
             return False
 
+        # chaos hook: an injected failure here proves the decline contract —
+        # the commit stays durable, the caller falls back to the debounced
+        # rebuild, and a stale mirror cannot serve (version mismatch)
+        faults.fire("column.delta_apply")
         if not cnf.COLUMN_DELTA_FEED:
             return _decline("disabled")
         if commit_version is None:

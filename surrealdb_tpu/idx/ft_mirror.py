@@ -69,6 +69,12 @@ class FtMirror:
         self.t_tfs: Optional[np.ndarray] = None
         self.doclen_arr: Optional[np.ndarray] = None
         self._pending: Optional[List[tuple]] = None
+        # filtered-stats cache (replicated clusters): the responsibility
+        # mask depends only on (compacted-array generation, liveness view),
+        # so one O(corpus) rid/ring walk serves every BM25 query until a
+        # mutation recompacts the arrays or the live set changes
+        self._stats_gen = 0
+        self._stats_mask: Optional[Tuple[tuple, np.ndarray]] = None
         self._lock = _locks.RLock("idx.ft.state")
         self._build_lock = _locks.Lock("idx.ft.build")
 
@@ -355,23 +361,61 @@ class FtMirror:
             dl[idx[ok]] = np.maximum(val[ok], 0.0)  # -1 tombstone scores as 0
         self.t_indptr, self.t_dids, self.t_tfs, self.doclen_arr = indptr, dids, tfs, dl
         self.dirty = False
+        self._stats_gen += 1  # responsibility masks over old arrays are stale
 
     # ------------------------------------------------------------ search
-    def term_stats(self, terms: List[str]):
+    def term_stats(self, terms: List[str], doc_ok=None, filter_key=None):
         """Local corpus statistics for a term set: (doc count, total doc
         length, {term: document frequency}) — phase one of the cluster's
-        two-phase BM25 (cluster/rpc.py ft_stats). Unknown terms report 0."""
+        two-phase BM25 (cluster/rpc.py ft_stats). Unknown terms report 0.
+
+        `doc_ok(rid) -> bool` restricts the stats to a responsibility
+        subset (replicated clusters: each node reports only the docs it is
+        the first live replica of, so a doc counts once globally); pass a
+        hashable `filter_key` describing what doc_ok depends on (live-node
+        set + rf) and the O(corpus) mask is cached until the arrays
+        recompact or the key changes. The filtered path counts live docs
+        from the length array, so a zero-length doc is excluded — empty
+        bodies carry no BM25 mass."""
         with self._lock:
             self._ensure_arrays()
-            df: Dict[str, int] = {}
+            if doc_ok is None:
+                df: Dict[str, int] = {}
+                for t in dict.fromkeys(terms):
+                    tid = self.term_ids.get(t)
+                    df[t] = (
+                        int(self.t_indptr[tid + 1] - self.t_indptr[tid])
+                        if tid is not None
+                        else 0
+                    )
+                return int(self.dc), float(self.tl), df
+            cache_key = (
+                (self._stats_gen, filter_key) if filter_key is not None else None
+            )
+            if self._stats_mask is not None and self._stats_mask[0] == cache_key:
+                mask = self._stats_mask[1]
+            else:
+                cap = len(self.doclen_arr)
+                mask = np.zeros(cap, dtype=bool)
+                for did in np.nonzero(self.doclen_arr > 0)[0]:
+                    rid = self.rid_for(int(did))
+                    if rid is not None and doc_ok(rid):
+                        mask[did] = True
+                if cache_key is not None:
+                    self._stats_mask = (cache_key, mask)
+            df = {}
             for t in dict.fromkeys(terms):
                 tid = self.term_ids.get(t)
-                df[t] = (
-                    int(self.t_indptr[tid + 1] - self.t_indptr[tid])
-                    if tid is not None
-                    else 0
-                )
-            return int(self.dc), float(self.tl), df
+                if tid is None:
+                    df[t] = 0
+                    continue
+                s, e = int(self.t_indptr[tid]), int(self.t_indptr[tid + 1])
+                df[t] = int(np.count_nonzero(mask[self.t_dids[s:e]]))
+            return (
+                int(np.count_nonzero(mask)),
+                float(self.doclen_arr[mask].sum()),
+                df,
+            )
 
     def search(self, terms: List[str], k1: float, b: float, stats_override=None):
         """AND-match the analyzed query terms; returns (dids, scores) —

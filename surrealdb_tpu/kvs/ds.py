@@ -231,8 +231,12 @@ class GroupCommit:
                     # work arrived between timeout and lock: keep going
 
     def _flush(self, batch: List[tuple]) -> None:
-        from surrealdb_tpu import telemetry
+        from surrealdb_tpu import faults, telemetry
 
+        # chaos hook: a flusher that dies HERE exercises the whole rescue
+        # chain — drained slots resolve with the error (commit callers see
+        # a clean failure), _live un-latches, submitters self-rescue
+        faults.fire("kvs.group_commit.flush")
         ds = self._ds()
         sink = _ColumnSink()
         lock = ds.commit_lock if ds is not None else None

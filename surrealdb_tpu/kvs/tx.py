@@ -107,8 +107,12 @@ class Transaction:
         self.commit_direct()
 
     def commit_direct(self, column_sink=None) -> None:
-        from surrealdb_tpu import telemetry
+        from surrealdb_tpu import faults, telemetry
 
+        # chaos hook: a commit that fails HERE fails before the backend
+        # commit — the caller sees the error and the write provably did
+        # not land (the no-lost-acknowledged-writes invariant's dual)
+        faults.fire("kvs.commit")
         # the kvs level of the request's span tree (+ a write-labeled
         # duration histogram): commit-lock waits and mirror-delta
         # application show up here when they stall a query
@@ -167,6 +171,12 @@ class Transaction:
             self._graph_mirrors.apply_deltas(self.graph_deltas)
             self.graph_deltas = []
         if self.vector_deltas and self._index_stores is not None:
+            from surrealdb_tpu import faults
+
+            # chaos hook AFTER the backend commit: an injected failure here
+            # exercises the mirror-diverged recovery story (the commit is
+            # durable; a stale vector mirror must rebuild, never serve)
+            faults.fire("vector.delta_apply")
             for ns, db, tb, name, rid, vec in self.vector_deltas:
                 mirror = self._index_stores.get(ns, db, tb, name)
                 if mirror is None:

@@ -810,7 +810,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
         from surrealdb_tpu import bg
 
         conn = f"conn{next(_WS_CONN_SEQ)}"
-        bg.spawn_service("ws_pump", conn, pump, owner=id(self.ds))
+        bg.spawn_service("ws_pump", conn, pump, owner=id(self.ds), restart=True)
 
         # per-socket concurrent request pool (reference: the WS actor's
         # concurrent-request semaphore, src/rpc/connection.rs:80-147).
@@ -986,15 +986,19 @@ class Server:
         def tick_loop():
             from surrealdb_tpu import cnf
 
+            # no inner swallow: an uncaught tick failure (a wedged GC
+            # sweep, an injected bg.changefeed_gc panic) propagates to the
+            # service supervisor, which restarts the loop with capped
+            # backoff and counts bg_service_restarts{kind="tick"} — a
+            # crash is a metric, not a silent death of all maintenance
             while not self._tick_stop.wait(cnf.CHANGEFEED_GC_INTERVAL_SECS):
-                try:
-                    ds.tick()
-                except Exception:  # noqa: BLE001 — maintenance must not die
-                    pass
+                ds.tick()
 
         from surrealdb_tpu import bg
 
-        self._ticker = bg.spawn_service("tick", "server", tick_loop, owner=id(ds))
+        self._ticker = bg.spawn_service(
+            "tick", "server", tick_loop, owner=id(ds), restart=True
+        )
 
     @property
     def url(self) -> str:

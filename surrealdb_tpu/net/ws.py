@@ -161,6 +161,10 @@ class DaemonPool:
                 f"{target}.{i}" if target else str(i),
                 self._worker,
                 owner=owner,
+                # supervised: a worker that dies on an uncaught exception
+                # (panic-class faults included) restarts with backoff
+                # instead of silently shrinking the pool
+                restart=True,
             )
             for i in range(max(workers, 1))
         ]

@@ -303,6 +303,8 @@ def graft_spans(spans: List[dict], base_start: float, node: str) -> None:
     ctx = _current.get()
     if ctx is None or not spans:
         return
+    from surrealdb_tpu.sql.value import is_none as _is_none, is_null as _is_null
+
     tr = ctx.trace
     idmap: Dict[Any, int] = {}
     for s in sorted(spans, key=lambda s: s.get("rel_start", 0.0)):
@@ -310,6 +312,12 @@ def graft_spans(spans: List[dict], base_start: float, node: str) -> None:
             nid = tr.next_id()
             idmap[s.get("id")] = nid
             parent = idmap.get(s.get("parent"), ctx.span_id)
+            err = s.get("error")
+            if err is not None and (_is_none(err) or _is_null(err)):
+                # the CBOR hop decodes a None error as the engine NULL
+                # sentinel — normalize back, or exported trace docs stop
+                # being JSON-serializable
+                err = None
             tr.add(
                 nid,
                 parent,
@@ -317,7 +325,7 @@ def graft_spans(spans: List[dict], base_start: float, node: str) -> None:
                 dict(s.get("labels") or {}, node=node),
                 base_start + float(s.get("rel_start", 0.0)),
                 float(s.get("dur", 0.0)),
-                s.get("error"),
+                err,
             )
         except (TypeError, ValueError):
             continue  # a malformed remote span must not break the trace

@@ -74,6 +74,9 @@ HIERARCHY: Dict[str, int] = {
     "notification.hub": 58,    # live-query channel map
     "sdk.ws_client": 60,       # SDK WS pending/notification maps
     "net.ws_send": 62,         # per-socket write framing
+    "cluster.breaker": 63,     # per-node circuit-breaker state (never nests
+                               # with cluster.client; both only precede
+                               # the observability leaves)
     "cluster.client": 64,      # cluster node-health map (leaf-ish: only
                                # telemetry may nest inside it)
     # storage leaves
@@ -81,6 +84,8 @@ HIERARCHY: Dict[str, int] = {
     "kvs.file": 72,            # file-backend WAL
     "kvs.mem": 74,             # in-memory backend (RLock)
     # observability leaves (any layer may record into these; must be last)
+    "faults": 78,              # failpoint engine (fires under any engine
+                               # lock — commit, dispatch, rpc)
     "bg.registry": 80,         # background-task registry
     "compile_log": 82,         # compile-event log
     "tracing.store": 84,       # bounded trace store

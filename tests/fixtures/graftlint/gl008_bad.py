@@ -1,0 +1,16 @@
+"""GL008 fixture: unpaced retry loop + bare except-swallow."""
+
+
+def fetch_with_retry(call):
+    while True:
+        try:
+            return call()
+        except Exception:
+            continue  # hammers the failing dependency at CPU speed
+
+
+def best_effort_cleanup(conn):
+    try:
+        conn.close()
+    except Exception:
+        pass  # the failure is erased, not handled

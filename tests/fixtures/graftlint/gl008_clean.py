@@ -1,0 +1,31 @@
+"""GL008 clean twin: paced/bounded retries, evidence-keeping handlers."""
+
+import time
+
+
+def fetch_with_backoff(call):
+    backoff = 0.05
+    while True:
+        try:
+            return call()
+        except Exception:
+            time.sleep(backoff)  # paced: the loop backs off between attempts
+            backoff = min(backoff * 2, 1.0)
+            continue
+
+
+def fetch_bounded(call):
+    last = None
+    for _ in range(3):  # bounded attempts, no const-true loop
+        try:
+            return call()
+        except ValueError as e:
+            last = e
+    raise last
+
+
+def cleanup_with_evidence(conn, log):
+    try:
+        conn.close()
+    except OSError:  # narrow type: only the expected failure class
+        log.append("close failed")

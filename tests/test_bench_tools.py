@@ -171,3 +171,59 @@ def test_v7_requires_ingest_rate_and_clean_sustained_parity(tmp_path):
     doc = _min_v7_artifact()
     doc["results"][0]["cluster"]["ingest_bulk_path"] = False
     assert any("ingest_bulk_path" in p for p in _validate_doc(tmp_path, doc))
+
+
+# ------------------------------------------------------------------ schema/8
+def _min_v8_artifact():
+    doc = _min_v7_artifact()
+    doc["schema"] = "surrealdb-tpu-bench/8"
+    doc["configs"] = ["6", "7", "8"]
+    doc["bundle"]["locks"] = {}
+    doc["bundle"]["faults"] = {"enabled": False, "sites": {}, "trips_total": 0}
+    chaos_line = dict(doc["results"][0])
+    chaos_line.pop("cluster")
+    chaos_line.update(
+        metric="chaos_reads_3nodes_rf2", config="8",
+        chaos={
+            "nodes": 3, "rf": 2, "killed_node": "n2", "reads": 60,
+            "failover_reads": 30, "degraded_responses": 30, "errors": 0,
+            "wrong_answers": 0, "recovery_s": 2.0,
+        },
+    )
+    doc["results"].insert(2, chaos_line)
+    return doc
+
+
+def test_v8_chaos_line_rules(tmp_path):
+    assert _validate_doc(tmp_path, _min_v8_artifact()) == []
+
+    # a chaos line with ANY wrong answer is an invalid artifact, full stop
+    doc = _min_v8_artifact()
+    doc["results"][2]["chaos"]["wrong_answers"] = 1
+    assert any("wrong_answers" in p for p in _validate_doc(tmp_path, doc))
+
+    # a window that never lost a node proved nothing
+    doc = _min_v8_artifact()
+    doc["results"][2]["chaos"]["killed_node"] = ""
+    assert any("killed_node" in p for p in _validate_doc(tmp_path, doc))
+
+    # replicated + killed node must show degraded responses
+    doc = _min_v8_artifact()
+    doc["results"][2]["chaos"]["degraded_responses"] = 0
+    assert any("degraded" in p for p in _validate_doc(tmp_path, doc))
+
+    # the chaos object itself is mandatory on chaos_* lines
+    doc = _min_v8_artifact()
+    doc["results"][2].pop("chaos")
+    assert any("'chaos' object" in p for p in _validate_doc(tmp_path, doc))
+
+    # /8 bundles carry the failpoint section
+    doc = _min_v8_artifact()
+    doc["bundle"].pop("faults")
+    assert any("faults" in p for p in _validate_doc(tmp_path, doc))
+
+
+def test_committed_r12_artifact_validates():
+    path = os.path.join(REPO, "bench_results_r12.json")
+    assert os.path.exists(path)
+    assert cba.validate(path) == []

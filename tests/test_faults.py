@@ -1,0 +1,680 @@
+"""Failpoint engine + cluster fault tolerance (surrealdb_tpu/faults.py,
+cluster replication/breaker/retry/admission).
+
+The contracts under test:
+
+- the failpoint engine itself: spec parsing, prob/count semantics, seeded
+  determinism, every action class, trip accounting in the bundle's eighth
+  section and on /metrics;
+- every layer with a recovery story actually recovers when its site fires
+  (dispatch split-retry, group-commit rescue, column-delta decline,
+  bg-task failure, service supervision restarts);
+- the replicated cluster: RF=2 reads survive one node loss COMPLETELY
+  (flagged degraded, never wrong), acknowledged writes survive, breakers
+  make a dead node cheap, admission sheds instead of collapsing, a peer
+  dying MID-response (truncated/corrupt CBOR) is failover-or-error, never
+  a hang or a partial answer served as complete;
+- a seeded 200-operation chaos schedule holds the global invariants: no
+  hangs past deadline, no wrong answers (degraded-or-error only), no lost
+  acknowledged writes, no leaked threads.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy  # noqa: F401 — concurrent lazy first-import races otherwise
+
+from surrealdb_tpu import bg, cnf, faults, telemetry
+from surrealdb_tpu.bundle import debug_bundle
+from surrealdb_tpu.cluster import ClusterConfig, attach
+from surrealdb_tpu.dbs.dispatch import DispatchQueue
+from surrealdb_tpu.dbs.session import Session
+from surrealdb_tpu.kvs.ds import Datastore
+from surrealdb_tpu.net.server import serve
+
+
+def ok(resp):
+    assert resp["status"] == "OK", resp
+    return resp["result"]
+
+
+def counter_sum(name):
+    return sum(telemetry.counters_matching(name).values())
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ------------------------------------------------------------------ harness
+class Cluster:
+    """N in-process nodes (full Datastore + HTTP server each) wired into
+    one replicated hash ring; `ref` is the single-node comparison twin."""
+
+    def __init__(self, n: int = 3, secret: str = "chaos-secret"):
+        self.servers = [
+            serve("memory", port=0, auth_enabled=False).start_background()
+            for _ in range(n)
+        ]
+        nodes = [
+            {"id": f"n{i + 1}", "url": srv.url}
+            for i, srv in enumerate(self.servers)
+        ]
+        self.datastores = [srv.httpd.RequestHandlerClass.ds for srv in self.servers]
+        for i, ds in enumerate(self.datastores):
+            attach(ds, ClusterConfig(nodes, f"n{i + 1}", secret=secret))
+        self.ref = Datastore("memory")
+        self.s = Session.owner("t", "t")
+        self.rf = max(min(cnf.CLUSTER_RF, n), 1)
+
+    @property
+    def coord(self):
+        return self.datastores[0]
+
+    def both(self, sql, vars=None):
+        a = self.ref.execute(sql, self.s, dict(vars) if vars else None)
+        b = self.coord.execute(sql, self.s, dict(vars) if vars else None)
+        assert [r["status"] for r in a] == [r["status"] for r in b], (sql, a, b)
+        assert [r["result"] for r in a] == [r["result"] for r in b], (sql, a, b)
+        return [r["result"] for r in b]
+
+    def kill(self, i: int):
+        self.servers[i].shutdown()
+
+    def close(self):
+        for srv in self.servers:
+            srv.shutdown()
+        for ds in self.datastores:
+            ds.close()
+        self.ref.close()
+
+
+@pytest.fixture()
+def cluster3():
+    saved = cnf.CLUSTER_RPC_TIMEOUT_SECS
+    cnf.CLUSTER_RPC_TIMEOUT_SECS = 3.0
+    c = Cluster(3)
+    yield c
+    c.close()
+    cnf.CLUSTER_RPC_TIMEOUT_SECS = saved
+
+
+def seed_corpus(c, n=30, dim=8):
+    c.both(
+        "DEFINE TABLE person SCHEMALESS; "
+        "DEFINE TABLE item SCHEMALESS; "
+        "DEFINE TABLE doc SCHEMALESS; "
+        "DEFINE INDEX iemb ON item FIELDS emb MTREE DIMENSION 8; "
+        "DEFINE ANALYZER simple TOKENIZERS blank,class FILTERS lowercase; "
+        "DEFINE INDEX fbody ON doc FIELDS body SEARCH ANALYZER simple BM25"
+    )
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((n, dim)).astype(np.float32)
+    words = ["alpha", "beta", "gamma", "delta", "eps"]
+    for i in range(n):
+        c.both(f"CREATE person:{i} SET val = {i}, band = {i % 3}")
+        c.both(f"CREATE item:{i} SET emb = $v", {"v": x[i].tolist()})
+        body = " ".join(words[int(w)] for w in rng.integers(0, 5, size=3 + i % 4))
+        c.both(f"CREATE doc:{i} SET body = $b", {"b": body})
+    return x
+
+
+# ================================================================== engine
+def test_spec_parse_prob_count_and_trips():
+    faults.configure("a=error:0.5:3, b=latency-1, c=corrupt::2")
+    snap = faults.snapshot()
+    assert snap["sites"]["a"]["prob"] == 0.5
+    assert snap["sites"]["a"]["remaining"] == 3
+    assert snap["sites"]["b"]["action"] == "latency"
+    assert snap["sites"]["c"]["remaining"] == 2
+    # count semantics: exactly 2 corruptions, then clean
+    assert faults.fire("c", b"xxxx") != b"xxxx"
+    assert faults.fire("c", b"xxxx") != b"xxxx"
+    assert faults.fire("c", b"xxxx") == b"xxxx"
+    assert faults.snapshot()["sites"]["c"]["trips"] == 2
+    # unknown action / classes refuse loudly
+    with pytest.raises(ValueError):
+        faults.configure("x=explode")
+    with pytest.raises(ValueError):
+        faults.configure("x=error-nosuch")
+    with pytest.raises(ValueError):
+        faults.configure("justasite")
+
+
+def test_seeded_rng_is_reproducible():
+    def run():
+        faults.reset()
+        faults.seed(99)
+        faults.enable("p", "error", prob=0.4)
+        pattern = []
+        for _ in range(50):
+            try:
+                faults.fire("p")
+                pattern.append(0)
+            except faults.FaultError:
+                pattern.append(1)
+        return pattern
+
+    assert run() == run()
+    assert sum(run()) > 0
+
+
+def test_action_classes():
+    faults.enable("t", "error-transient", count=1)
+    with pytest.raises(faults.TransientFaultError, match="UNAVAILABLE"):
+        faults.fire("t")
+    faults.enable("o", "error-oserror", count=1)
+    with pytest.raises(OSError):
+        faults.fire("o")
+    faults.enable("k", "error-kvs", count=1)
+    from surrealdb_tpu.err import KvsError
+
+    with pytest.raises(KvsError):
+        faults.fire("k")
+    faults.enable("pa", "panic", count=1)
+    with pytest.raises(BaseException) as ei:
+        faults.fire("pa")
+    assert not isinstance(ei.value, Exception), "panic must escape except Exception"
+    faults.enable("lat", "latency-30", count=1)
+    t0 = time.perf_counter()
+    faults.fire("lat")
+    assert time.perf_counter() - t0 >= 0.025
+    # corrupt shapes
+    faults.enable("co", "corrupt")
+    assert faults.fire("co", b"0123456789") == b"\xcf1234"
+    assert faults.fire("co", None) is faults.CORRUPT
+
+
+def test_trip_counters_reach_metrics_and_bundle():
+    faults.enable("demo.site", "latency-1", count=1)
+    faults.fire("demo.site")
+    assert counter_sum("failpoint_trips") >= 1
+    assert "failpoint_trips" in telemetry.render_prometheus()
+    b = debug_bundle(None)
+    assert "faults" in b
+    assert b["faults"]["sites"]["demo.site"]["trips"] == 1
+    assert b["faults"]["trips_total"] >= 1
+
+
+# ================================================================== layers
+def test_dispatch_launch_failpoint_recovers_via_retry(ds):
+    faults.enable("dispatch.launch", "error-transient", count=1)
+    q = DispatchQueue(split_floor=4)
+    out = q.submit("k", 7, lambda payloads: [p * 2 for p in payloads])
+    assert out == 14
+    assert q.retries >= 1  # the transient injection went through real recovery
+    faults.enable("dispatch.launch", "error", count=1)  # deterministic class
+    with pytest.raises(faults.FaultError):
+        q.submit("k", 1, lambda payloads: payloads)
+    ds.close()
+
+
+def test_kvs_commit_failpoint_is_a_clean_pre_commit_failure(ds):
+    s = Session.owner("t", "t")
+    ok(ds.execute("CREATE a:1 SET v = 1", s)[0])
+    faults.enable("kvs.commit", "error-kvs", count=1)
+    r = ds.execute("CREATE a:2 SET v = 2", s)[0]
+    assert r["status"] == "ERR", r
+    # the failed write provably did not land; the next one provably does
+    assert ok(ds.execute("SELECT VALUE v FROM a", s)[0]) == [1]
+    ok(ds.execute("CREATE a:3 SET v = 3", s)[0])
+    assert ok(ds.execute("SELECT VALUE v FROM a", s)[0]) == [1, 3]
+    ds.close()
+
+
+def test_group_commit_flush_crash_resolves_submitters(ds):
+    s = Session.owner("t", "t")
+    ok(ds.execute("DEFINE TABLE g SCHEMALESS", s)[0])
+    faults.enable("kvs.group_commit.flush", "error-runtime", count=1)
+    # the crashed flusher must resolve its drained slots with the error
+    # (no caller polls a dead flusher forever), and later commits recover
+    errs, oks = [], []
+    def write(i):
+        r = ds.execute(f"CREATE g:{i} SET v = {i}", s)[0]
+        (oks if r["status"] == "OK" else errs).append(i)
+    threads = [threading.Thread(target=write, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(20)
+        assert not t.is_alive(), "a commit hung behind a crashed flusher"
+    got = ok(ds.execute("SELECT VALUE v FROM g", s)[0])
+    # every acknowledged write is present, every failed one absent
+    assert sorted(got) == sorted(oks), (got, oks, errs)
+    ok(ds.execute("CREATE g:99 SET v = 99", s)[0])  # the flusher respawned
+    ds.close()
+
+
+def test_column_delta_apply_failpoint_declines_to_rebuild(ds):
+    s = Session.owner("t", "t")
+    ok(ds.execute("DEFINE TABLE c SCHEMALESS", s)[0])
+    rows = [{"id": i, "v": i} for i in range(200)]
+    ok(ds.execute("INSERT INTO c $rows", s, {"rows": rows})[0])
+    bg.wait_idle(owner=id(ds))
+    faults.enable("column.delta_apply", "error-runtime")
+    more = [{"id": 1000 + i, "v": 1000 + i} for i in range(100)]
+    ok(ds.execute("INSERT INTO c $rows", s, {"rows": more})[0])
+    faults.disable("column.delta_apply")
+    # the commit survived the crashed delta apply, and a columnar-eligible
+    # read over the (now stale-mirrored) table is still exactly right
+    got = ok(ds.execute("SELECT VALUE v FROM c WHERE v >= 1000", s)[0])
+    assert sorted(got) == [1000 + i for i in range(100)]
+    ds.close()
+
+
+def test_bg_task_failpoint_fails_the_task_record(ds):
+    faults.enable("bg.changefeed_gc", "error-runtime", count=1)
+    with pytest.raises(RuntimeError):
+        ds.tick()
+    snap = bg.snapshot()
+    failed = [t for t in snap["recent"] if t["kind"] == "changefeed_gc"]
+    assert failed and failed[0]["state"] == "failed"
+    assert ds.tick() == 0  # the next sweep is healthy
+    ds.close()
+
+
+def test_service_supervision_restarts_with_backoff():
+    calls = []
+    stop = threading.Event()
+
+    def svc():
+        calls.append(time.monotonic())
+        if len(calls) < 3:
+            raise RuntimeError("service crash")
+        stop.wait(10)
+
+    r0 = counter_sum("bg_service_restarts")
+    th = bg.spawn_service("chaos_svc", "x", svc, restart=True)
+    deadline = time.monotonic() + 15
+    while len(calls) < 3 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert len(calls) >= 3, "service was not restarted"
+    assert counter_sum("bg_service_restarts") - r0 >= 2
+    stop.set()
+    th.join(5)
+    assert not th.is_alive(), "service did not exit on normal return"
+
+
+def test_service_supervision_survives_panic_class():
+    calls = []
+    stop = threading.Event()
+
+    def svc():
+        calls.append(1)
+        if len(calls) == 1:
+            raise faults.FaultPanic("injected panic")
+        stop.wait(10)
+
+    th = bg.spawn_service("chaos_panic_svc", "x", svc, restart=True)
+    deadline = time.monotonic() + 10
+    while len(calls) < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert len(calls) >= 2, "panic-class crash was not supervised"
+    stop.set()
+    th.join(5)
+    assert not th.is_alive()
+
+
+# ================================================================== cluster
+def test_reads_survive_one_node_loss_knn_scan_bm25(cluster3):
+    c = cluster3
+    assert c.rf == 2
+    x = seed_corpus(c, n=30)
+    scan_sql = "SELECT * FROM person WHERE val < 20"
+    knn_sql = "SELECT id FROM item WHERE emb <|5|> $q"
+    bm_sql = (
+        "SELECT id, search::score(1) AS sc FROM doc WHERE body @1@ 'alpha' "
+        "ORDER BY sc DESC LIMIT 8"
+    )
+    qv = {"q": (x[3] + 0.01).tolist()}
+    expect = {
+        "scan": ok(c.ref.execute(scan_sql, c.s)[0]),
+        "knn": ok(c.ref.execute(knn_sql, c.s, dict(qv))[0]),
+        "bm25": ok(c.ref.execute(bm_sql, c.s)[0]),
+    }
+    c.kill(1)
+    time.sleep(0.1)
+    fo0 = counter_sum("cluster_failover_total")
+    for name, sql, vars in (
+        ("scan", scan_sql, None),
+        ("knn", knn_sql, dict(qv)),
+        ("bm25", bm_sql, None),
+    ):
+        t0 = time.perf_counter()
+        r = c.coord.execute(sql, c.s, vars)[0]
+        dt = time.perf_counter() - t0
+        assert r["status"] == "OK", (name, r)
+        assert r.get("degraded") is True, (name, r)
+        assert r["result"] == expect[name], f"{name}: degraded read diverged"
+        assert dt < 15.0, f"{name} took {dt:.1f}s with a node down"
+    assert counter_sum("cluster_failover_total") > fo0
+    # graph-free aggregates over the degraded gather dedup exactly
+    r = c.coord.execute("SELECT count() FROM person GROUP ALL", c.s)[0]
+    assert r["status"] == "OK" and r["result"][0]["count"] == 30
+
+
+def test_acked_writes_survive_one_node_loss(cluster3):
+    c = cluster3
+    c.both("DEFINE TABLE w SCHEMALESS")
+    acked = []
+    for i in range(40):
+        r = c.coord.execute(f"CREATE w:{i} SET v = {i}", c.s)[0]
+        if r["status"] == "OK":
+            acked.append(i)
+    assert len(acked) == 40
+    c.kill(2)
+    time.sleep(0.1)
+    r = c.coord.execute("SELECT VALUE v FROM w", c.s)[0]
+    assert r["status"] == "OK" and r.get("degraded") is True, r
+    assert sorted(r["result"]) == acked, "an acknowledged write was lost"
+
+
+def test_breaker_makes_a_dead_node_cheap(cluster3):
+    c = cluster3
+    seed = 12
+    c.both("DEFINE TABLE b SCHEMALESS")
+    for i in range(seed):
+        c.both(f"CREATE b:{i} SET v = {i}")
+    saved = cnf.CLUSTER_BREAKER_THRESHOLD
+    cnf.CLUSTER_BREAKER_THRESHOLD = 1
+    try:
+        c.kill(1)
+        time.sleep(0.1)
+        ok(c.coord.execute("SELECT * FROM b", c.s)[0])  # trips the breaker
+        assert c.coord.cluster.client.breaker_state("n2") == "open"
+        ff0 = counter_sum("cluster_breaker_fast_fails")
+        t0 = time.perf_counter()
+        for _ in range(5):
+            r = c.coord.execute("SELECT * FROM b", c.s)[0]
+            assert r["status"] == "OK" and r.get("degraded") is True, r
+        assert time.perf_counter() - t0 < 5.0
+        assert counter_sum("cluster_breaker_fast_fails") > ff0
+        # breaker + probe state surface in the engine bundle section
+        eng = debug_bundle(c.coord)["engine"]["cluster"]
+        assert eng["rf"] == 2 and eng["nodes"]["n2"]["breaker"] == "open"
+    finally:
+        cnf.CLUSTER_BREAKER_THRESHOLD = saved
+
+
+def test_idempotent_reads_retry_writes_never(cluster3):
+    c = cluster3
+    c.both("DEFINE TABLE r SCHEMALESS")
+    for i in range(10):
+        c.both(f"CREATE r:{i} SET v = {i}")
+    expect = ok(c.ref.execute("SELECT * FROM r", c.s)[0])
+    saved = cnf.CLUSTER_RETRY_BASE_SECS
+    cnf.CLUSTER_RETRY_BASE_SECS = 0.01
+    try:
+        # one transient network failure: the read retries through it and
+        # stays COMPLETE and un-degraded
+        faults.enable("cluster.rpc.send", "error-oserror", count=1)
+        re0 = counter_sum("cluster_retries")
+        r = c.coord.execute("SELECT * FROM r", c.s)[0]
+        assert r["status"] == "OK" and r["result"] == expect, r
+        assert counter_sum("cluster_retries") - re0 >= 1
+        # writes NEVER retry: the same one-shot failure degrades the write
+        # (one replica missed — rebalance territory) without a re-send
+        faults.enable("cluster.rpc.send", "error-oserror", count=1)
+        re1 = counter_sum("cluster_retries")
+        r = c.coord.execute("CREATE r:100 SET v = 100", c.s)[0]
+        assert r["status"] == "OK", r
+        assert counter_sum("cluster_retries") == re1, "a write was retried"
+        got = ok(c.coord.execute("SELECT VALUE v FROM r WHERE v = 100", c.s)[0])
+        assert got == [100], "acked degraded write must still be readable"
+    finally:
+        cnf.CLUSTER_RETRY_BASE_SECS = saved
+
+
+def test_peer_dies_mid_response_corrupt_cbor(cluster3):
+    """Satellite: a truncated/corrupt response BODY (not a refused
+    connection) must be failover-or-error — never a hang, never a partial
+    answer served as complete."""
+    c = cluster3
+    c.both("DEFINE TABLE m SCHEMALESS")
+    for i in range(18):
+        c.both(f"CREATE m:{i} SET v = {i}")
+    expect = ok(c.ref.execute("SELECT * FROM m", c.s)[0])
+    # a ONE-SHOT corruption is retried through (idempotent read): the
+    # answer stays complete and un-degraded
+    faults.enable("cluster.rpc.recv", "corrupt", count=1)
+    r = c.coord.execute("SELECT * FROM m", c.s)[0]
+    assert r["status"] == "OK" and r["result"] == expect, r
+    saved_retry = cnf.CLUSTER_RETRY_MAX
+    cnf.CLUSTER_RETRY_MAX = 0  # force the FAILOVER path, not the retry path
+    try:
+        # RF=2: the corrupted node's records all have live replicas -> the
+        # statement fails over and stays complete
+        faults.enable("cluster.rpc.recv", "corrupt", count=1)
+        t0 = time.perf_counter()
+        r = c.coord.execute("SELECT * FROM m", c.s)[0]
+        dt = time.perf_counter() - t0
+        assert dt < 15.0, "corrupt response produced a hang"
+        assert r["status"] == "OK", r
+        assert r.get("degraded") is True, r
+        assert r["result"] == expect, "a partial answer was served as complete"
+        # RF=1: no replica can cover -> a clear error naming the failure
+        saved_rf = cnf.CLUSTER_RF
+        cnf.CLUSTER_RF = 1
+        try:
+            faults.enable("cluster.rpc.recv", "corrupt", count=1)
+            r = c.coord.execute("SELECT * FROM m", c.s)[0]
+            assert r["status"] == "ERR", r
+            assert "unavailable" in str(r["result"]), r
+        finally:
+            cnf.CLUSTER_RF = saved_rf
+    finally:
+        cnf.CLUSTER_RETRY_MAX = saved_retry
+
+
+def test_admission_control_sheds_fast_with_retryable_error(cluster3):
+    c = cluster3
+    c.both("DEFINE TABLE ad SCHEMALESS")
+    for i in range(6):
+        c.both(f"CREATE ad:{i} SET v = {i}")
+    saved = (
+        cnf.CLUSTER_MAX_INFLIGHT, cnf.CLUSTER_ADMIT_QUEUE,
+        cnf.CLUSTER_ADMIT_WAIT_SECS,
+    )
+    cnf.CLUSTER_MAX_INFLIGHT = 1
+    cnf.CLUSTER_ADMIT_QUEUE = 1
+    cnf.CLUSTER_ADMIT_WAIT_SECS = 0.05
+    try:
+        faults.enable("cluster.rpc.handle", "latency-300")
+        shed0 = counter_sum("cluster_shed_total")
+        results = []
+
+        def go():
+            results.append(c.coord.execute("SELECT * FROM ad", c.s)[0])
+
+        threads = [threading.Thread(target=go) for _ in range(6)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+            assert not t.is_alive(), "an admission-bounded statement hung"
+        wall = time.perf_counter() - t0
+        shed = [r for r in results if r["status"] == "ERR" and "shed" in str(r["result"])]
+        served = [r for r in results if r["status"] == "OK"]
+        assert shed, results
+        assert served, results
+        assert "retry" in str(shed[0]["result"])
+        assert counter_sum("cluster_shed_total") - shed0 >= len(shed)
+        # shedding is what keeps the tail bounded: 6 statements at ~0.3s+
+        # each through a width-1 gate would serialize to ~2s+; the shed
+        # ones returned immediately
+        assert wall < 6 * 0.3, wall
+    finally:
+        (
+            cnf.CLUSTER_MAX_INFLIGHT, cnf.CLUSTER_ADMIT_QUEUE,
+            cnf.CLUSTER_ADMIT_WAIT_SECS,
+        ) = saved
+
+
+def test_diverged_replicas_serve_the_ring_first_copy(cluster3):
+    """Review fix: when replica copies of a record DIFFER (a missed write,
+    a stale rejoin), reads serve the EARLIEST replica in ring order — the
+    write-reporter rule — and count cluster_read_divergence, instead of
+    whichever node id happens to sort first."""
+    c = cluster3
+    c.both("DEFINE TABLE dv SCHEMALESS")
+    r = c.coord.execute("CREATE dv:1 SET v = 'orig'", c.s)[0]
+    assert r["status"] == "OK", r
+    ring = c.coord.cluster.ring
+    replicas = ring.owners_of("dv", 1, 2)
+    by_id = {f"n{i + 1}": ds for i, ds in enumerate(c.datastores)}
+    # diverge the SECOND replica's copy behind the cluster's back
+    ok(by_id[replicas[1]].execute_local("UPDATE dv:1 SET v = 'stale'", c.s)[0])
+    d0 = counter_sum("cluster_read_divergence")
+    got = ok(c.coord.execute("SELECT VALUE v FROM dv", c.s)[0])
+    assert got == ["orig"], (got, replicas)
+    assert counter_sum("cluster_read_divergence") > d0
+    # now diverge the FIRST replica instead: its copy is canon
+    ok(by_id[replicas[0]].execute_local("UPDATE dv:1 SET v = 'newer'", c.s)[0])
+    got = ok(c.coord.execute("SELECT VALUE v FROM dv", c.s)[0])
+    assert got == ["newer"], (got, replicas)
+
+
+def test_breaker_half_open_trial_released_on_engine_class_fault(cluster3):
+    """Review fix: a half-open trial call that dies on a NON-network
+    exception (an injected engine-class fault, an unencodable payload)
+    must release its trial latch — not wedge the node fast-failing until
+    the next probe."""
+    c = cluster3
+    client = c.coord.cluster.client
+    saved = (cnf.CLUSTER_BREAKER_THRESHOLD, cnf.CLUSTER_BREAKER_COOLDOWN_SECS)
+    cnf.CLUSTER_BREAKER_THRESHOLD = 1
+    cnf.CLUSTER_BREAKER_COOLDOWN_SECS = 0.0
+    try:
+        client._breaker_failure("n2")
+        assert client.breaker_state("n2") == "open"
+        # half-open trial dies on a FaultError (neither NodeUnavailable nor
+        # RemoteOpError): the latch must release...
+        faults.enable("cluster.rpc.send", "error", count=1)
+        with pytest.raises(faults.FaultError):
+            client.call("n2", "ping", {})
+        # ...so the NEXT call becomes the trial and closes the breaker
+        assert client.call("n2", "ping", {}).get("ok") is True
+        assert client.breaker_state("n2") == "closed"
+    finally:
+        cnf.CLUSTER_BREAKER_THRESHOLD, cnf.CLUSTER_BREAKER_COOLDOWN_SECS = saved
+
+
+# ================================================================== chaos
+def test_chaos_schedule_200_ops_holds_invariants(cluster3):
+    """A seeded 200-op schedule over a healthy 3-node RF=2 cluster with
+    failpoints armed at every layer (network send, remote handle latency,
+    kvs commits). Invariants: every op completes inside its deadline, OK
+    reads are EXACT (acked ⊆ seen ⊆ attempted, values matching), no
+    acknowledged write is ever lost, no scatter/service threads leak."""
+    c = cluster3
+    c.both("DEFINE TABLE t SCHEMALESS")
+    rng = np.random.default_rng(1234)
+    faults.seed(1234)
+    saved = cnf.CLUSTER_RETRY_BASE_SECS
+    cnf.CLUSTER_RETRY_BASE_SECS = 0.01
+    threads_before = {
+        th.name for th in threading.enumerate() if th.name.startswith("cluster-scatter")
+    }
+    # The model's replication contract (the executor's documented one):
+    # a CLEAN ack means every replica applied -> visible on EVERY later OK
+    # read. A DEGRADED ack means >= 1 copy landed -> visible on every
+    # NON-degraded read (all holders answered), but a DEGRADED read may
+    # transiently miss it (its sole holder may be the unreachable node).
+    # A clean-acked DELETE removed every copy; a degraded one may leave a
+    # copy that resurfaces. Nothing outside `attempted` may EVER appear.
+    acked = {}        # id -> value, coordinator-acknowledged writes
+    fragile = set()   # acked ids whose ack was degraded (single-copy risk)
+    attempted = {}    # id -> set of values ever sent (partial-write bound)
+    deleted_clean = set()
+    try:
+        faults.enable("cluster.rpc.send", "error-oserror", prob=0.05)
+        faults.enable("cluster.rpc.handle", "latency-5", prob=0.10)
+        faults.enable("kvs.commit", "error-kvs", prob=0.03)
+        next_id = 0
+        t_start = time.perf_counter()
+        for step in range(200):
+            op = rng.choice(["create", "create", "select", "count", "delete"])
+            t0 = time.perf_counter()
+            if op == "create":
+                i, v = next_id, int(rng.integers(0, 1000))
+                next_id += 1
+                attempted.setdefault(i, set()).add(v)
+                r = c.coord.execute(f"CREATE t:{i} SET v = {v}", c.s)[0]
+                if r["status"] == "OK":
+                    acked[i] = v
+                    if r.get("degraded"):
+                        fragile.add(i)
+            elif op == "delete" and acked:
+                i = sorted(acked)[int(rng.integers(0, len(acked)))]
+                r = c.coord.execute(f"DELETE t:{i}", c.s)[0]
+                # even an ERR delete may have removed SOME copies before a
+                # member failed (no distributed txn) — the id leaves the
+                # must-be-visible set either way; `attempted` still bounds
+                # what may appear
+                del acked[i]
+                fragile.discard(i)
+                if r["status"] == "OK" and not r.get("degraded"):
+                    deleted_clean.add(i)
+            elif op == "count":
+                r = c.coord.execute("SELECT count() FROM t GROUP ALL", c.s)[0]
+                if r["status"] == "OK":
+                    n = r["result"][0]["count"] if r["result"] else 0
+                    floor = len(acked) - (len(fragile) if r.get("degraded") else 0)
+                    assert n >= floor, (n, len(acked), len(fragile))
+                    assert n <= len(attempted), (n, len(attempted))
+            else:
+                r = c.coord.execute("SELECT * FROM t", c.s)[0]
+                if r["status"] == "OK":
+                    seen = {}
+                    for row in r["result"]:
+                        rid = row["id"].id
+                        assert rid not in seen, "replica dedup failed"
+                        seen[rid] = row.get("v")
+                    degraded = bool(r.get("degraded"))
+                    for i, v in acked.items():
+                        if degraded and i in fragile:
+                            continue  # its sole holder may be the dark node
+                        assert seen.get(i) == v, f"lost acked write t:{i}"
+                    for i, v in seen.items():
+                        assert i in attempted and v in attempted[i], (
+                            f"phantom row t:{i} = {v}"
+                        )
+            dt = time.perf_counter() - t0
+            assert dt < 15.0, f"op {step} ({op}) took {dt:.1f}s — a hang"
+        wall = time.perf_counter() - t_start
+        assert wall < 300, f"schedule took {wall:.0f}s"
+        # trip evidence reached the bundle's eighth section mid-storm
+        assert debug_bundle(c.coord)["faults"]["trips_total"] > 0
+    finally:
+        cnf.CLUSTER_RETRY_BASE_SECS = saved
+        faults.reset()
+    # final ground truth with all failpoints off and every node reachable:
+    # EVERY acked write (fragile included — its copy is reachable now) is
+    # visible, every CLEANLY-deleted record is gone. A breaker the storm
+    # tripped may still be half-open — give the probes a beat to close it.
+    deadline = time.monotonic() + 20
+    while True:
+        r = c.coord.execute("SELECT * FROM t", c.s)[0]
+        if r["status"] == "OK" and not r.get("degraded"):
+            break
+        assert time.monotonic() < deadline, f"cluster never converged: {r['status']}"
+        time.sleep(0.25)
+    seen = {row["id"].id: row.get("v") for row in r["result"]}
+    for i, v in acked.items():
+        assert seen.get(i) == v, f"lost acked write t:{i} after the storm"
+    for i in deleted_clean:
+        if i in seen:
+            raise AssertionError(f"cleanly-acked delete of t:{i} resurfaced")
+    # no scatter-pool thread growth (services are accounted separately)
+    threads_after = {
+        th.name for th in threading.enumerate() if th.name.startswith("cluster-scatter")
+    }
+    pool_cap = 4 * 3 * len(c.datastores) + 24
+    assert len(threads_after) <= max(len(threads_before), pool_cap)
