@@ -90,7 +90,16 @@ PROFILE = "--profile" in sys.argv[1:] or os.environ.get("SURREAL_PROFILE") == "1
 # the next successful read). Config 7's cluster object gains `rf` and its
 # row-spread accounting is replication-aware. The embedded debug bundle
 # grew its eighth section (`faults`: failpoint trip counters).
-SCHEMA = "surrealdb-tpu-bench/8"
+# schema/9 (r13, cluster observability): the embedded bundle grew its
+# NINTH section (`events`: the structured trace-linked timeline), and the
+# cluster configs (7, 8) each carry a `cluster_obs` object — the FEDERATED
+# cluster bundle scraped from the coordinator (per-node sections; a killed
+# node shows up `unreachable`) plus the slowest scattered statement's
+# per-shard profile (per-node RPC ms, rows, retries, failovers, merge ms)
+# and the live-node list its shard timings must cover. Config 8's chaos
+# line adds an `events` accounting (breaker events, degraded reads and
+# how many of those carry no trace_id — bench_gate floors them).
+SCHEMA = "surrealdb-tpu-bench/9"
 
 D = 768
 NI = max(int(1_000_000 * SCALE), 1024)  # item corpus (configs 2/4/5)
@@ -1144,6 +1153,7 @@ def bench_cluster(rng):
         queries = [{"q": qs[i].tolist()} for i in range(nq)]
         for target in (ds1, ref):  # warm both paths
             target.execute(knn_sql, s, dict(queries[0]))
+        ds1.cluster.executor.reset_profiles()  # profile the MEASURED window
         t0 = time.perf_counter()
         for v in queries:
             r = ds1.execute(knn_sql, s, dict(v))
@@ -1153,6 +1163,22 @@ def bench_cluster(rng):
         for v in queries:
             ref.execute(knn_sql, s, dict(v))
         single_qps = nq / (time.perf_counter() - t0)
+
+        # ---- the observability plane's own evidence: the federated
+        # bundle from the coordinator + the slowest statement's per-shard
+        # profile (validator: shard timings must cover every live node)
+        from surrealdb_tpu.cluster.federation import federated_bundle
+
+        slowest = ds1.cluster.executor.slowest_profile()
+        fed = federated_bundle(ds1, trace_limit=10, full_traces=2)
+        cluster_obs = {
+            "bundle": fed,
+            "slowest_profile": slowest,
+            "live_nodes": [nd["id"] for nd in nodes],
+            # one interpreter, shared global registries: per-node sections
+            # mirror one state (cluster/federation.py in-process caveat)
+            "in_process": True,
+        }
 
         emit(
             {
@@ -1176,6 +1202,7 @@ def bench_cluster(rng):
                     "ingest_bulk_path": ingest_parity,
                     "ingest_bulk_rows": int(bulk_rows),
                 },
+                "cluster_obs": cluster_obs,
             }
         )
         assert all(parity.values()), f"cluster parity broken: {parity}"
@@ -1262,6 +1289,10 @@ def bench_chaos(rng):
         dss[0].execute(knn_sql, s, {"q": qs[0].tolist()})  # warm the path
 
         fo0 = sum(_tm.counters_matching("cluster_failover_total").values())
+        from surrealdb_tpu import events as _events
+
+        ev_seq0 = _events.last_seq()  # window-scope the timeline read
+        dss[0].cluster.executor.reset_profiles()
         errors = degraded = wrong = failover_reads = 0
         t_kill = recovery_s = None
         t0 = time.perf_counter()
@@ -1291,6 +1322,43 @@ def bench_chaos(rng):
             sum(_tm.counters_matching("cluster_failover_total").values()) - fo0
         )
         qps = reads / window_s if window_s else 0.0
+
+        # ---- the chaos window's structured timeline + federated evidence:
+        # the bundle is captured AFTER the kill, so the dead member's
+        # section shows up `unreachable` (the degraded-bundle contract in
+        # the committed artifact), and the events accounting is what
+        # bench_gate floors (>=1 breaker event, 0 unattributed degraded
+        # reads — a failover nobody can join to a statement)
+        window_events = _events.since(ev_seq0)
+        degraded_evs = [
+            e for e in window_events if e["kind"] == "cluster.degraded_read"
+        ]
+        events_acct = {
+            "total": len(window_events),
+            "breaker": sum(
+                1 for e in window_events if e["kind"] == "cluster.breaker_open"
+            ),
+            "flaps": sum(
+                1 for e in window_events if e["kind"] == "cluster.node_down"
+            ),
+            "degraded_reads": len(degraded_evs),
+            "unattributed_degraded_reads": sum(
+                1 for e in degraded_evs if not e.get("trace_id")
+            ),
+        }
+        from surrealdb_tpu.cluster.federation import federated_bundle
+
+        live_nodes = [
+            nd["id"] for i, nd in enumerate(nodes)
+            if not (killed and i == killed_idx)
+        ]
+        cluster_obs = {
+            "bundle": federated_bundle(dss[0], trace_limit=10, full_traces=2),
+            "slowest_profile": dss[0].cluster.executor.slowest_profile(),
+            "live_nodes": live_nodes,
+            "in_process": True,  # shared registries; see federation.py caveat
+        }
+
         emit(
             {
                 "metric": f"chaos_reads_3nodes_rf{rf}_{n}x{d}",
@@ -1314,6 +1382,8 @@ def bench_chaos(rng):
                     "wrong_answers": wrong,
                     "recovery_s": round(recovery_s, 3) if recovery_s is not None else None,
                 },
+                "events": events_acct,
+                "cluster_obs": cluster_obs,
             }
         )
         assert wrong == 0, f"chaos window produced {wrong} wrong answers"

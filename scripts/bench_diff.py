@@ -24,8 +24,16 @@ mirror staleness flips, tables that appeared/vanished, compile-cache drift
 ANN quantizer state changes, and dispatch counter ratios — the round-over-
 round engine-state attribution the per-config metric deltas can't show.
 
-Also importable: `diff(old_art, new_art, threshold) -> list[dict]` and
-`diff_bundles(old_bundle, new_bundle) -> dict`.
+FEDERATED bundles (GET /debug/bundle?cluster=1, or a schema-/9 artifact's
+cluster_obs embed) are diffed per node: each member's sections compare
+pairwise against its previous-round self, plus a PEER-DRIFT pass over the
+new bundle — one node's compile cache missing shapes its peers compiled,
+a breaker open toward a member the rest consider alive, a column mirror
+stale on one node but fresh on the others (the one-node-p99 signatures).
+
+Also importable: `diff(old_art, new_art, threshold) -> list[dict]`,
+`diff_bundles(old_bundle, new_bundle) -> dict`,
+`diff_federated(old, new) -> dict` and `peer_drift(bundle) -> list[str]`.
 """
 
 from __future__ import annotations
@@ -130,8 +138,9 @@ def diff(old: dict, new: dict, threshold: float = 0.25) -> List[dict]:
 
 # ------------------------------------------------------------------ bundles
 def _as_bundle(doc: dict) -> Optional[dict]:
-    """Accept a standalone bundle (GET /debug/bundle) or a bench artifact
-    embedding one."""
+    """Accept a standalone bundle (GET /debug/bundle), a FEDERATED cluster
+    bundle (GET /debug/bundle?cluster=1 — has a `nodes` map), or a bench
+    artifact embedding either."""
     if not isinstance(doc, dict):
         return None
     if str(doc.get("schema", "")).startswith("surrealdb-tpu-bundle/"):
@@ -139,7 +148,15 @@ def _as_bundle(doc: dict) -> Optional[dict]:
     b = doc.get("bundle")
     if isinstance(b, dict):
         return b
+    # schema/9 cluster lines embed the federated bundle under cluster_obs
+    co = doc.get("cluster_obs")
+    if isinstance(co, dict) and isinstance(co.get("bundle"), dict):
+        return co["bundle"]
     return None
+
+
+def _is_federated(bundle: Optional[dict]) -> bool:
+    return isinstance(bundle, dict) and isinstance(bundle.get("nodes"), dict)
 
 
 def diff_bundles(old: dict, new: dict) -> dict:
@@ -228,6 +245,109 @@ def diff_bundles(old: dict, new: dict) -> dict:
     return out
 
 
+def peer_drift(bundle: dict) -> List[str]:
+    """Per-node drift WITHIN one federated bundle: the flags that say one
+    member's engine state has diverged from its peers — the node a p99
+    regression on a 2-8 node bench run should be read against."""
+    flags: List[str] = []
+    nodes = bundle.get("nodes") or {}
+    reachable = {
+        nid: b for nid, b in nodes.items()
+        if isinstance(b, dict) and not b.get("unreachable")
+    }
+    for nid, b in sorted(nodes.items()):
+        if not isinstance(b, dict) or b.get("unreachable"):
+            flags.append(f"node {nid}: UNREACHABLE in this bundle")
+    if len(reachable) < 2:
+        return flags
+
+    # compile-cache drift: a member compiling shapes its peers never saw
+    # (or missing shapes every peer has) pays per-request XLA compiles the
+    # others don't — the classic one-node-p99 signature
+    shape_sets = {
+        nid: {
+            f"{e.get('subsystem')}:{e.get('shape')}"
+            for e in ((b.get("compiles") or {}).get("events") or [])
+        }
+        for nid, b in reachable.items()
+    }
+    union = set().union(*shape_sets.values())
+    for nid, shapes in sorted(shape_sets.items()):
+        missing = union - shapes
+        # only flag when a PEERED shape (seen on >= half the other nodes)
+        # is absent here; node-local tables legitimately differ
+        peered = {
+            s for s in missing
+            if sum(s in o for n2, o in shape_sets.items() if n2 != nid)
+            >= max((len(shape_sets) - 1 + 1) // 2, 1)
+        }
+        if peered:
+            flags.append(
+                f"node {nid}: compile cache diverged from peers — missing "
+                f"{len(peered)} shape(s) most peers compiled "
+                f"(e.g. {sorted(peered)[0]})"
+            )
+
+    # breaker/liveness drift: a member whose view of the cluster disagrees
+    # with its peers (open breakers, down marks) while the others are calm
+    for nid, b in sorted(reachable.items()):
+        cl = ((b.get("engine") or {}).get("cluster") or {})
+        for peer, st in sorted((cl.get("nodes") or {}).items()):
+            breaker = (st or {}).get("breaker")
+            if breaker and breaker != "closed":
+                flags.append(
+                    f"node {nid}: breaker {breaker.upper()} toward {peer} "
+                    "(its peers may be serving around a node this member "
+                    "considers dead)"
+                )
+
+    # column-mirror staleness drift: the same table stale on one member but
+    # fresh on its peers serves the row path only there
+    stale_by_tb: Dict[str, List[str]] = {}
+    fresh_by_tb: Dict[str, List[str]] = {}
+    for nid, b in reachable.items():
+        for tb, st in (((b.get("engine") or {}).get("column_mirrors")) or {}).items():
+            (stale_by_tb if st.get("stale") else fresh_by_tb).setdefault(
+                tb, []
+            ).append(nid)
+    for tb in sorted(stale_by_tb):
+        if tb in fresh_by_tb:
+            flags.append(
+                f"column mirror {tb}: STALE on {sorted(stale_by_tb[tb])} "
+                f"but fresh on {sorted(fresh_by_tb[tb])} — those members "
+                "serve the row path for the same statements"
+            )
+    return flags
+
+
+def diff_federated(old: dict, new: dict) -> dict:
+    """Two federated bundles: pairwise per-node section diffs (the
+    round-over-round view) plus the NEW bundle's peer-drift flags (the
+    within-round view)."""
+    out: Dict[str, Any] = {"per_node": {}, "flags": []}
+    onodes, nnodes = old.get("nodes") or {}, new.get("nodes") or {}
+    for nid in sorted(set(onodes) | set(nnodes)):
+        ob, nb = onodes.get(nid), nnodes.get(nid)
+        o_dead = not isinstance(ob, dict) or ob.get("unreachable")
+        n_dead = not isinstance(nb, dict) or nb.get("unreachable")
+        if o_dead and n_dead:
+            out["per_node"][nid] = {"unreachable": True}
+            continue
+        if n_dead:
+            out["per_node"][nid] = {"unreachable": True}
+            out["flags"].append(f"node {nid}: reachable before, UNREACHABLE now")
+            continue
+        if o_dead:
+            out["per_node"][nid] = {"appeared": True}
+            continue
+        rep = diff_bundles(ob, nb)
+        out["per_node"][nid] = rep
+        out["flags"].extend(f"node {nid}: {fl}" for fl in rep["flags"])
+    out["peer_drift"] = peer_drift(new)
+    out["flags"].extend(out["peer_drift"])
+    return out
+
+
 def _main_bundles(old_doc: dict, new_doc: dict) -> int:
     ob, nb = _as_bundle(old_doc), _as_bundle(new_doc)
     if ob is None or nb is None:
@@ -237,6 +357,24 @@ def _main_bundles(old_doc: dict, new_doc: dict) -> int:
             file=sys.stderr,
         )
         return 2
+    if _is_federated(ob) or _is_federated(nb):
+        if not (_is_federated(ob) and _is_federated(nb)):
+            print(
+                "cannot diff a federated (cluster=1) bundle against a "
+                "single-node one — capture both from the coordinator",
+                file=sys.stderr,
+            )
+            return 2
+        rep = diff_federated(ob, nb)
+        for nid, sub in sorted(rep["per_node"].items()):
+            head = "unreachable" if sub.get("unreachable") else (
+                "appeared" if sub.get("appeared") else f"{len(sub.get('flags') or [])} flag(s)"
+            )
+            print(f"node {nid}: {head}")
+        for fl in rep["flags"]:
+            print(f"FLAG  {fl}")
+        print(f"{len(rep['flags'])} drift flag(s)")
+        return 1 if rep["flags"] else 0
     rep = diff_bundles(ob, nb)
     for tb, entry in sorted(rep["columns"].items()):
         print(f"column {tb}: {json.dumps(entry)}")

@@ -234,6 +234,24 @@ def main() -> int:
             )
         if (ch.get("rf") or 1) >= 2 and not ch.get("degraded_responses"):
             failures.append("chaos window shows no degraded responses after the kill")
+        # events floor (schema/9): the chaos window's structured timeline
+        # must SHOW the failure handling — at least one breaker event, and
+        # every degraded read attributed to a statement's trace (an
+        # unattributed degraded read is a failover no one can explain)
+        ev = chaos_line.get("events")
+        if not isinstance(ev, dict):
+            failures.append("chaos line carries no 'events' accounting")
+        else:
+            if (ev.get("breaker") or 0) < 1:
+                failures.append(
+                    "chaos window shows no breaker event — the kill never "
+                    "tripped a circuit breaker"
+                )
+            if ev.get("unattributed_degraded_reads") != 0:
+                failures.append(
+                    f"{ev.get('unattributed_degraded_reads')} degraded "
+                    "read(s) carry no trace_id — unattributable failovers"
+                )
 
     summary = {
         "qps": qps,

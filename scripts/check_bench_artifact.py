@@ -23,7 +23,7 @@ import os
 import sys
 from typing import List
 
-SCHEMA = "surrealdb-tpu-bench/8"
+SCHEMA = "surrealdb-tpu-bench/9"
 # earlier rounds' committed artifacts stay validatable under their own rules
 KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/1",
@@ -33,6 +33,7 @@ KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/5",
     "surrealdb-tpu-bench/6",
     "surrealdb-tpu-bench/7",
+    "surrealdb-tpu-bench/8",
     SCHEMA,
 )
 
@@ -80,6 +81,11 @@ CHAOS_KEYS = (
 )
 BUNDLE_SECTIONS = ("traces", "slow_queries", "errors", "tasks", "compiles", "engine")
 BUNDLE_SECTIONS_V8 = BUNDLE_SECTIONS + ("locks", "faults")
+# schema/9 (cluster observability): the ninth section is the structured
+# event timeline, and cluster/chaos config lines embed the FEDERATED
+# cluster bundle + the slowest statement's per-shard profile (cluster_obs)
+BUNDLE_SECTIONS_V9 = BUNDLE_SECTIONS_V8 + ("events",)
+CLUSTER_OBS_KEYS = ("bundle", "slowest_profile", "live_nodes")
 COMPILES_KEYS = ("on_demand", "prewarm", "events")
 BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
 BATCH_KEYS_V3 = BATCH_KEYS + ("width_dist", "pipeline_wait_s")
@@ -103,7 +109,8 @@ def validate(path: str) -> List[str]:
     if art.get("schema") not in KNOWN_SCHEMAS:
         problems.append(f"schema is {art.get('schema')!r}, expected one of {KNOWN_SCHEMAS}")
     schema = art.get("schema")
-    v8 = schema == SCHEMA
+    v9 = schema == SCHEMA
+    v8 = v9 or schema == "surrealdb-tpu-bench/8"
     v7 = v8 or schema == "surrealdb-tpu-bench/7"
     v6 = v7 or schema == "surrealdb-tpu-bench/6"
     v5 = v6 or schema == "surrealdb-tpu-bench/5"
@@ -125,7 +132,12 @@ def validate(path: str) -> List[str]:
         if not isinstance(bundle, dict):
             problems.append("schema/5 artifact missing the embedded debug bundle")
         else:
-            for sec in (BUNDLE_SECTIONS_V8 if v8 else BUNDLE_SECTIONS):
+            sections = (
+                BUNDLE_SECTIONS_V9
+                if v9
+                else (BUNDLE_SECTIONS_V8 if v8 else BUNDLE_SECTIONS)
+            )
+            for sec in sections:
                 if sec not in bundle:
                     problems.append(f"bundle: missing section {sec!r}")
     for key in ("scale", "configs", "results"):
@@ -262,6 +274,48 @@ def validate(path: str) -> List[str]:
                         problems.append(
                             f"{where} ({metric}): a replicated chaos window "
                             "with a killed node must show degraded responses"
+                        )
+        if v9 and (metric.startswith("cluster_") or metric.startswith("chaos_")):
+            co = r.get("cluster_obs")
+            if not isinstance(co, dict):
+                problems.append(
+                    f"{where} ({metric}): schema/9 cluster lines must carry "
+                    "the 'cluster_obs' object (federated bundle + slowest "
+                    "per-shard profile)"
+                )
+            else:
+                for key in CLUSTER_OBS_KEYS:
+                    if key not in co:
+                        problems.append(
+                            f"{where} ({metric}): cluster_obs missing {key!r}"
+                        )
+                fb = co.get("bundle")
+                if not (
+                    isinstance(fb, dict)
+                    and isinstance(fb.get("nodes"), dict)
+                    and fb.get("nodes")
+                ):
+                    problems.append(
+                        f"{where} ({metric}): cluster_obs.bundle must be a "
+                        "federated bundle with a non-empty 'nodes' map"
+                    )
+                prof = co.get("slowest_profile")
+                live = co.get("live_nodes")
+                if not (isinstance(prof, dict) and isinstance(prof.get("shards"), dict)):
+                    problems.append(
+                        f"{where} ({metric}): cluster_obs.slowest_profile "
+                        "must carry per-node 'shards' timings"
+                    )
+                elif isinstance(live, list):
+                    # the acceptance bar: a profile that cannot attribute
+                    # time to every LIVE node cannot name the slow shard
+                    missing_nodes = sorted(
+                        set(str(n) for n in live) - set(prof["shards"])
+                    )
+                    if missing_nodes:
+                        problems.append(
+                            f"{where} ({metric}): slowest_profile shard "
+                            f"timings missing live node(s) {missing_nodes}"
                         )
         if v4 and metric.startswith("filtered_scan"):
             for key in FILTERED_SCAN_KEYS:

@@ -32,6 +32,11 @@ to enforce from memory:
          sleep/backoff (a CPU-speed hammer on a failing dependency),
          and broad `except Exception: pass` swallows that erase the
          evidence every recovery path needs
+  GL009  event-timeline hygiene (events.py's static twin): emissions
+         must go through events.emit(kind, ...) with a kind from the
+         declared KINDS registry — dynamic/unregistered kinds and
+         ad-hoc appends to the ring are un-filterable, un-alertable
+         timeline entries
 
 Workflow:
 

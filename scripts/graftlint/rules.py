@@ -515,6 +515,125 @@ def _gl008_has_pacing(node: ast.AST) -> bool:
     return False
 
 
+@_rule("GL009", "event emitted outside events.emit / with an unregistered kind")
+def gl009(modules: List[Module]) -> List[Finding]:
+    """The event timeline (surrealdb_tpu/events.py) is a CLOSED registry:
+    every emission goes through `events.emit(kind, ...)` with a kind
+    declared in events.KINDS — a dynamic or unregistered kind is a
+    timeline entry nobody can filter, alert on, or document, and an
+    ad-hoc append to the ring (`events._ring`) bypasses the trace link,
+    the counter, and the runtime registry check."""
+    kinds = _gl009_registry()
+    out: List[Finding] = []
+    for m in modules:
+        if m.rel == "surrealdb_tpu/events.py":
+            continue
+        # direct-import aliases: `from surrealdb_tpu.events import emit`
+        # (or `emit as e`) must not bypass the rule, and importing the
+        # ring itself is flagged at the import site
+        emit_names: Set[str] = set()
+        for imp in ast.walk(m.tree):
+            if not (
+                isinstance(imp, ast.ImportFrom)
+                and imp.module == "surrealdb_tpu.events"
+            ):
+                continue
+            for a in imp.names:
+                if a.name == "emit":
+                    emit_names.add(a.asname or a.name)
+                elif a.name == "_ring":
+                    out.append(
+                        Finding(
+                            "GL009", m.rel, imp.lineno, imp.col_offset,
+                            "importing events._ring — the timeline is "
+                            "written only through events.emit(kind, ...) "
+                            "(trace link + counter + registry check)",
+                            f"GL009:{m.rel}:import:_ring",
+                        )
+                    )
+        for node in ast.walk(m.tree):
+            # (a) ad-hoc ring access: events._ring.<anything> outside the
+            # module that owns it
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr == "_ring"
+                and isinstance(node.value, ast.Name)
+                and node.value.id in ("events", "_events")
+            ):
+                out.append(
+                    Finding(
+                        "GL009", m.rel, node.lineno, node.col_offset,
+                        "direct events._ring access — the timeline is "
+                        "written only through events.emit(kind, ...) "
+                        "(trace link + counter + registry check)",
+                        f"GL009:{m.rel}:{m.enclosing_def(node)}:ring",
+                    )
+                )
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            recv, attr = _call_name(node)
+            is_emit = (attr == "emit" and recv in ("events", "_events")) or (
+                recv is None and attr in emit_names
+            )
+            if not is_emit:
+                continue
+            if not node.args:
+                continue
+            names = _gl009_kind_strings(node.args[0])
+            if names is None:
+                out.append(
+                    Finding(
+                        "GL009", m.rel, node.lineno, node.col_offset,
+                        "events.emit with a DYNAMIC kind — kinds are a "
+                        "closed registry (events.KINDS); use a static "
+                        "registered string and put the variable part in "
+                        "a field",
+                        f"GL009:{m.rel}:{m.enclosing_def(node)}:dynamic-kind",
+                    )
+                )
+                continue
+            for name in names:
+                if kinds is not None and name not in kinds:
+                    out.append(
+                        Finding(
+                            "GL009", m.rel, node.lineno, node.col_offset,
+                            f"events.emit kind {name!r} is not in the "
+                            "events.KINDS registry — register it (with a "
+                            "description) before emitting",
+                            f"GL009:{m.rel}:kind:{name}",
+                        )
+                    )
+    return out
+
+
+def _gl009_kind_strings(a0: ast.AST) -> Optional[List[str]]:
+    """Static kind candidates of an emit's first arg: a string constant,
+    or a conditional expression whose branches both resolve statically
+    (`"a.up" if up else "a.down"` names two registered kinds). None means
+    the kind is dynamic."""
+    if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+        return [a0.value]
+    if isinstance(a0, ast.IfExp):
+        body = _gl009_kind_strings(a0.body)
+        orelse = _gl009_kind_strings(a0.orelse)
+        if body is not None and orelse is not None:
+            return body + orelse
+    return None
+
+
+def _gl009_registry() -> Optional[Set[str]]:
+    """The declared kind registry. Imported from the real module (linting
+    runs from the repo root) so the rule and the runtime check can never
+    drift; None (skip the kind check) if the engine is unimportable."""
+    try:
+        from surrealdb_tpu.events import KINDS
+
+        return set(KINDS)
+    except Exception:  # noqa: BLE001 — lint must not require a working engine
+        return None
+
+
 @_rule("GL008", "retry loop without backoff/attempt cap; bare except-swallow")
 def gl008(modules: List[Module]) -> List[Finding]:
     out: List[Finding] = []

@@ -3,7 +3,7 @@
 # wrapped so CI and humans run the identical command, plus the repo's
 # static-analysis and concurrency-sanitizer gates:
 #
-#   0. `python -m scripts.graftlint` — engine-specific lint (GL001–GL008);
+#   0. `python -m scripts.graftlint` — engine-specific lint (GL001–GL009);
 #      findings beyond scripts/graftlint/baseline.json fail the gate.
 #   1. the pytest tier-1 suite (exit code preserved; log in /tmp/_t1.log,
 #      DOTS_PASSED recount printed — driver-proof pass counting).
@@ -82,6 +82,7 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
   tests/test_flight_recorder.py tests/test_column_scan.py \
   tests/test_kvs.py tests/test_e2e_crud.py tests/test_cluster.py \
   tests/test_bulk_ingest_v2.py tests/test_faults.py \
+  tests/test_cluster_obs.py \
   -q -p no:cacheprovider -p no:xdist -p no:randomly >/tmp/_t1_sanitize.log 2>&1
 san_rc=$?
 [ "$san_rc" -ne 0 ] && tail -20 /tmp/_t1_sanitize.log

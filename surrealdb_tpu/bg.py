@@ -245,6 +245,13 @@ def run(task_id: int, rename_thread: bool = True):
                     # it finished after all — count the recovery so a
                     # stalled counter spike can be read against it
                     telemetry.inc("bg_task_recovered", kind=t.kind)
+                    from surrealdb_tpu import events
+
+                    events.emit(
+                        "bg.recovered", trace_id=t.trace_id,
+                        task=t.kind, target=t.target, task_id=t.id,
+                        duration_s=round(t.duration_s, 3),
+                    )
                 kind = t.kind
             else:
                 kind = None
@@ -337,7 +344,16 @@ def spawn_service(
                     rec = _tasks.get(tid)
                     if rec is not None:
                         rec.retries += 1
+                        err = rec.error
+                    else:
+                        err = None
                 telemetry.inc("bg_service_restarts", kind=kind)
+                from surrealdb_tpu import events
+
+                events.emit(
+                    "bg.service_restart", task=kind, target=target,
+                    **({"error": err} if err else {}),
+                )
                 if time.monotonic() - started >= max(
                     cnf.BG_SERVICE_HEALTHY_RESET_SECS, 1.0
                 ):
@@ -450,6 +466,14 @@ def _watchdog_loop() -> None:
             # counter first: observers poll state->counter in lockstep and
             # must not see a stalled task without its metric
             telemetry.inc("bg_task_stalled", kind=t.kind)
+            from surrealdb_tpu import events
+
+            # the watchdog runs outside any request — cite the task's own
+            # arming trace so the timeline entry still joins a statement
+            events.emit(
+                "bg.stall", trace_id=t.trace_id,
+                task=t.kind, target=t.target, task_id=t.id,
+            )
         if flagged:
             # sample the wedged threads' stacks (sys._current_frames — the
             # faulthandler view, but attributable per task) so the bundle's

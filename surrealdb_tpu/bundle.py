@@ -20,13 +20,22 @@ versioned document — the artifact you attach to any perf report:
                      guarded-state violations (populated under
                      SURREAL_SANITIZE=1; enabled=false otherwise);
 8. `faults`        — the failpoint engine's state (faults.py): armed
-                     sites, per-site trip counters, the chaos seed.
+                     sites, per-site trip counters, the chaos seed;
+9. `events`        — the structured event timeline (events.py): bounded,
+                     trace-linked operational transitions (flaps, breaker
+                     trips, degraded reads, sheds, failpoint trips,
+                     bg stalls/restarts, group-commit rescues).
 
 Served by `GET /debug/bundle` (system-user-gated) and embedded via
 `INFO FOR ROOT` (`system.bundle`); bench.py embeds one per artifact so a
 perf number always ships with the engine state that produced it. Works
 with `ds=None` too (global registries only) — the tier-1 failure hook
 uses that to dump diagnostics from a dying test process.
+
+On a cluster node `GET /debug/bundle?cluster=1` federates instead
+(cluster/federation.py): one `surrealdb-tpu-bundle/3` document whose
+`nodes` map carries every member's full bundle, dead members marked
+`{"unreachable": true}` — the request still answers 200.
 """
 
 from __future__ import annotations
@@ -34,19 +43,19 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, Optional
 
-BUNDLE_SCHEMA = "surrealdb-tpu-bundle/2"
+BUNDLE_SCHEMA = "surrealdb-tpu-bundle/3"
 
 # the sections every consumer may rely on
 SECTIONS = (
     "traces", "slow_queries", "errors", "tasks", "compiles", "engine",
-    "locks", "faults",
+    "locks", "faults", "events",
 )
 
 
 def debug_bundle(
     ds=None, trace_limit: int = 50, full_traces: int = 10
 ) -> Dict[str, Any]:
-    from surrealdb_tpu import bg, compile_log, faults, telemetry, tracing
+    from surrealdb_tpu import bg, compile_log, events, faults, telemetry, tracing
     from surrealdb_tpu.utils import locks
 
     ids = tracing.trace_ids()
@@ -71,6 +80,7 @@ def debug_bundle(
         "engine": _engine_state(ds),
         "locks": locks.report(),
         "faults": faults.snapshot(),
+        "events": events.snapshot(),
     }
     return out
 

@@ -213,6 +213,8 @@ class ClusterClient:
         (concurrent callers keep failing fast while it is in flight)."""
         from surrealdb_tpu import telemetry
 
+        trial = False
+        went_half_open = False
         with self._breaker_lock:
             b = self._breakers.get(node_id)
             if b is None or b.state == _CLOSED:
@@ -223,10 +225,20 @@ class ClusterClient:
             ):
                 b.state = _HALF_OPEN
                 b.trial_inflight = False
+                went_half_open = True
             if b.state == _HALF_OPEN and not b.trial_inflight:
                 b.trial_inflight = True  # this caller is the trial
-                return
-            state = _STATE_NAMES[b.state]
+                trial = True
+            else:
+                state = _STATE_NAMES[b.state]
+        if trial:
+            # emit OUTSIDE the breaker lock (concurrent fast-failers
+            # contend on it), matching every other emit in this module
+            if went_half_open:
+                from surrealdb_tpu import events
+
+                events.emit("cluster.breaker_half_open", node=node_id)
+            return
         telemetry.inc("cluster_breaker_fast_fails", node=node_id)
         raise NodeUnavailableError(
             node_id, self.config.url_of(node_id),
@@ -248,15 +260,17 @@ class ClusterClient:
         self._breaker_set(node_id, up=False)
 
     def _breaker_set(self, node_id: str, up: bool) -> None:
-        from surrealdb_tpu import telemetry
+        from surrealdb_tpu import events, telemetry
 
         tripped = False
+        reclosed = False
         with self._breaker_lock:
             b = self._breakers.get(node_id)
             if b is None:
                 return
             if up:
                 changed = b.state != _CLOSED or b.fails
+                reclosed = b.state != _CLOSED
                 b.state = _CLOSED
                 b.fails = 0
                 b.trial_inflight = False
@@ -275,9 +289,13 @@ class ClusterClient:
                     b.state = _OPEN
                     b.opened_at = _time.monotonic()
             state = b.state
+            fails = b.fails
         telemetry.gauge_set("cluster_breaker_state", float(state), node=node_id)
         if tripped:
             telemetry.inc("cluster_breaker_trips", node=node_id)
+            events.emit("cluster.breaker_open", node=node_id, fails=fails)
+        elif reclosed:
+            events.emit("cluster.breaker_close", node=node_id)
 
     def breaker_state(self, node_id: str) -> str:
         with self._breaker_lock:
@@ -286,9 +304,10 @@ class ClusterClient:
 
     # ------------------------------------------------------------ health
     def _mark(self, node_id: str, up: bool, error: Optional[str] = None) -> None:
-        from surrealdb_tpu import telemetry
+        from surrealdb_tpu import events, telemetry
 
         flapped = False
+        changed = False
         with self._lock:
             h = self._health.get(node_id)
             if h is None:
@@ -296,13 +315,24 @@ class ClusterClient:
             if h["up"] is not None and h["up"] != up:
                 h["flaps"] += 1
                 flapped = True
+            changed = h["up"] != up
             h["up"] = up
             h["error"] = error
             if up:
                 h["last_seen"] = _time.time()
+            flaps = h["flaps"]
         telemetry.gauge_set("cluster_node_up", 1.0 if up else 0.0, node=node_id)
         if flapped:
             telemetry.inc("cluster_node_flaps_total", node=node_id)
+        if changed:
+            # timeline entry per TRANSITION (not per probe beat): an event
+            # emitted while serving a statement carries that statement's
+            # trace id — the flap joins the request it degraded
+            events.emit(
+                "cluster.node_up" if up else "cluster.node_down",
+                node=node_id, flaps=flaps,
+                **({"error": str(error)[:200]} if error else {}),
+            )
 
     def health(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:

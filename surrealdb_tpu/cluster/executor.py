@@ -109,7 +109,7 @@ from surrealdb_tpu.sql.value import (
 )
 
 from . import merge as _merge
-from .client import ClusterError, NodeUnavailableError
+from .client import ClusterError, NodeUnavailableError, RemoteOpError
 
 _DIST = "__cluster_dist"
 _SCORE = "__cluster_score"
@@ -138,29 +138,129 @@ def _err(msg: str) -> dict:
 
 
 class _StmtCtx:
-    """Per-statement fault accounting: the shared retry budget every
-    scatter draws from, and the degraded/failed-node view that ends up on
-    the response. Mutated from pool threads — guarded by a raw lock."""
+    """Per-statement fault accounting AND the per-shard execution profile:
+    the shared retry budget every scatter draws from, the degraded/
+    failed-node view that ends up on the response, and — new with the
+    observability plane — per-node RPC timing/row/retry/failover counts,
+    admission wait, merge time, and the remote slow/error ring entries
+    carried back on RPC responses. Mutated from pool threads — guarded by
+    a raw lock."""
 
-    __slots__ = ("degraded", "failed_nodes", "_budget", "_lock")
+    __slots__ = (
+        "degraded", "failed_nodes", "_budget", "_lock",
+        "scatter_kind", "admission_wait_s", "merge_s", "rows_gathered",
+        "retries", "shards", "remote_slow", "remote_errors",
+    )
 
     def __init__(self, budget: int):
         self.degraded = False
         self.failed_nodes: set = set()
         self._budget = max(int(budget), 0)
         self._lock = threading.Lock()
+        self.scatter_kind: Optional[str] = None
+        self.admission_wait_s = 0.0
+        self.merge_s = 0.0
+        self.rows_gathered: Optional[int] = None
+        self.retries = 0
+        # node -> {"calls", "rpc_s", "max_rpc_s", "rows", "retries",
+        #          "failovers", "errors"} (seconds internally; the profile
+        #          renders milliseconds)
+        self.shards: Dict[str, dict] = {}
+        self.remote_slow: List[dict] = []
+        self.remote_errors: List[dict] = []
 
     def take_retry(self) -> bool:
         with self._lock:
             if self._budget <= 0:
                 return False
             self._budget -= 1
+            self.retries += 1
             return True
 
-    def note_failover(self, node_id: str) -> None:
+    def _shard(self, node_id: str) -> dict:
+        sh = self.shards.get(node_id)
+        if sh is None:
+            sh = self.shards[node_id] = {
+                "calls": 0, "rpc_s": 0.0, "max_rpc_s": 0.0, "rows": 0,
+                "retries": 0, "failovers": 0, "errors": 0,
+            }
+        return sh
+
+    def record_rpc(
+        self, node_id: str, dur_s: float,
+        rows: Optional[int] = None, error: bool = False, retry: bool = False,
+    ) -> None:
+        """One RPC attempt's contribution to the node's shard profile."""
+        with self._lock:
+            sh = self._shard(node_id)
+            sh["calls"] += 1
+            sh["rpc_s"] += dur_s
+            sh["max_rpc_s"] = max(sh["max_rpc_s"], dur_s)
+            if rows is not None:
+                sh["rows"] += rows
+            if error:
+                sh["errors"] += 1
+            if retry:
+                sh["retries"] += 1
+
+    def harvest_remote(self, node_id: str, resp: dict) -> None:
+        """Remote-shard slow/error ring entries ride the RPC response
+        (cluster/rpc.py) — collect them node-tagged so the coordinator's
+        ring shows the cluster statement ONCE with a per-node breakdown."""
+        slow = resp.get("slow")
+        errs = resp.get("errors")
+        if not slow and not errs:
+            return
+        with self._lock:
+            for e in slow or []:
+                if isinstance(e, dict):
+                    self.remote_slow.append(dict(e, node=node_id))
+            for e in errs or []:
+                if isinstance(e, dict):
+                    self.remote_errors.append(dict(e, node=node_id))
+
+    def note_failover(self, node_id: str, kind: str = "read") -> None:
+        from surrealdb_tpu import events
+
         with self._lock:
             self.failed_nodes.add(node_id)
             self.degraded = True
+            self._shard(node_id)["failovers"] += 1
+        # timeline: the degraded read/write joins the statement's trace
+        events.emit(
+            "cluster.degraded_read" if kind == "read" else "cluster.degraded_write",
+            node=node_id,
+        )
+
+    def profile(self, sql: str, kind: str, dur_s: float) -> dict:
+        """The per-shard statement profile: the EXPLAIN ANALYZE payload,
+        the slow-ring attachment, and the trace annotation — one shape."""
+        with self._lock:
+            shards = {
+                n: {
+                    "calls": sh["calls"],
+                    "rpc_ms": round(sh["rpc_s"] * 1e3, 3),
+                    "max_rpc_ms": round(sh["max_rpc_s"] * 1e3, 3),
+                    "rows": sh["rows"],
+                    "retries": sh["retries"],
+                    "failovers": sh["failovers"],
+                    "errors": sh["errors"],
+                }
+                for n, sh in sorted(self.shards.items())
+            }
+            return {
+                "sql": sql[:200],
+                "kind": kind,
+                "scatter": self.scatter_kind,
+                "duration_ms": round(dur_s * 1e3, 3),
+                "admission_wait_ms": round(self.admission_wait_s * 1e3, 3),
+                "merge_ms": round(self.merge_s * 1e3, 3),
+                "rows_gathered": self.rows_gathered,
+                "retries": self.retries,
+                "degraded": self.degraded,
+                "failed_nodes": sorted(self.failed_nodes),
+                "shards": shards,
+            }
 
 
 _STMT: "contextvars.ContextVar[Optional[_StmtCtx]]" = contextvars.ContextVar(
@@ -180,8 +280,12 @@ class _Admission:
         self._waiters = 0
 
     def acquire(self) -> None:
-        from surrealdb_tpu import telemetry
+        """Admit or shed. Returns normally once admitted; the caller's
+        statement context records the wait as `admission_wait_ms` (the
+        queue-wait slice of the per-shard profile)."""
+        from surrealdb_tpu import events, telemetry
 
+        t0 = _time.perf_counter()
         cap = max(cnf.CLUSTER_MAX_INFLIGHT, 1)
         with self._cv:
             if self._inflight < cap:
@@ -202,11 +306,15 @@ class _Admission:
                         self._cv.wait(left)
                     if self._inflight < cap:
                         self._inflight += 1
+                        ctx = _STMT.get(None)
+                        if ctx is not None:
+                            ctx.admission_wait_s += _time.perf_counter() - t0
                         return
                     reason = "wait_timeout"
                 finally:
                     self._waiters -= 1
         telemetry.inc("cluster_shed_total", reason=reason)
+        events.emit("cluster.admission_shed", reason=reason)
         raise ClusterOverloadedError(
             "coordinator overloaded: statement shed by admission control "
             f"({reason}); the request is safe to retry"
@@ -235,9 +343,30 @@ class ClusterExecutor:
             thread_name_prefix="cluster-scatter",
         )
         self.admission = _Admission()
+        # slowest per-shard profile since the last reset (bench artifacts
+        # embed it; raw lock — leaf-only, never nests)
+        self._profile_lock = threading.Lock()
+        self._slowest_profile: Optional[dict] = None
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)
+
+    # ------------------------------------------------------------ profiles
+    def _note_profile(self, profile: dict) -> None:
+        with self._profile_lock:
+            cur = self._slowest_profile
+            if cur is None or profile["duration_ms"] > cur["duration_ms"]:
+                self._slowest_profile = profile
+
+    def slowest_profile(self) -> Optional[dict]:
+        """The slowest scattered statement's per-shard profile since the
+        last reset (bench config 7/8 artifacts embed it)."""
+        with self._profile_lock:
+            return dict(self._slowest_profile) if self._slowest_profile else None
+
+    def reset_profiles(self) -> None:
+        with self._profile_lock:
+            self._slowest_profile = None
 
     # ------------------------------------------------------------ entry
     def execute(self, text: str, session, vars: Optional[Dict[str, Any]] = None) -> List[dict]:
@@ -273,9 +402,75 @@ class ClusterExecutor:
                     # was down — callers polling for cluster health read it
                     # here instead of diffing counters
                     resp["degraded"] = True
-                resp["time"] = _fmt_time(_time.perf_counter() - t0)
+                dt = _time.perf_counter() - t0
+                self._account_statement(stm, src, session, ctx, resp, dt)
+                resp["time"] = _fmt_time(dt)
                 out.append(resp)
             return out
+
+    def _account_statement(
+        self, stm, src: str, session, ctx: _StmtCtx, resp: dict, dt: float
+    ) -> None:
+        """Close the observability loop on one coordinated statement: build
+        the per-shard profile, pin it onto the request's trace, track the
+        slowest one, and — when the statement was slow or errored — record
+        it into the COORDINATOR's slow/error rings with the remote shards'
+        own ring entries joined in (today a slow remote shard is only
+        visible on the remote node; after this it shows up once, here,
+        with the per-node breakdown)."""
+        from surrealdb_tpu import telemetry, tracing
+
+        if not ctx.shards:
+            # not a scattered statement: the local execution path already
+            # did its own slow/error accounting (dbs/executor.py)
+            return
+        kind = type(stm).__name__
+        profile = ctx.profile(src, kind, dt)
+        tracing.annotate_append("cluster_profiles", profile)
+        self._note_profile(profile)
+        session_info = {
+            "ns": session.ns,
+            "db": session.db,
+            "auth": getattr(session.auth, "level", None) or "anon",
+        }
+        errored = resp.get("status") == "ERR"
+        if errored:
+            telemetry.inc("statement_errors", kind=kind)
+            tracing.force_keep()
+            telemetry.record_error(
+                {
+                    "ts": _time.time(),
+                    "kind": kind,
+                    "error": str(resp.get("result"))[:300],
+                    "trace_id": tracing.current_trace_id(),
+                    "session": session_info,
+                    "cluster": {
+                        "shards": profile["shards"],
+                        "remote_errors": list(ctx.remote_errors),
+                    },
+                }
+            )
+        if dt >= cnf.SLOW_QUERY_THRESHOLD_SECS:
+            telemetry.inc("slow_queries", kind=kind)
+            tracing.force_keep()  # /slow -> /trace/:id must stay one hop
+            telemetry.record_slow_query(
+                {
+                    "ts": _time.time(),
+                    "sql": src[:500],
+                    "kind": kind,
+                    "duration_s": round(dt, 6),
+                    "plan": telemetry.drain_plan_notes(),
+                    "trace_id": tracing.current_trace_id(),
+                    "session": session_info,
+                    "error": str(resp.get("result"))[:500] if errored else None,
+                    "cluster": {
+                        "profile": profile,
+                        # the remote shards' OWN slow entries (their inner
+                        # scattered statements), node-tagged
+                        "remote_slow": list(ctx.remote_slow),
+                    },
+                }
+            )
 
     # ------------------------------------------------------------ routing
     def _route(self, stm, src: str, session, vars) -> dict:
@@ -381,12 +576,19 @@ class ClusterExecutor:
         while True:
             t0 = _time.monotonic()
             try:
-                return self._call_once(node_id, op, req)
+                resp = self._call_once(node_id, op, req)
+            except RemoteOpError:
+                # the node is alive and EXECUTED the op but reported a
+                # failure — the attempt still belongs in the shard profile
+                # (a statement errored by one shard must name that shard)
+                ctx = _STMT.get(None)
+                if ctx is not None:
+                    ctx.record_rpc(node_id, _time.monotonic() - t0, error=True)
+                raise
             except NodeUnavailableError as e:
                 ctx = _STMT.get(None)
-                slow = (_time.monotonic() - t0) >= 0.5 * max(
-                    cnf.CLUSTER_RPC_TIMEOUT_SECS, 0.1
-                )
+                dur = _time.monotonic() - t0
+                slow = dur >= 0.5 * max(cnf.CLUSTER_RPC_TIMEOUT_SECS, 0.1)
                 if (
                     not idempotent
                     or slow
@@ -395,7 +597,10 @@ class ClusterExecutor:
                     or ctx is None
                     or not ctx.take_retry()
                 ):
+                    if ctx is not None:
+                        ctx.record_rpc(node_id, dur, error=True)
                     raise
+                ctx.record_rpc(node_id, dur, error=True, retry=True)
                 delay = min(
                     max(cnf.CLUSTER_RETRY_BASE_SECS, 0.001) * (2 ** attempt),
                     max(cnf.CLUSTER_RETRY_MAX_SECS, 0.001),
@@ -404,6 +609,14 @@ class ClusterExecutor:
                 _time.sleep(delay * (0.5 + 0.5 * _random.random()))
                 attempt += 1
                 telemetry.inc("cluster_retries", op=op)
+            else:
+                ctx = _STMT.get(None)
+                if ctx is not None:
+                    ctx.record_rpc(
+                        node_id, _time.monotonic() - t0, rows=_resp_rows(resp)
+                    )
+                    ctx.harvest_remote(node_id, resp)
+                return resp
 
     def _fan_out(
         self,
@@ -607,6 +820,7 @@ class ClusterExecutor:
         schema does not)."""
         from surrealdb_tpu import telemetry
 
+        self._set_scatter_kind("ddl")
         with telemetry.span("cluster_scatter", kind="ddl"):
             per_node = self._scatter_sql(self._all_nodes(), src, session, vars)
         mine = per_node.get(self.node.node_id) or []
@@ -638,6 +852,7 @@ class ClusterExecutor:
                 "deduplicated across replicas — use RETURN AFTER, BEFORE "
                 "or NONE in cluster mode"
             )
+        self._set_scatter_kind("write")
         with telemetry.span("cluster_scatter", kind="write"):
             per_node = self._scatter_sql(
                 self._all_nodes(), src, session, vars,
@@ -687,7 +902,7 @@ class ClusterExecutor:
             for e in down:
                 telemetry.inc("cluster_failover_total", op="write")
                 if ctx is not None and getattr(e, "node_id", None) is not None:
-                    ctx.note_failover(e.node_id)
+                    ctx.note_failover(e.node_id, kind="write")
         reporter = next(nid for nid in replicas if nid in gathered)
         results = gathered[reporter].get("results") or []
         for r in results:
@@ -714,7 +929,7 @@ class ClusterExecutor:
                 telemetry.inc("cluster_failover_total", op="write")
                 ctx = _STMT.get(None)
                 if ctx is not None:
-                    ctx.note_failover(nid)
+                    ctx.note_failover(nid, kind="write")
         rows: List[Any] = []
         for resp in results:
             r = resp.get("result")
@@ -748,6 +963,7 @@ class ClusterExecutor:
                 return _err(f"{verb}: unsupported cluster target {t!r}")
         rows: List[Any] = []
         saved_what = stm.what
+        self._set_scatter_kind("write")
         try:
             with telemetry.span("cluster_scatter", kind="write"):
                 for t in things:
@@ -816,6 +1032,7 @@ class ClusterExecutor:
             + f"INTO {escape_ident(tb)} ${_ROWS}"
         )
         indexed: List[Tuple[int, Any]] = []
+        self._set_scatter_kind("write")
         with telemetry.span("cluster_scatter", kind="write"):
             for replicas, batch in by_replicas.items():
                 got = self._write_replicas(
@@ -886,6 +1103,7 @@ class ClusterExecutor:
                 by_replicas.setdefault(replicas, []).append((f, edge_of(f, w), w))
         saved = (stm.from_, stm.with_, stm.kind)
         rows: List[Any] = []
+        self._set_scatter_kind("write")
         try:
             with telemetry.span("cluster_scatter", kind="write"):
                 for replicas, pairs in by_replicas.items():
@@ -911,7 +1129,9 @@ class ClusterExecutor:
         from surrealdb_tpu import telemetry
 
         if getattr(stm, "explain", False):
-            return self._local_stm(src, session, vars)
+            if not getattr(stm, "explain_analyze", False):
+                return self._local_stm(src, session, vars)
+            return self._explain_analyze(stm, session, vars)
         if getattr(stm, "fetch", None):
             return _err("FETCH is not supported in cluster mode yet")
 
@@ -938,6 +1158,7 @@ class ClusterExecutor:
 
         graph = self._graph_shape(stm)
         if graph is not None:
+            self._set_scatter_kind("graph")
             with telemetry.span("cluster_scatter", kind="graph"):
                 return self._graph_select(stm, session, vars, graph)
 
@@ -958,16 +1179,82 @@ class ClusterExecutor:
                     "GROUP over graph projections aggregates per shard — "
                     "not supported in cluster mode"
                 )
+            self._set_scatter_kind("colocated")
             with telemetry.span("cluster_scatter", kind="colocated"):
                 return self._colocated_select(stm, session, vars)
 
         kind = "knn" if knn is not None else ("bm25" if matches is not None else "scan")
+        self._set_scatter_kind(kind)
         with telemetry.span("cluster_scatter", kind=kind):
             if knn is not None:
                 return self._scatter_select(stm, session, vars, knn=knn)
             if matches is not None:
                 return self._scatter_select(stm, session, vars, matches=matches)
             return self._scatter_select(stm, session, vars)
+
+    @staticmethod
+    def _set_scatter_kind(kind: str) -> None:
+        ctx = _STMT.get(None)
+        if ctx is not None:
+            ctx.scatter_kind = kind
+
+    def _explain_analyze(self, stm, session, vars) -> dict:
+        """EXPLAIN ANALYZE on a cluster statement: execute the scatter FOR
+        REAL (flags stripped), then render the statement context's
+        per-shard profile as plan operations — per-node RPC latency and
+        rows, queue/admission wait, retries, failovers, merge time. The
+        same profile is pinned onto the request's trace, so the slowest
+        `Shard` row here matches the slowest `cluster_rpc` span there."""
+        saved = (stm.explain, stm.explain_full, stm.explain_analyze)
+        stm.explain = stm.explain_full = stm.explain_analyze = False
+        t0 = _time.perf_counter()
+        try:
+            resp = self._select(stm, repr(stm), session, vars)
+        finally:
+            stm.explain, stm.explain_full, stm.explain_analyze = saved
+        dur = _time.perf_counter() - t0
+        if resp.get("status") != "OK":
+            return resp
+        ctx = _STMT.get(None)
+        if ctx is None or not ctx.shards:
+            # a shape that never scattered (LET-fed params etc.) still
+            # answers with an Execute row so the output shape is stable
+            return _ok([{
+                "operation": "Execute",
+                "detail": {"duration_ms": round(dur * 1e3, 3)},
+            }])
+        profile = ctx.profile(repr(stm), type(stm).__name__, dur)
+        ops: List[dict] = [{
+            "operation": "Cluster Scatter",
+            "detail": {
+                "kind": profile["scatter"],
+                "nodes": len(profile["shards"]),
+                "admission_wait_ms": profile["admission_wait_ms"],
+            },
+        }]
+        for node, sh in profile["shards"].items():
+            ops.append({"operation": "Shard", "detail": dict(sh, node=node)})
+        ops.append({
+            "operation": "Merge",
+            "detail": {
+                "merge_ms": profile["merge_ms"],
+                "rows_gathered": profile["rows_gathered"],
+                "degraded": profile["degraded"],
+                "failed_nodes": profile["failed_nodes"],
+                "retries": profile["retries"],
+            },
+        })
+        rows = resp.get("result")
+        ops.append({
+            "operation": "Execute",
+            "detail": {
+                "duration_ms": profile["duration_ms"],
+                "rows": len(rows) if isinstance(rows, list) else (
+                    0 if rows is None or is_none(rows) else 1
+                ),
+            },
+        })
+        return _ok(ops)
 
     # ---- shape analysis
     def _graph_shape(self, stm) -> Optional[Idiom]:
@@ -1058,6 +1345,7 @@ class ClusterExecutor:
             )
         finally:
             stm.order, stm.limit, stm.start, stm.fields = saved
+        t_merge = _time.perf_counter()
         rows = self._gather_rows(per_node, dedup=dedup, dedup_key=_RID)
         if rows and all(isinstance(r, dict) and "id" in r for r in rows):
             rows = _merge.sort_rows_scan_order(rows, self._from_tables(stm, session, vars))
@@ -1067,6 +1355,7 @@ class ClusterExecutor:
             )
         if dedup:
             rows = _merge.strip_cluster_fields(rows)
+        self._note_merge(t_merge, len(rows))
         if not (stm.order or stm.limit or stm.start):
             if getattr(stm, "only", False):
                 return _ok(rows[0] if rows else NONE)
@@ -1130,6 +1419,7 @@ class ClusterExecutor:
             self._all_nodes(), inner, session, scatter_vars,
             idempotent=True, tolerate_down=rf > 1,
         )
+        t_merge = _time.perf_counter()
         rows = self._gather_rows(per_node, dedup=rf > 1)
         if knn is not None:
             rows = _merge.merge_topk(rows, int(knn.k), _DIST)
@@ -1139,7 +1429,17 @@ class ClusterExecutor:
             rows = _merge.sort_rows_scan_order(
                 rows, self._from_tables(stm, session, vars)
             )
+        self._note_merge(t_merge, len(rows))
         return self._replay(stm, session, vars, rows, knn, matches)
+
+    @staticmethod
+    def _note_merge(t_start: float, rows: int) -> None:
+        """Coordinator-side merge accounting for the per-shard profile."""
+        ctx = _STMT.get(None)
+        if ctx is not None:
+            with ctx._lock:
+                ctx.merge_s += _time.perf_counter() - t_start
+                ctx.rows_gathered = (ctx.rows_gathered or 0) + rows
 
     def _replay(self, stm, session, vars, rows, knn, matches) -> dict:
         """Re-run the ORIGINAL statement shape over the gathered rows: the
@@ -1314,6 +1614,25 @@ class ClusterExecutor:
 
 
 # ------------------------------------------------------------------ helpers
+def _resp_rows(resp: dict) -> Optional[int]:
+    """Rows returned by one cluster op response — the per-shard profile's
+    `rows` feed (query results or expand maps; None for stats/pings)."""
+    results = resp.get("results")
+    if isinstance(results, list):
+        n = 0
+        for r in results:
+            v = r.get("result") if isinstance(r, dict) else None
+            if isinstance(v, list):
+                n += len(v)
+            elif v is not None and not is_none(v):
+                n += 1
+        return n
+    mp = resp.get("map")
+    if isinstance(mp, dict):
+        return len(mp)
+    return None
+
+
 def _align_insert_rows(
     tb: str, batch: List[Tuple[int, dict]], got: List[Any]
 ) -> List[Tuple[int, Any]]:

@@ -10,6 +10,20 @@ Ops:
     ft_stats  {ns, db, tb, field, query}     -> {dc, tl, df, terms} | {missing}
     expand    {ns, db, part, ids}            -> {map: repr(id) -> expansion}
     ping      {}                             -> {ok}
+    bundle    {trace_limit?, full_traces?}   -> {json: <node debug bundle>}
+    metrics   {}                             -> {json: <telemetry export>}
+    events    {kind?, limit?}                -> {json: <event timeline>}
+
+The observability ops (`bundle`/`metrics`/`events` — the federation plane)
+ship their payloads as JSON STRINGS inside the CBOR envelope: bundle
+documents carry arbitrary engine values (None-valued fields, nested label
+maps) whose CBOR round trip would re-type them, and the coordinator only
+re-serializes them anyway.
+
+A `query` response also carries any slow-query / error ring entries the
+handled statement recorded on THIS node (`slow` / `errors`, matched by the
+request's trace id) so the coordinator can join a slow remote shard into
+its own rings — without this, a slow shard is only visible on the shard.
 
 The channel is authenticated by the shared config secret (net/server.py
 checks `x-surreal-cluster-key` before calling handle()); ops execute with
@@ -19,6 +33,8 @@ capabilities are enforced.
 
 from __future__ import annotations
 
+import json as _json
+import time as _time
 from typing import Any, Dict
 
 from surrealdb_tpu.err import SurrealError
@@ -34,6 +50,7 @@ def handle(ds, req: Dict[str, Any]) -> Dict[str, Any]:
 
     op = str(req.get("op", ""))
     fn = _OPS.get(op)
+    t0 = _time.time()
     try:
         if fn is None:
             raise SurrealError(f"unknown cluster op {op!r}")
@@ -46,7 +63,39 @@ def handle(ds, req: Dict[str, Any]) -> Dict[str, Any]:
         out = {"error": f"Internal error: {type(e).__name__}: {e}"}
     out["node"] = str(getattr(getattr(ds, "cluster", None), "node_id", "") or "")
     out["spans"] = tracing.export_spans()
+    if op == "query":
+        _attach_ring_entries(out, t0)
     return out
+
+
+def _attach_ring_entries(out: Dict[str, Any], t0: float) -> None:
+    """Slow/error ring entries recorded WHILE handling this op, matched by
+    the request's trace id (the /cluster ingress honored the coordinator's
+    traceparent, so the handled statement recorded under it). They ride the
+    response next to the grafted spans — the coordinator joins them into
+    its own rings as the statement's per-node breakdown."""
+    from surrealdb_tpu import telemetry, tracing
+
+    tid = tracing.current_trace_id()
+    if tid is None:
+        return
+    # small epsilon: time.time() is not monotonic across the two reads
+    cutoff = t0 - 0.002
+    slow = [
+        e for e in telemetry.slow_queries()
+        if e.get("trace_id") == tid and (e.get("ts") or 0) >= cutoff
+    ]
+    errs = [
+        e for e in telemetry.recent_errors()
+        if e.get("trace_id") == tid and (e.get("ts") or 0) >= cutoff
+    ]
+    # JSON round trip (default=str) pins the entries to CBOR-safe
+    # primitives — an exotic plan-note value must never break the query
+    # response it happens to ride on
+    if slow:
+        out["slow"] = _json.loads(_json.dumps(slow, default=str))
+    if errs:
+        out["errors"] = _json.loads(_json.dumps(errs, default=str))
 
 
 def _session(req):
@@ -164,9 +213,48 @@ def _op_ft_stats(ds, req):
         ex._cancel()
 
 
+def _op_bundle(ds, req):
+    """This node's full debug bundle for the federated
+    `/debug/bundle?cluster=1` merge — JSON-encoded (see module doc)."""
+    from surrealdb_tpu.bundle import debug_bundle
+
+    b = debug_bundle(
+        ds,
+        trace_limit=int(req.get("trace_limit") or 50),
+        full_traces=int(req.get("full_traces") or 10),
+    )
+    return {"json": _json.dumps(b, default=str)}
+
+
+def _op_metrics(ds, req):
+    """This node's metrics registry state for the federated
+    `/metrics?cluster=1` scrape (re-labeled node=<id> by the coordinator).
+    Node gauges are refreshed first, exactly like a direct scrape."""
+    from surrealdb_tpu import telemetry
+
+    telemetry.collect_node_metrics(ds)
+    return {"json": _json.dumps(telemetry.export_state())}
+
+
+def _op_events(ds, req):
+    """This node's event timeline slice for the federated `/events` merge."""
+    from surrealdb_tpu import events
+
+    kind = req.get("kind")
+    limit = req.get("limit")
+    out = events.snapshot(
+        kind_prefix=str(kind) if kind else None,
+        limit=int(limit) if limit is not None else None,
+    )
+    return {"json": _json.dumps(out, default=str)}
+
+
 _OPS = {
     "ping": _op_ping,
     "query": _op_query,
     "expand": _op_expand,
     "ft_stats": _op_ft_stats,
+    "bundle": _op_bundle,
+    "metrics": _op_metrics,
+    "events": _op_events,
 }

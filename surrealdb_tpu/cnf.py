@@ -236,6 +236,11 @@ FAULTS_SEED = (
     else None
 )
 
+# Structured event timeline (surrealdb_tpu/events.py): bounded ring of
+# trace-linked operational state transitions (flaps, breaker trips,
+# degraded reads, sheds, failpoint trips, bg stalls/restarts).
+EVENTS_CAP = _env_int("SURREAL_EVENTS_CAP", 1024)
+
 # bg service-task supervision (bg.spawn_service(restart=True)): a service
 # loop that dies on an UNCAUGHT exception is restarted with exponential
 # backoff capped here; a loop that stayed healthy this long resets the

@@ -64,7 +64,37 @@ def select_compute(ctx, stm) -> Any:
         if stm.explain:
             from surrealdb_tpu.idx.planner import explain
 
-            return explain(c, stm, sources, full=stm.explain_full)
+            plan = explain(c, stm, sources, full=stm.explain_full)
+            if not getattr(stm, "explain_analyze", False):
+                return plan
+            # EXPLAIN ANALYZE: the plan AND the execution it describes —
+            # run the statement for real (flag stripped; the parsed AST is
+            # request-local, so the mutate-restore is race-free) and append
+            # an Execute row with the measured stats + the plan decisions
+            # the execution actually took (telemetry plan notes)
+            import time as _time
+
+            from surrealdb_tpu import telemetry
+            from surrealdb_tpu.sql.value import is_none as _is_none
+
+            telemetry.drain_plan_notes()
+            stm.explain = False
+            t0 = _time.perf_counter()
+            try:
+                rows = select_compute(ctx, stm)
+            finally:
+                stm.explain = True
+            dur = _time.perf_counter() - t0
+            n = (
+                len(rows)
+                if isinstance(rows, list)
+                else (0 if rows is None or _is_none(rows) else 1)
+            )
+            detail = {"duration_ms": round(dur * 1e3, 3), "rows": n}
+            notes = telemetry.drain_plan_notes()
+            if notes:
+                detail["plan_notes"] = notes
+            return plan + [{"operation": "Execute", "detail": detail}]
 
         from surrealdb_tpu.ml.exec import try_columnar_ml_scan
 

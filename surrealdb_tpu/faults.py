@@ -287,9 +287,13 @@ def fire(site: str, payload: Any = None) -> Any:
             fp.remaining -= 1
         fp.trips += 1
         action, arg = fp.action, fp.arg
-    from surrealdb_tpu import telemetry
+    from surrealdb_tpu import events, telemetry
 
     telemetry.inc("failpoint_trips", site=site, action=action)
+    # timeline entry: a trip observed while serving a statement joins that
+    # statement's trace — chaos runs read injected faults next to their
+    # victims instead of diffing counters
+    events.emit("fault.trip", site=site, action=action)
     if action == "error":
         raise ERROR_CLASSES[arg](site)
     if action == "latency":

@@ -156,6 +156,12 @@ class GroupCommit:
                     self._gen += 1
                     rgen = self._gen
             if rescue:
+                from surrealdb_tpu import events
+
+                # timeline entry under the submitter's own trace: a commit
+                # that had to rescue a dead flusher is exactly the latency
+                # outlier the event log exists to explain
+                events.emit("txn.group_commit_rescue")
                 _gc_tls.in_flusher = True
                 try:
                     self._drain(linger=0.0)

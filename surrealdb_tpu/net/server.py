@@ -288,6 +288,18 @@ class SurrealHandler(BaseHTTPRequestHandler):
             self._send(401, {"error": str(e)})
             return None
 
+    def _cluster_query(self) -> bool:
+        """True when the request asks for the cluster-federated variant of
+        an observability surface (`?cluster=1`) AND this node can serve it
+        (attached to a cluster)."""
+        from urllib.parse import parse_qs
+
+        q = parse_qs(urlparse(self.path).query)
+        return (
+            q.get("cluster", [""])[0] in ("1", "true")
+            and self.ds.cluster is not None
+        )
+
     def _route_allowed(self, route: str) -> bool:
         """HTTP-route capability gate (reference: RouteTarget allow/deny).
         Sends the 403 itself when denied."""
@@ -318,6 +330,17 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 return
             from surrealdb_tpu import telemetry
 
+            if self._cluster_query():
+                # federated scrape: every member's registry re-labeled
+                # node=<id>, dead members as cluster_scrape_up 0. Unlike
+                # the plain (local, cheap) render this fans RPCs out to
+                # the whole membership on the scatter pool — debug-class
+                # work, so system-gated like the other federation routes
+                if self._system_gate() is None:
+                    return
+                from surrealdb_tpu.cluster.federation import federated_metrics
+
+                return self._send(200, federated_metrics(self.ds).encode(), "text/plain")
             # refresh node runtime gauges (RSS, live queries, jit cache,
             # device memory) so the scrape sees current values
             telemetry.collect_node_metrics(self.ds)
@@ -355,7 +378,40 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 return
             from surrealdb_tpu.bundle import debug_bundle
 
+            if self._cluster_query():
+                # the federated bundle: per-node sections merged under this
+                # coordinator, dead members marked unreachable — still 200
+                from surrealdb_tpu.cluster.federation import federated_bundle
+
+                return self._send(200, federated_bundle(self.ds))
             return self._send(200, debug_bundle(self.ds))
+        if path == "/events":
+            # the structured event timeline (events.py): trace-linked
+            # operational transitions. Carries trace ids + node/session
+            # context, so system-gated like the other debug surfaces.
+            if not self._route_allowed("events"):
+                return
+            if self._system_gate() is None:
+                return
+            from urllib.parse import parse_qs
+
+            from surrealdb_tpu import events as _events
+
+            q = parse_qs(urlparse(self.path).query)
+            kind = q.get("kind", [None])[0]
+            try:
+                limit = int(q.get("limit", [None])[0]) if q.get("limit") else None
+            except (TypeError, ValueError):
+                limit = None
+            if self._cluster_query():
+                from surrealdb_tpu.cluster.federation import federated_events
+
+                return self._send(
+                    200, federated_events(self.ds, kind_prefix=kind, limit=limit)
+                )
+            return self._send(
+                200, _events.snapshot(kind_prefix=kind, limit=limit)
+            )
         if path == "/slow":
             # structured slow-query log (ring buffer; dbs/executor.py) — the
             # /metrics-adjacent debug endpoint. Entries carry raw statement

@@ -375,6 +375,7 @@ class SelectStatement(Statement):
         "parallel",
         "explain",
         "explain_full",
+        "explain_analyze",
         "value_mode",
     )
 
@@ -397,6 +398,7 @@ class SelectStatement(Statement):
         self.parallel = kw.get("parallel", False)
         self.explain = kw.get("explain", False)
         self.explain_full = kw.get("explain_full", False)
+        self.explain_analyze = kw.get("explain_analyze", False)
         self.value_mode = kw.get("value_mode", False)
 
     def compute(self, ctx):
@@ -437,7 +439,11 @@ class SelectStatement(Statement):
         if self.parallel:
             out += " PARALLEL"
         if self.explain:
-            out += " EXPLAIN" + (" FULL" if self.explain_full else "")
+            out += " EXPLAIN"
+            if self.explain_full:
+                out += " FULL"
+            if self.explain_analyze:
+                out += " ANALYZE"
         return out
 
 

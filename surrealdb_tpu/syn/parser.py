@@ -411,6 +411,10 @@ class Parser:
         if self.eat_kw("EXPLAIN"):
             kw["explain"] = True
             kw["explain_full"] = self.eat_kw("FULL")
+            # EXPLAIN ANALYZE: run the statement for real and report the
+            # plan WITH execution statistics (per-shard profile in cluster
+            # mode) instead of the plan alone
+            kw["explain_analyze"] = self.eat_kw("ANALYZE")
         kw.pop("tempfiles", None)
         return S.SelectStatement(fields, what, **kw)
 

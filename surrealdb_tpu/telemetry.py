@@ -473,6 +473,82 @@ def _bucket_label(b: float) -> str:
     return repr(b) if isinstance(b, float) and not float(b).is_integer() else str(int(b))
 
 
+def export_state() -> dict:
+    """Raw registry state for cluster federation (cluster/rpc.py `metrics`
+    op): JSON-able — label tuples become dicts, histogram series become
+    [family, buckets, labels, cells]. The coordinator re-labels every
+    series with node=<id> and renders one merged exposition."""
+    with _lock:
+        return {
+            "counters": [[n, dict(k), v] for (n, k), v in _counters.items()],
+            "gauges": [[n, dict(k), v] for (n, k), v in _gauges.items()],
+            "hists": [
+                [fam, list(buckets), dict(lk), list(h)]
+                for fam, (buckets, series) in _hists.items()
+                for lk, h in series.items()
+            ],
+        }
+
+
+def render_prometheus_federated(states: Dict[str, Optional[dict]]) -> str:
+    """One Prometheus exposition for the WHOLE cluster (`/metrics?cluster=1`
+    on the coordinator): every member's series re-labeled `node=<id>`
+    (Monarch-style region labeling — one scrape, per-node attribution).
+    Degraded-tolerant: a member whose scrape failed (state None)
+    contributes only `surreal_cluster_scrape_up{node="<id>"} 0`, and the
+    scrape still succeeds."""
+    counters: Dict[str, List[Tuple[_LabelKey, float]]] = {}
+    gauges: Dict[str, List[Tuple[_LabelKey, float]]] = {}
+    hists: Dict[str, Tuple[Tuple[float, ...], List[Tuple[_LabelKey, list]]]] = {}
+    for node in sorted(states):
+        st = states[node]
+        gauges.setdefault("cluster_scrape_up", []).append(
+            (_key({"node": node}), 0.0 if st is None else 1.0)
+        )
+        if st is None:
+            continue
+        for n, labels, v in st.get("counters") or []:
+            counters.setdefault(str(n), []).append(
+                (_key(dict(labels, node=node)), float(v))
+            )
+        for n, labels, v in st.get("gauges") or []:
+            gauges.setdefault(str(n), []).append(
+                (_key(dict(labels, node=node)), float(v))
+            )
+        for fam, buckets, labels, cells in st.get("hists") or []:
+            entry = hists.setdefault(str(fam), (tuple(buckets), []))
+            if len(entry[0]) == len(buckets):  # shape-mismatched series drop
+                entry[1].append((_key(dict(labels, node=node)), list(cells)))
+
+    lines: List[str] = []
+    for name in sorted(counters):
+        fam = f"surreal_{name}_total"
+        lines.append(f"# TYPE {fam} counter")
+        for labels, v in sorted(counters[name]):
+            lines.append(f"{fam}{_fmt_labels(labels)} {_num(v)}")
+    for name in sorted(gauges):
+        fam = f"surreal_{name}"
+        lines.append(f"# TYPE {fam} gauge")
+        for labels, v in sorted(gauges[name]):
+            lines.append(f"{fam}{_fmt_labels(labels)} {_num(v)}")
+    for family in sorted(hists):
+        buckets, series = hists[family]
+        fam = f"surreal_{family}"
+        lines.append(f"# TYPE {fam} histogram")
+        for labels, h in sorted(series):
+            cum = 0
+            for i, b in enumerate(buckets):
+                cum += h[i]
+                lines.append(
+                    f"{fam}_bucket{_fmt_labels(labels, ('le', _bucket_label(b)))} {cum}"
+                )
+            cum += h[len(buckets)]
+            lines.append(f"{fam}_bucket{_fmt_labels(labels, ('le', '+Inf'))} {cum}")
+            lines.append(f"{fam}_sum{_fmt_labels(labels)} {h[-3]:.6f}")
+            lines.append(f"{fam}_count{_fmt_labels(labels)} {h[-2]}")
+    return "\n".join(lines) + "\n"
+
+
 def render_prometheus() -> str:
     """Valid Prometheus text exposition of counters, gauges and histograms
     (reference telemetry/metrics/http/, ws/). Label values are escaped;

@@ -186,6 +186,15 @@ def annotate(**meta: Any) -> None:
         ctx.trace.meta.update(meta)
 
 
+def annotate_append(key: str, value: Any) -> None:
+    """Append `value` to a LIST-valued meta key on the active trace (e.g.
+    the cluster executor accumulating one per-shard profile per statement
+    across a multi-statement request); no-op outside a trace."""
+    ctx = _current.get()
+    if ctx is not None:
+        ctx.trace.meta.setdefault(key, []).append(value)
+
+
 def force_keep() -> None:
     """Pin the active trace into the store regardless of sampling (called
     when a slow-query / error record cites its trace_id — the `/slow` ->

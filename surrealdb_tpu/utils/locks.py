@@ -88,6 +88,7 @@ HIERARCHY: Dict[str, int] = {
                                # lock — commit, dispatch, rpc)
     "bg.registry": 80,         # background-task registry
     "compile_log": 82,         # compile-event log
+    "events": 83,              # structured event timeline (events.py)
     "tracing.store": 84,       # bounded trace store
     "telemetry.registry": 86,  # metrics registry (the hottest leaf)
 }

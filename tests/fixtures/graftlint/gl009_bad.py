@@ -1,0 +1,28 @@
+"""GL009 fixture: ad-hoc event logging outside the registered surface."""
+
+import time
+
+from surrealdb_tpu import events
+from surrealdb_tpu.events import _ring  # flagged: ring import bypass
+from surrealdb_tpu.events import emit as _emit
+
+
+def note_aliased(state: str):
+    # a direct-import alias must not dodge the dynamic-kind check
+    _emit(f"cluster.{state}")
+
+
+def note_flap(node_id: str, state: str):
+    # dynamic kind: un-filterable timeline entry
+    events.emit(f"cluster.{state}", node=node_id)
+
+
+def note_custom(node_id: str):
+    # static but UNREGISTERED kind
+    events.emit("fixture.made_up_kind", node=node_id)
+
+
+def sneak_into_ring(entry: dict):
+    # ad-hoc dict logging straight into the ring: bypasses the trace link,
+    # the counter, and the registry check
+    events._ring.append(dict(entry, ts=time.time()))

@@ -1,0 +1,14 @@
+"""GL009 clean twin: registered kinds through events.emit only."""
+
+from surrealdb_tpu import events
+
+
+def note_flap(node_id: str, up: bool):
+    events.emit(
+        "cluster.node_up" if up else "cluster.node_down", node=node_id
+    )
+
+
+def note_shed(reason: str):
+    # the variable part rides in a FIELD; the kind stays registered
+    events.emit("cluster.admission_shed", reason=reason)

@@ -227,3 +227,119 @@ def test_committed_r12_artifact_validates():
     path = os.path.join(REPO, "bench_results_r12.json")
     assert os.path.exists(path)
     assert cba.validate(path) == []
+
+
+# ------------------------------------------------------------------ schema/9
+def _fed_bundle(n2_unreachable=False, n2_extra=None):
+    def node_bundle(extra=None):
+        b = _bundle(**(extra or {}))
+        b["schema"] = "surrealdb-tpu-bundle/3"
+        b["events"] = []
+        return b
+
+    return {
+        "schema": "surrealdb-tpu-bundle/3",
+        "cluster": True,
+        "coordinator": "n1",
+        "nodes": {
+            "n1": node_bundle(),
+            "n2": {"unreachable": True, "error": "timed out"}
+            if n2_unreachable
+            else node_bundle(n2_extra),
+        },
+    }
+
+
+def _min_v9_artifact():
+    doc = _min_v8_artifact()
+    doc["schema"] = "surrealdb-tpu-bench/9"
+    doc["bundle"]["events"] = []
+    obs = {
+        "bundle": _fed_bundle(),
+        "slowest_profile": {
+            "sql": "SELECT ...", "duration_ms": 12.0, "merge_ms": 0.2,
+            "admission_wait_ms": 0.0,
+            "shards": {
+                "n1": {"rpc_ms": 5.0, "rows": 3},
+                "n2": {"rpc_ms": 9.0, "rows": 4},
+            },
+        },
+        "live_nodes": ["n1", "n2"],
+    }
+    doc["results"][0]["cluster_obs"] = obs
+    doc["results"][2]["cluster_obs"] = json.loads(json.dumps(obs))
+    doc["results"][2]["events"] = {
+        "total": 9, "breaker": 1, "flaps": 1, "degraded_reads": 30,
+        "unattributed_degraded_reads": 0,
+    }
+    return doc
+
+
+def test_v9_cluster_obs_rules(tmp_path):
+    assert _validate_doc(tmp_path, _min_v9_artifact()) == []
+
+    # /9 bundles need the ninth (events) section
+    doc = _min_v9_artifact()
+    doc["bundle"].pop("events")
+    assert any("events" in p for p in _validate_doc(tmp_path, doc))
+
+    # cluster lines must carry the cluster_obs object
+    doc = _min_v9_artifact()
+    doc["results"][0].pop("cluster_obs")
+    assert any("cluster_obs" in p for p in _validate_doc(tmp_path, doc))
+
+    # the federated bundle must actually be federated (non-empty nodes map)
+    doc = _min_v9_artifact()
+    doc["results"][0]["cluster_obs"]["bundle"] = {"schema": "surrealdb-tpu-bundle/3"}
+    assert any("'nodes' map" in p for p in _validate_doc(tmp_path, doc))
+
+    # the acceptance bar: shard timings must cover every LIVE node
+    doc = _min_v9_artifact()
+    doc["results"][2]["cluster_obs"]["slowest_profile"]["shards"].pop("n2")
+    problems = _validate_doc(tmp_path, doc)
+    assert any("missing live node(s) ['n2']" in p for p in problems), problems
+
+    # ... but a DEAD node is not required to report timings
+    doc = _min_v9_artifact()
+    doc["results"][2]["cluster_obs"]["slowest_profile"]["shards"].pop("n2")
+    doc["results"][2]["cluster_obs"]["live_nodes"] = ["n1"]
+    assert _validate_doc(tmp_path, doc) == []
+
+
+# ------------------------------------------------------- federated bundles
+def test_bundle_diff_federated_per_node_and_unreachable(capsys):
+    old = _fed_bundle()
+    new = _fed_bundle(n2_unreachable=True)
+    rep = bench_diff.diff_federated(old, new)
+    assert any("UNREACHABLE now" in f for f in rep["flags"])
+    assert rep["per_node"]["n2"] == {"unreachable": True}
+    # the CLI path routes federated inputs automatically
+    rc = bench_diff._main_bundles(old, new)
+    out = capsys.readouterr().out
+    assert rc == 1 and "UNREACHABLE" in out
+
+
+def test_peer_drift_flags_compile_and_staleness_divergence():
+    drifted = _fed_bundle(n2_extra={
+        "columns": {"t.t.p": {"rows": 10, "stale": True}},
+    })
+    n1 = drifted["nodes"]["n1"]
+    n1["engine"]["column_mirrors"] = {"t.t.p": {"rows": 10, "stale": False}}
+    n1["compiles"] = {
+        "events": [{"subsystem": "ivf", "shape": "(8,)", "mode": "prewarm"}],
+        "on_demand": 0, "prewarmed": 1,
+    }
+    # n2 stale where n1 is fresh -> staleness divergence flag
+    flags = bench_diff.peer_drift(drifted)
+    assert any("STALE on ['n2']" in f for f in flags), flags
+
+    # a breaker open toward a peer flags too
+    withbrk = _fed_bundle()
+    withbrk["nodes"]["n1"]["engine"]["cluster"] = {
+        "nodes": {"n2": {"breaker": "open", "up": False}}
+    }
+    flags = bench_diff.peer_drift(withbrk)
+    assert any("breaker OPEN toward n2" in f for f in flags), flags
+
+    # identical peers drift nothing
+    assert bench_diff.peer_drift(_fed_bundle()) == []

@@ -25,7 +25,7 @@ def rule_ids(findings):
 
 
 # ------------------------------------------------------------------ per rule
-@pytest.mark.parametrize("rule", ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008"])
+@pytest.mark.parametrize("rule", ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008", "GL009"])
 def test_rule_fires_on_bad_fixture_and_not_on_clean(rule):
     bad = lint(f"{rule.lower()}_bad.py", rules=[rule])
     assert rule in rule_ids(bad), f"{rule} failed to fire on its fixture"
@@ -133,11 +133,12 @@ def test_cli_exit_codes():
             os.path.join(FIXTURES, "gl006_bad.py"),
             os.path.join(FIXTURES, "gl007_bad.py"),
             os.path.join(FIXTURES, "gl008_bad.py"),
+            os.path.join(FIXTURES, "gl009_bad.py"),
         ],
         cwd=REPO, capture_output=True, text=True, env=env,
     )
     assert bad.returncode == 1, bad.stdout + bad.stderr
-    for rule in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008"):
+    for rule in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008", "GL009"):
         assert rule in bad.stdout, f"{rule} missing from CLI output"
     # --update-baseline refuses a restricted scope (it would silently drop
     # every grandfathered entry the restricted run can't see)
@@ -152,10 +153,32 @@ def test_cli_exit_codes():
     assert "full scope" in guard.stderr
 
 
+def test_gl009_flags_dynamic_kind_unregistered_kind_and_ring_access():
+    keys = {f.key for f in lint("gl009_bad.py", rules=["GL009"])}
+    assert any(k.endswith(":dynamic-kind") for k in keys), keys
+    assert any(":kind:fixture.made_up_kind" in k for k in keys), keys
+    assert any(k.endswith(":ring") for k in keys), keys
+    assert any(k.endswith(":import:_ring") for k in keys), keys
+    # the direct-import alias (`from ... events import emit as _emit`)
+    # does not dodge the dynamic-kind check
+    assert any(":note_aliased:dynamic-kind" in k for k in keys), keys
+    # registered kinds (including conditional expressions over registered
+    # constants, the flap-site idiom) pass clean
+    assert lint("gl009_clean.py", rules=["GL009"]) == []
+
+
+def test_gl009_registry_matches_runtime():
+    # the rule checks against the REAL registry, so the static and runtime
+    # halves can never drift
+    from surrealdb_tpu.events import KINDS
+
+    assert rules_mod._gl009_registry() == set(KINDS)
+
+
 def test_every_rule_has_doc_and_registration():
     assert set(rules_mod.RULES) == {
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008",
+        "GL008", "GL009",
     }
     for rid, (fn, doc) in rules_mod.RULES.items():
         assert callable(fn) and doc
