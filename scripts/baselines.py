@@ -1,0 +1,50 @@
+"""Shared grandfathered-findings baseline store for the static-analysis
+gates (scripts/graftlint, scripts/graftcheck).
+
+Both gates use the same mechanics — a committed JSON of stable
+finding keys that do not fail the run, rewritten wholesale by
+`--update-baseline` — so the IO lives here once. Findings only need
+`.rule`, `.key` and `.message` attributes; each gate keeps its own
+default path and file comment.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence, Tuple
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    import os
+
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        doc = json.load(f)
+    return {e["key"]: e for e in doc.get("findings", [])}
+
+
+def write_baseline(findings: Sequence, path: str, comment: str) -> str:
+    doc = {
+        "_comment": comment,
+        "findings": [
+            {"rule": f.rule, "key": k, "message": f.message}
+            for k, f in sorted(
+                {f.key: f for f in findings}.items()
+            )  # keys are the identity; same-key sites share one entry
+        ],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    return path
+
+
+def apply_baseline(
+    findings: Sequence, baseline: Dict[str, dict]
+) -> Tuple[List, List[str]]:
+    """Split into (new findings, stale baseline keys)."""
+    seen = {f.key for f in findings}
+    new = [f for f in findings if f.key not in baseline]
+    stale = [k for k in baseline if k not in seen]
+    return new, stale

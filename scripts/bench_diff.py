@@ -21,8 +21,10 @@ its window (the usual suspects). Exit code 1 when anything was flagged,
 standalone surrealdb-tpu-bundle/1 files from GET /debug/bundle): column-
 mirror staleness flips, tables that appeared/vanished, compile-cache drift
 (shapes compiled in one round but not the other, on-demand compile counts),
-ANN quantizer state changes, and dispatch counter ratios — the round-over-
-round engine-state attribution the per-config metric deltas can't show.
+ANN quantizer state changes, dispatch counter ratios, and — on bundle/4 —
+graftcheck kernel_audit drift (per-kernel HLO-digest changes, declared- or
+lowered-collective changes, rule failures) — the round-over-round
+engine-state attribution the per-config metric deltas can't show.
 
 FEDERATED bundles (GET /debug/bundle?cluster=1, or a schema-/9 artifact's
 cluster_obs embed) are diffed per node: each member's sections compare
@@ -230,6 +232,15 @@ def diff_bundles(old: dict, new: dict) -> dict:
                 "serving the exact fallback path this round"
             )
 
+    # ---- kernel_audit drift (graftcheck compiled-IR report, bundle/4+):
+    # a changed HLO digest means the kernel LOWERS differently this round
+    # (toolchain bump or code change — either way, re-bench before
+    # trusting deltas); a changed declared-collective set means someone
+    # widened a mesh kernel's allowlist between rounds
+    out["kernel_audit"] = _diff_kernel_audit(
+        old.get("kernel_audit"), new.get("kernel_audit"), out["flags"]
+    )
+
     # ---- dispatch counter ratios (retry/split pressure)
     od = ((old.get("engine") or {}).get("dispatch") or {}).get("stats") or {}
     nd = ((new.get("engine") or {}).get("dispatch") or {}).get("stats") or {}
@@ -242,6 +253,81 @@ def diff_bundles(old: dict, new: dict) -> dict:
                 f"dispatch {counter} rate doubled between rounds "
                 f"({o_n}/{o_d} -> {n_n}/{n_d})"
             )
+    return out
+
+
+def _diff_kernel_audit(
+    old: Optional[dict], new: Optional[dict], flags: List[str]
+) -> dict:
+    """Per-kernel HLO-digest / declared-collective / rule-result drift
+    between two kernel_audit sections. Appends to `flags` in place."""
+    o_av = bool(isinstance(old, dict) and old.get("available"))
+    n_av = bool(isinstance(new, dict) and new.get("available"))
+    out: Dict[str, Any] = {"available": [o_av, n_av], "kernels": {}}
+    if o_av and not n_av:
+        flags.append(
+            "kernel_audit available in the old round but missing now — "
+            "the graftcheck gate did not run before this bench"
+        )
+    if not (o_av and n_av):
+        return out
+    ok, nk = old.get("kernels") or {}, new.get("kernels") or {}
+    for name in sorted(set(ok) | set(nk)):
+        o, n = ok.get(name), nk.get(name)
+        if o is None or n is None:
+            change = "appeared" if o is None else "vanished"
+            out["kernels"][name] = {"change": change}
+            if change == "vanished":
+                flags.append(
+                    f"kernel {name}: VANISHED from the audit between rounds "
+                    "— it left graftcheck coverage (site deregistered?)"
+                )
+            continue
+        entry: Dict[str, Any] = {}
+        oc = list(o.get("declared_collectives") or [])
+        nc = list(n.get("declared_collectives") or [])
+        if oc != nc:
+            entry["declared_collectives"] = [oc, nc]
+            flags.append(
+                f"kernel {name}: declared collectives changed {oc} -> {nc} "
+                "— the mesh allowlist was widened/narrowed between rounds"
+            )
+        os_, ns_ = o.get("shapes") or {}, n.get("shapes") or {}
+        drifted = []
+        for label in sorted(set(os_) & set(ns_)):
+            oh = (os_[label] or {}).get("hlo_sha256")
+            nh = (ns_[label] or {}).get("hlo_sha256")
+            if oh and nh and oh != nh:
+                drifted.append(label)
+            ocol = (os_[label] or {}).get("collectives") or {}
+            ncol = (ns_[label] or {}).get("collectives") or {}
+            if ocol != ncol:
+                flags.append(
+                    f"kernel {name}[{label}]: lowered collectives changed "
+                    f"{ocol} -> {ncol} — XLA inserts different communication "
+                    "this round"
+                )
+        if drifted:
+            entry["hlo_drift"] = drifted
+            flags.append(
+                f"kernel {name}: HLO digest drifted for shape(s) {drifted} "
+                "— the kernel lowers differently this round (re-validate "
+                "perf deltas against the new lowering)"
+            )
+        failed = sorted(
+            f"{label}:{rid}"
+            for label, s in ns_.items()
+            for rid, res in (s.get("rules") or {}).items()
+            if res != "pass"
+        )
+        if failed:
+            entry["rule_failures"] = failed
+            flags.append(
+                f"kernel {name}: graftcheck rule failure(s) in this round's "
+                f"audit: {failed}"
+            )
+        if entry:
+            out["kernels"][name] = entry
     return out
 
 

@@ -85,6 +85,11 @@ BUNDLE_SECTIONS_V8 = BUNDLE_SECTIONS + ("locks", "faults")
 # event timeline, and cluster/chaos config lines embed the FEDERATED
 # cluster bundle + the slowest statement's per-shard profile (cluster_obs)
 BUNDLE_SECTIONS_V9 = BUNDLE_SECTIONS_V8 + ("events",)
+# surrealdb-tpu-bundle/4 adds the graftcheck kernel_audit section. It is
+# validated STRUCTURALLY whenever present (any artifact schema): a bundle
+# carrying a malformed audit would poison bench_diff --bundles drift
+# detection, so either `available: false` or a well-formed report.
+KERNEL_AUDIT_KEYS = ("schema", "kernels", "summary")
 CLUSTER_OBS_KEYS = ("bundle", "slowest_profile", "live_nodes")
 COMPILES_KEYS = ("on_demand", "prewarm", "events")
 BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
@@ -94,6 +99,42 @@ PHASE_KEYS = ("knn_ms", "filter_ms", "expand_ms")
 FILTERED_SCAN_KEYS = ("row_path_qps", "same_results", "rows_matched")
 # a present (non-null) slowest_trace must be a real trace doc
 TRACE_KEYS = ("trace_id", "duration_ms", "spans")
+
+
+def _check_kernel_audit(bundle: dict) -> List[str]:
+    """Structural check of the optional kernel_audit section (bundle/4+):
+    absent is fine (older bundles), `available: false` is fine (no audit
+    ran on that host), but a present report must carry the per-kernel
+    shape maps bench_diff's drift detection reads."""
+    ka = bundle.get("kernel_audit")
+    if ka is None:
+        return []
+    if not isinstance(ka, dict):
+        return ["bundle: kernel_audit must be an object"]
+    if not ka.get("available"):
+        return []
+    problems = [
+        f"bundle: kernel_audit missing {key!r}"
+        for key in KERNEL_AUDIT_KEYS
+        if key not in ka
+    ]
+    kernels = ka.get("kernels")
+    if not isinstance(kernels, dict):
+        return problems
+    for name, k in sorted(kernels.items()):
+        if not isinstance(k, dict) or not isinstance(k.get("shapes"), dict):
+            problems.append(
+                f"bundle: kernel_audit.kernels[{name!r}] must carry a "
+                "'shapes' map"
+            )
+            continue
+        for label, s in sorted(k["shapes"].items()):
+            if not isinstance(s, dict) or not s.get("hlo_sha256"):
+                problems.append(
+                    f"bundle: kernel_audit kernel {name!r} shape "
+                    f"{label!r} missing its hlo_sha256 digest"
+                )
+    return problems
 
 
 def validate(path: str) -> List[str]:
@@ -140,6 +181,7 @@ def validate(path: str) -> List[str]:
             for sec in sections:
                 if sec not in bundle:
                     problems.append(f"bundle: missing section {sec!r}")
+            problems.extend(_check_kernel_audit(bundle))
     for key in ("scale", "configs", "results"):
         if key not in art:
             problems.append(f"missing top-level key {key!r}")

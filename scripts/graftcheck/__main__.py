@@ -1,0 +1,181 @@
+"""graftcheck CLI — `python -m scripts.graftcheck`.
+
+Exit codes: 0 = clean (every finding baselined), 1 = new findings /
+registry incompleteness, 2 = bad usage or broken site contract.
+
+The process environment is pinned BEFORE jax loads: CPU platform and a
+simulated 8-device host platform, so the `shard_map` runners lower under
+the same mesh the multi-chip tests use — run this module as its own
+process (the tier-1 gate does), not from an interpreter that already
+imported jax.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+MESH_DEVICES = 8
+
+
+def _pin_env() -> None:
+    import re
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # FORCE the simulated device count — an ambient smaller value (a dev
+    # shell exporting =2) would make every sharded lowering fail GC000
+    # with a misleading make_mesh error instead of auditing under the
+    # 8-device mesh the contracts declare
+    flags = os.environ.get("XLA_FLAGS", "")
+    want = f"--xla_force_host_platform_device_count={MESH_DEVICES}"
+    flags, n = re.subn(
+        r"--xla_force_host_platform_device_count=\d+", want, flags
+    )
+    if not n:
+        flags = (flags + " " + want).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="jaxpr/StableHLO contract audit of the registered kernels",
+    )
+    ap.add_argument(
+        "--sites", default=None,
+        help="comma-separated subsystems to audit (default: all registered)",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON (default scripts/graftcheck/baseline.json)",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline from current findings and exit 0",
+    )
+    ap.add_argument(
+        "--report", default=None,
+        help="write the kernel_audit JSON here (default: the "
+        "cnf.KERNEL_AUDIT_REPORT path on a full-scope run)",
+    )
+    ap.add_argument(
+        "--fixtures", action="store_true",
+        help="audit the seeded-violation fixtures instead (self-test; "
+        "expected to find violations and exit 1)",
+    )
+    ap.add_argument("--list-sites", action="store_true")
+    args = ap.parse_args(argv)
+
+    _pin_env()
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+    from . import engine, lowering, registry, rules
+
+    if args.list_sites:
+        from surrealdb_tpu import compile_log
+
+        for sub, path in sorted(compile_log.KERNEL_SITES.items()):
+            print(f"{sub}  {path}")
+        return 0
+
+    sites = (
+        [s.strip() for s in args.sites.split(",") if s.strip()]
+        if args.sites
+        else None
+    )
+    try:
+        if args.fixtures:
+            from . import fixtures
+
+            contracts = fixtures.fixture_sites()
+            if sites is not None:
+                contracts = [c for c in contracts if c["subsystem"] in sites]
+            for c in contracts:
+                engine.validate_contract(c)
+        else:
+            contracts = registry.resolve_contracts(sites)
+    except engine.ContractError as e:
+        print(f"graftcheck: contract error: {e}", file=sys.stderr)
+        return 2
+
+    full_scope = sites is None and not args.fixtures
+    findings = []
+    results = []
+    if full_scope:
+        # registry completeness is part of the audit itself: a tracked
+        # subsystem missing from KERNEL_SITES must fail the gate, not
+        # just the test suite
+        for problem in registry.completeness_problems():
+            findings.append(
+                engine.Finding(
+                    "GC000", "registry", "", problem, f"GC000:{problem}"
+                )
+            )
+    for contract in contracts:
+        for shape in contract["shapes"]:
+            try:
+                low = lowering.lower_site(contract, shape)
+            except Exception as e:  # noqa: BLE001 — surfaced as a finding
+                findings.append(
+                    engine.Finding(
+                        "GC000", contract["subsystem"], shape["label"],
+                        f"lowering failed: {type(e).__name__}: {e}",
+                        f"GC000:{contract['subsystem']}:{shape['label']}",
+                    )
+                )
+                continue
+            fs = rules.check(contract, shape, low)
+            findings.extend(fs)
+            results.append((contract, shape, low, fs))
+
+    if args.update_baseline:
+        if not full_scope:
+            print(
+                "error: --update-baseline requires the default full scope "
+                "(no --sites, no --fixtures) — a restricted run would drop "
+                "every other grandfathered entry",
+                file=sys.stderr,
+            )
+            return 2
+        path = engine.write_baseline(findings, args.baseline)
+        print(f"baseline written: {path} ({len(findings)} findings)")
+        return 0
+
+    baseline = engine.load_baseline(args.baseline)
+    new, stale = engine.apply_baseline(findings, baseline)
+    for f in new:
+        print(f.render())
+    for k in stale:
+        print(f"warning: stale baseline entry (finding fixed — remove it): {k}")
+
+    report_path = args.report
+    if report_path is None and full_scope:
+        from surrealdb_tpu import cnf
+
+        report_path = cnf.KERNEL_AUDIT_REPORT
+    if report_path and results:
+        from . import report as report_mod
+
+        rep = report_mod.build_report(results)
+        rep["baselined"] = len(findings) - len(new)
+        report_mod.write_report(rep, report_path)
+        print(f"kernel_audit report: {report_path}")
+
+    n_shapes = sum(len(c["shapes"]) for c in contracts)
+    grandfathered = len(findings) - len(new)
+    print(
+        f"graftcheck: {len(contracts)} site(s), {n_shapes} shape(s) "
+        f"lowered, {len(findings)} finding(s), {grandfathered} baselined, "
+        f"{len(new)} new"
+    )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

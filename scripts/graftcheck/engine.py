@@ -1,0 +1,98 @@
+"""graftcheck core: findings, baseline IO, contract validation.
+
+Findings carry a STABLE key (`rule:subsystem:shape_label[:detail]` — the
+shape labels are declared by the site contracts, never derived from jax
+version or digest, so unrelated toolchain bumps don't churn the
+baseline). The committed baseline (scripts/graftcheck/baseline.json)
+grandfathers pre-existing findings; anything new fails the run —
+identical mechanics to scripts/graftlint.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+# every contract a provider returns must carry exactly these keys
+CONTRACT_KEYS = (
+    "subsystem", "module", "kind", "allowed_collectives", "out_dtypes",
+    "shapes", "build",
+)
+CONTRACT_KINDS = ("single", "sharded")
+
+
+@dataclass
+class Finding:
+    rule: str
+    subsystem: str
+    shape: str  # shape label, "" for site-level findings
+    message: str
+    key: str
+
+    def render(self) -> str:
+        where = f"{self.subsystem}[{self.shape}]" if self.shape else self.subsystem
+        return f"{where}: {self.rule} {self.message}"
+
+
+class ContractError(Exception):
+    """A site provider returned a malformed or missing contract — a
+    registration bug, reported as such (never silently skipped: a site
+    that fails to register is a kernel that ships unaudited)."""
+
+
+def validate_contract(c: dict) -> None:
+    missing = [k for k in CONTRACT_KEYS if k not in c]
+    if missing:
+        raise ContractError(
+            f"site contract {c.get('subsystem', '?')!r} missing keys {missing}"
+        )
+    if c["kind"] not in CONTRACT_KINDS:
+        raise ContractError(
+            f"site {c['subsystem']!r}: kind must be one of {CONTRACT_KINDS}"
+        )
+    if not c["shapes"]:
+        raise ContractError(f"site {c['subsystem']!r} declares no shapes")
+    labels = [s.get("label") for s in c["shapes"]]
+    if None in labels or len(set(labels)) != len(labels):
+        raise ContractError(
+            f"site {c['subsystem']!r}: every shape needs a unique 'label'"
+        )
+    if not callable(c["build"]):
+        raise ContractError(f"site {c['subsystem']!r}: 'build' must be callable")
+
+
+# ------------------------------------------------------------------ baseline
+# IO shared with graftlint (scripts/baselines.py); only the default path
+# and the file comment are graftcheck's own
+_BASELINE_COMMENT = (
+    "graftcheck grandfathered findings: entries here do not fail "
+    "the run. Keys are contract-declared shape labels, never "
+    "digests, so toolchain bumps don't churn this file. Shrink "
+    "it; never grow it without a review."
+)
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, dict]:
+    from scripts.baselines import load_baseline as _load
+
+    return _load(path or default_baseline_path())
+
+
+def write_baseline(findings: List[Finding], path: Optional[str] = None) -> str:
+    from scripts.baselines import write_baseline as _write
+
+    return _write(findings, path or default_baseline_path(), _BASELINE_COMMENT)
+
+
+def apply_baseline(
+    findings: List[Finding], baseline: Dict[str, dict]
+) -> Tuple[List[Finding], List[str]]:
+    """Split into (new findings, stale baseline keys)."""
+    from scripts.baselines import apply_baseline as _apply
+
+    return _apply(findings, baseline)

@@ -1,0 +1,115 @@
+"""Site enumeration: resolve compile_log.KERNEL_SITES into contracts and
+cross-check them against the subsystems the source actually tracks.
+
+The completeness direction matters both ways:
+- a subsystem passed to `compile_log.tracked(...)` anywhere in
+  surrealdb_tpu/ but absent from KERNEL_SITES is a kernel shipping
+  UNAUDITED (the acceptance test fails);
+- a KERNEL_SITES entry whose provider doesn't yield a contract for it is
+  a dangling registration (ContractError here).
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import os
+from typing import Dict, List, Optional, Set
+
+from .engine import ContractError, validate_contract
+
+
+def repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def resolve_contracts(subsystems: Optional[List[str]] = None) -> List[dict]:
+    """Import every provider named in KERNEL_SITES and index the contracts
+    by subsystem (providers hosting several subsystems are imported once)."""
+    from surrealdb_tpu import compile_log
+
+    by_provider: Dict[str, List[dict]] = {}
+    contracts: Dict[str, dict] = {}
+    for subsystem, path in sorted(compile_log.KERNEL_SITES.items()):
+        if path not in by_provider:
+            mod_name, _, fn_name = path.partition(":")
+            try:
+                mod = importlib.import_module(mod_name)
+                provider = getattr(mod, fn_name)
+            except (ImportError, AttributeError) as e:
+                raise ContractError(f"provider {path!r} unresolvable: {e}")
+            sites = provider()
+            for c in sites:
+                validate_contract(c)
+            by_provider[path] = sites
+        got = [c for c in by_provider[path] if c["subsystem"] == subsystem]
+        if not got:
+            raise ContractError(
+                f"provider {path!r} yields no contract for subsystem "
+                f"{subsystem!r} (KERNEL_SITES points there)"
+            )
+        contracts[subsystem] = got[0]
+    want = list(contracts) if subsystems is None else subsystems
+    unknown = sorted(set(want) - set(contracts))
+    if unknown:
+        raise ContractError(
+            f"unknown site(s) {unknown}; registered: {sorted(contracts)}"
+        )
+    return [contracts[s] for s in sorted(want)]
+
+
+def tracked_subsystems(root: Optional[str] = None) -> Set[str]:
+    """Every string-literal subsystem passed to `compile_log.tracked(...)`
+    (or a bare `tracked(...)` imported from compile_log) anywhere under
+    surrealdb_tpu/ — the source-of-truth side of the completeness check."""
+    root = root or os.path.join(repo_root(), "surrealdb_tpu")
+    out: Set[str] = set()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path, "r", encoding="utf-8") as fh:
+                try:
+                    tree = ast.parse(fh.read(), filename=path)
+                except SyntaxError:
+                    continue  # graftlint GL000 owns reporting these
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                name = (
+                    f.attr
+                    if isinstance(f, ast.Attribute)
+                    else (f.id if isinstance(f, ast.Name) else "")
+                )
+                if name != "tracked" or not node.args:
+                    continue
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                    out.add(a0.value)
+    return out
+
+
+def completeness_problems() -> List[str]:
+    """Registry-vs-source drift, as printable problems (empty = complete)."""
+    from surrealdb_tpu import compile_log
+
+    tracked = tracked_subsystems()
+    registered = set(compile_log.KERNEL_SITES)
+    problems = []
+    for sub in sorted(tracked - registered):
+        problems.append(
+            f"subsystem {sub!r} is compile_log-tracked in the source but "
+            "not registered in compile_log.KERNEL_SITES — the kernel "
+            "would ship unaudited"
+        )
+    for sub in sorted(registered - tracked):
+        problems.append(
+            f"KERNEL_SITES entry {sub!r} has no compile_log.tracked() "
+            "site in the source — stale registration"
+        )
+    return problems

@@ -1,0 +1,167 @@
+"""graftcheck rules GC001–GC004 (see package docstring for the catalog).
+
+Each rule is `fn(contract, shape, lowered) -> List[Finding]` over one
+lowered (site, shape) pair. Inline suppression: a `"suppress"` tuple on
+the site contract or the shape entry skips those rule ids for that scope
+(declared next to the kernel, visible in review — the graftcheck analog
+of `# graftlint: disable=`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from .engine import Finding
+from .lowering import CALLBACK_PRIMITIVES, Lowered
+
+RULES: Dict[str, Tuple] = {}
+
+
+def _rule(rule_id: str, doc: str):
+    def deco(fn):
+        RULES[rule_id] = (fn, doc)
+        return fn
+
+    return deco
+
+
+def check(contract: dict, shape: dict, low: Lowered) -> List[Finding]:
+    suppressed = set(contract.get("suppress") or ()) | set(
+        shape.get("suppress") or ()
+    )
+    out: List[Finding] = []
+    for rule_id, (fn, _doc) in RULES.items():
+        if rule_id in suppressed:
+            continue
+        out.extend(fn(contract, shape, low))
+    out.sort(key=lambda f: (f.subsystem, f.shape, f.rule, f.key))
+    return out
+
+
+# ------------------------------------------------------------------ GC001
+@_rule("GC001", "host callback / jaxpr effect in a serving kernel")
+def gc001(contract: dict, shape: dict, low: Lowered) -> List[Finding]:
+    out: List[Finding] = []
+    sub, label = low.subsystem, low.label
+    for prim in sorted(low.primitives & CALLBACK_PRIMITIVES):
+        out.append(
+            Finding(
+                "GC001", sub, label,
+                f"jaxpr contains host callback `{prim}` — a callback "
+                "round-trips device->host under every launch, serializes "
+                "the async dispatch pipeline and cannot lower under a "
+                "multi-host mesh; compute it host-side around the kernel",
+                f"GC001:{sub}:{label}:{prim}",
+            )
+        )
+    if low.effects and not out:
+        out.append(
+            Finding(
+                "GC001", sub, label,
+                f"jaxpr carries effects {low.effects} — serving kernels "
+                "must be pure (effects order against XLA's scheduler and "
+                "break executable reuse)",
+                f"GC001:{sub}:{label}:effects",
+            )
+        )
+    return out
+
+
+# ------------------------------------------------------------------ GC002
+@_rule("GC002", "implicit f64 promotion / undeclared output dtype")
+def gc002(contract: dict, shape: dict, low: Lowered) -> List[Finding]:
+    out: List[Finding] = []
+    sub, label = low.subsystem, low.label
+    wide = sorted(
+        d for d in low.aval_dtypes if d in ("float64", "complex128")
+    )
+    if wide:
+        out.append(
+            Finding(
+                "GC002", sub, label,
+                f"jaxpr carries {wide} intermediates — an implicit f64 "
+                "promotion doubles memory bandwidth and falls off the "
+                "MXU; pin the accumulation dtype "
+                "(preferred_element_type=f32 / explicit astype)",
+                f"GC002:{sub}:{label}:f64",
+            )
+        )
+    declared = set(contract["out_dtypes"])
+    bad = sorted(set(low.out_dtypes) - declared)
+    if bad:
+        out.append(
+            Finding(
+                "GC002", sub, label,
+                f"lowered output dtypes {bad} not in the declared "
+                f"contract {sorted(declared)} — the dispatch collect() "
+                "path copies into host buffers typed by this contract",
+                f"GC002:{sub}:{label}:out-dtype",
+            )
+        )
+    return out
+
+
+# ------------------------------------------------------------------ GC003
+@_rule("GC003", "undeclared collective / all-gather-then-dynamic-slice")
+def gc003(contract: dict, shape: dict, low: Lowered) -> List[Finding]:
+    out: List[Finding] = []
+    sub, label = low.subsystem, low.label
+    allowed = set(contract.get("allowed_collectives") or ())
+    for op, count in sorted(low.collectives.items()):
+        if op in allowed:
+            continue
+        if contract["kind"] == "single":
+            why = (
+                "a single-device kernel lowered a collective — a mesh "
+                "dependency leaked into the per-chip path"
+            )
+        else:
+            why = (
+                f"not in the site's declared allowlist {sorted(allowed)} "
+                "— an undeclared collective moves corpus-sized payload "
+                "over ICI (declare it only after proving the payload is "
+                "O(k·devices))"
+            )
+        out.append(
+            Finding(
+                "GC003", sub, label,
+                f"lowered HLO contains {count}x `{op}`: {why}",
+                f"GC003:{sub}:{label}:{op}",
+            )
+        )
+    if low.gather_feeds_dynamic_slice:
+        out.append(
+            Finding(
+                "GC003", sub, label,
+                "an all-gather's result feeds a dynamic-slice — the SPMD "
+                "partitioner's reshard signature (every chip gathers the "
+                "full array just to re-slice its shard); fix the "
+                "partition specs so the data never leaves its shard",
+                f"GC003:{sub}:{label}:gather-then-slice",
+            )
+        )
+    return out
+
+
+# ------------------------------------------------------------------ GC004
+@_rule("GC004", "dynamic dimensions defeating warm-tile executable reuse")
+def gc004(contract: dict, shape: dict, low: Lowered) -> List[Finding]:
+    out: List[Finding] = []
+    sub, label = low.subsystem, low.label
+    if low.has_dynamic_dims or low.dynamic_shape_ops:
+        detail = (
+            f"dynamic-shape ops {sorted(set(low.dynamic_shape_ops))}"
+            if low.dynamic_shape_ops
+            else "`?` dimensions in tensor types"
+        )
+        out.append(
+            Finding(
+                "GC004", sub, label,
+                f"lowered HLO carries {detail} — dynamic dims mint a new "
+                "executable per runtime shape, defeating the warm-tile "
+                "compile cache (utils/num.dispatch_tile pads exactly so "
+                "this never happens)",
+                f"GC004:{sub}:{label}:dynamic",
+            )
+        )
+    return out

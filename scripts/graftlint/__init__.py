@@ -37,6 +37,11 @@ to enforce from memory:
          declared KINDS registry — dynamic/unregistered kinds and
          ad-hoc appends to the ring are un-filterable, un-alertable
          timeline entries
+  GL010  `except BaseException` that terminates the exception outside
+         the sanctioned supervisor sites (bg.py service loops,
+         faults.py) — it swallows KeyboardInterrupt/SystemExit and the
+         sanitizer's control exceptions; cleanup-then-re-raise is the
+         allowed shape everywhere else
 
 Workflow:
 

@@ -16,7 +16,6 @@ current findings — review the diff like any other code change.
 from __future__ import annotations
 
 import ast
-import json
 import os
 import re
 from dataclasses import dataclass
@@ -137,38 +136,29 @@ def repo_root() -> str:
 
 
 # ------------------------------------------------------------------ baseline
+# IO shared with graftcheck (scripts/baselines.py); only the default path
+# and the file comment are graftlint's own
+_BASELINE_COMMENT = (
+    "graftlint grandfathered findings: entries here do not fail the "
+    "run. Keys are line-number-free so edits elsewhere don't churn "
+    "this file. Shrink it; never grow it without a review."
+)
+
+
 def default_baseline_path() -> str:
     return os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
 
 
 def load_baseline(path: Optional[str] = None) -> Dict[str, dict]:
-    path = path or default_baseline_path()
-    if not os.path.exists(path):
-        return {}
-    with open(path) as f:
-        doc = json.load(f)
-    return {e["key"]: e for e in doc.get("findings", [])}
+    from scripts.baselines import load_baseline as _load
+
+    return _load(path or default_baseline_path())
 
 
 def write_baseline(findings: List[Finding], path: Optional[str] = None) -> str:
-    path = path or default_baseline_path()
-    doc = {
-        "_comment": (
-            "graftlint grandfathered findings: entries here do not fail the "
-            "run. Keys are line-number-free so edits elsewhere don't churn "
-            "this file. Shrink it; never grow it without a review."
-        ),
-        "findings": [
-            {"rule": f.rule, "key": k, "message": f.message}
-            for k, f in sorted(
-                {f.key: f for f in findings}.items()
-            )  # keys are the identity; same-key sites share one entry
-        ],
-    }
-    with open(path, "w") as f:
-        json.dump(doc, f, indent=1)
-        f.write("\n")
-    return path
+    from scripts.baselines import write_baseline as _write
+
+    return _write(findings, path or default_baseline_path(), _BASELINE_COMMENT)
 
 
 # ------------------------------------------------------------------ runner
@@ -208,7 +198,6 @@ def apply_baseline(
     findings: List[Finding], baseline: Dict[str, dict]
 ) -> Tuple[List[Finding], List[str]]:
     """Split into (new findings, stale baseline keys)."""
-    seen_keys = {f.key for f in findings}
-    new = [f for f in findings if f.key not in baseline]
-    stale = [k for k in baseline if k not in seen_keys]
-    return new, stale
+    from scripts.baselines import apply_baseline as _apply
+
+    return _apply(findings, baseline)

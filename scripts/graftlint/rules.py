@@ -1,4 +1,4 @@
-"""graftlint rules GL001–GL008 (see package docstring for the catalog).
+"""graftlint rules GL001–GL010 (see package docstring for the catalog).
 
 Each rule is `fn(modules: List[Module]) -> List[Finding]`. Rules are
 deliberately HEURISTIC — they encode this codebase's conventions, not a
@@ -632,6 +632,53 @@ def _gl009_registry() -> Optional[Set[str]]:
         return set(KINDS)
     except Exception:  # noqa: BLE001 — lint must not require a working engine
         return None
+
+
+# ------------------------------------------------------------------ GL010
+# BaseException catches KeyboardInterrupt/SystemExit and the sanitizer's
+# own control exceptions: outside the supervisor sites that deliberately
+# firewall service loops (bg.py) and the fault-injection engine
+# (faults.py), a handler may only catch BaseException to CLEAN UP AND
+# RE-RAISE. A handler that terminates the exception converts a process
+# shutdown into a half-alive engine.
+GL010_ALLOWED_FILES = frozenset(
+    {"surrealdb_tpu/bg.py", "surrealdb_tpu/faults.py"}
+)
+
+
+@_rule("GL010", "except BaseException without re-raise outside bg.py/faults.py")
+def gl010(modules: List[Module]) -> List[Finding]:
+    out: List[Finding] = []
+    for m in modules:
+        if m.rel in GL010_ALLOWED_FILES:
+            continue
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            t = node.type
+            names = []
+            if isinstance(t, ast.Name):
+                names = [t.id]
+            elif isinstance(t, ast.Tuple):
+                names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+            # a bare `except:` IS `except BaseException:` — same hazard
+            if t is not None and "BaseException" not in names:
+                continue
+            # cleanup-then-propagate is the sanctioned shape: any raise
+            # inside the handler body keeps the exception alive
+            if any(isinstance(n, ast.Raise) for n in ast.walk(node)):
+                continue
+            out.append(
+                Finding(
+                    "GL010", m.rel, node.lineno, node.col_offset,
+                    "`except BaseException` that terminates the exception "
+                    "— this swallows KeyboardInterrupt/SystemExit too; "
+                    "narrow to Exception, or re-raise after cleanup "
+                    "(supervisor firewalls live only in bg.py/faults.py)",
+                    f"GL010:{m.rel}:{m.enclosing_def(node)}",
+                )
+            )
+    return out
 
 
 @_rule("GL008", "retry loop without backoff/attempt cap; bare except-swallow")

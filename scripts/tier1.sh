@@ -3,8 +3,14 @@
 # wrapped so CI and humans run the identical command, plus the repo's
 # static-analysis and concurrency-sanitizer gates:
 #
-#   0. `python -m scripts.graftlint` — engine-specific lint (GL001–GL009);
+#   0. `python -m scripts.graftlint` — engine-specific lint (GL001–GL010);
 #      findings beyond scripts/graftlint/baseline.json fail the gate.
+#   0.5 `python -m scripts.graftcheck` — compiled-IR kernel audit
+#      (GC001–GC004): every compile_log-registered kernel lowered to
+#      jaxpr/StableHLO (simulated 8-device mesh for the shard_map
+#      runners) and checked for host callbacks, f64 promotion,
+#      undeclared collectives and dynamic shapes; writes the
+#      kernel_audit report bundle.py embeds.
 #   1. the pytest tier-1 suite (exit code preserved; log in /tmp/_t1.log,
 #      DOTS_PASSED recount printed — driver-proof pass counting).
 #   2. a SURREAL_SANITIZE=1 smoke subset re-run: instrumented locks record
@@ -56,6 +62,17 @@ fi
 python -m scripts.graftlint
 lint_rc=$?
 
+# ---- gate 0.5: compiled-IR kernel audit -------------------------------------
+# its own process: graftcheck pins JAX_PLATFORMS/XLA_FLAGS (8 simulated
+# host devices) BEFORE jax loads, which an interpreter that already
+# imported jax cannot do. The report lands where bundle.py reads it.
+# the report path follows the same knob bundle.py reads, so bundles
+# embedded by the rest of this run always see THIS gate's audit
+audit_report="${SURREAL_KERNEL_AUDIT_REPORT:-/tmp/_graftcheck_report.json}"
+rm -f "$audit_report"
+timeout -k 10 600 python -m scripts.graftcheck
+gcheck_rc=$?
+
 # ---- gate 1: the canonical tier-1 suite ------------------------------------
 rm -f /tmp/_t1.log /tmp/_t1_bundle.json
 timeout -k 10 870 env JAX_PLATFORMS=cpu SURREAL_T1_BUNDLE=/tmp/_t1_bundle.json \
@@ -96,10 +113,11 @@ fi
 
 # ---- verdict ---------------------------------------------------------------
 [ "$lint_rc" -ne 0 ] && echo "GATE FAILED: graftlint (rc=$lint_rc)"
+[ "$gcheck_rc" -ne 0 ] && echo "GATE FAILED: graftcheck kernel audit (rc=$gcheck_rc)"
 [ "$rc" -ne 0 ] && echo "GATE FAILED: tier-1 pytest (rc=$rc)"
 [ "$san_rc" -ne 0 ] && echo "GATE FAILED: sanitizer smoke subset (rc=$san_rc)"
 [ "$lock_rc" -ne 0 ] && echo "GATE FAILED: lock-order cross-check (rc=$lock_rc)"
 # pytest's exit code still wins for compatibility with the driver recount
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
-if [ "$lint_rc" -ne 0 ] || [ "$san_rc" -ne 0 ] || [ "$lock_rc" -ne 0 ]; then exit 1; fi
+if [ "$lint_rc" -ne 0 ] || [ "$gcheck_rc" -ne 0 ] || [ "$san_rc" -ne 0 ] || [ "$lock_rc" -ne 0 ]; then exit 1; fi
 exit 0

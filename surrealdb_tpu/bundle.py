@@ -24,7 +24,14 @@ versioned document — the artifact you attach to any perf report:
 9. `events`        — the structured event timeline (events.py): bounded,
                      trace-linked operational transitions (flaps, breaker
                      trips, degraded reads, sheds, failpoint trips,
-                     bg stalls/restarts, group-commit rescues).
+                     bg stalls/restarts, group-commit rescues);
+10. `kernel_audit` — the graftcheck compiled-IR audit report (scripts/
+                     graftcheck): per-kernel rule results GC001–GC004,
+                     declared collectives, lowered-shape matrix and HLO
+                     digest per shape key — read from the report file the
+                     last `python -m scripts.graftcheck` run wrote
+                     (cnf.KERNEL_AUDIT_REPORT); `available: false` when
+                     no audit has run on this host.
 
 Served by `GET /debug/bundle` (system-user-gated) and embedded via
 `INFO FOR ROOT` (`system.bundle`); bench.py embeds one per artifact so a
@@ -33,7 +40,7 @@ with `ds=None` too (global registries only) — the tier-1 failure hook
 uses that to dump diagnostics from a dying test process.
 
 On a cluster node `GET /debug/bundle?cluster=1` federates instead
-(cluster/federation.py): one `surrealdb-tpu-bundle/3` document whose
+(cluster/federation.py): one `surrealdb-tpu-bundle/4` document whose
 `nodes` map carries every member's full bundle, dead members marked
 `{"unreachable": true}` — the request still answers 200.
 """
@@ -43,12 +50,12 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, Optional
 
-BUNDLE_SCHEMA = "surrealdb-tpu-bundle/3"
+BUNDLE_SCHEMA = "surrealdb-tpu-bundle/4"
 
 # the sections every consumer may rely on
 SECTIONS = (
     "traces", "slow_queries", "errors", "tasks", "compiles", "engine",
-    "locks", "faults", "events",
+    "locks", "faults", "events", "kernel_audit",
 )
 
 
@@ -81,8 +88,31 @@ def debug_bundle(
         "locks": locks.report(),
         "faults": faults.snapshot(),
         "events": events.snapshot(),
+        "kernel_audit": _kernel_audit_state(),
     }
     return out
+
+
+def _kernel_audit_state() -> Dict[str, Any]:
+    """The last graftcheck kernel_audit report, embedded verbatim (plus
+    provenance). The audit runs as its own pinned-env process, so the
+    report FILE is the handoff; a host that never ran the audit reports
+    `available: false` rather than failing the bundle."""
+    import json
+    import os
+
+    from surrealdb_tpu import cnf
+
+    path = cnf.KERNEL_AUDIT_REPORT
+    try:
+        if path and os.path.exists(path):
+            with open(path) as f:
+                rep = json.load(f)
+            if isinstance(rep, dict) and isinstance(rep.get("kernels"), dict):
+                return {"available": True, "source": path, **rep}
+    except (OSError, ValueError):
+        pass  # a corrupt report file must never fail a diagnostics dump
+    return {"available": False, "source": path}
 
 
 def _engine_state(ds) -> Dict[str, Any]:

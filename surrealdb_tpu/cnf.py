@@ -282,6 +282,12 @@ BG_WATCHDOG_INTERVAL_SECS = _env_float("SURREAL_BG_WATCHDOG_INTERVAL", 1.0)
 BG_WATCHDOG_DEADLINE_SECS = _env_float("SURREAL_BG_WATCHDOG_DEADLINE", 120.0)
 BG_REGISTRY_CAP = _env_int("SURREAL_BG_REGISTRY_CAP", 512)
 COMPILE_LOG_CAP = _env_int("SURREAL_COMPILE_LOG_CAP", 512)
+# Where `python -m scripts.graftcheck` writes the kernel_audit report and
+# where bundle.py reads it back as the bundle's kernel_audit section (the
+# audit runs as its own pinned-env process, so a file is the handoff).
+KERNEL_AUDIT_REPORT = os.environ.get(
+    "SURREAL_KERNEL_AUDIT_REPORT", "/tmp/_graftcheck_report.json"
+)
 
 # Concurrency sanitizer (utils/locks.py): instrumented lock wrappers record
 # the lock-acquisition graph, detect order cycles (potential deadlocks) and

@@ -21,6 +21,16 @@ call sites (idx/knn.py, idx/ivf.py, idx/graph_csr.py):
 Shape keys are value tuples of static dims (tile, dim, cap, k, ...), the
 same things XLA keys its own cache on, so "first call per key" == "this
 call traced + compiled". The log is bounded by SURREAL_COMPILE_LOG_CAP.
+
+The registry below (KERNEL_SITES) makes the tracked sites ENUMERABLE:
+every subsystem name ever passed to tracked() maps to the import path of
+a `graftcheck_sites()` provider in the module that owns the kernel. The
+provider declares the kernel's audit contract — representative shape
+matrix, abstract-lowering builder, allowed collectives, declared output
+dtypes — and `python -m scripts.graftcheck` lowers each one to
+jaxpr/StableHLO and checks the GC001–GC004 contracts against the IR. A
+new jitted kernel MUST register here (tests/test_graftcheck.py asserts
+source-tracked subsystems ⊆ KERNEL_SITES), so it cannot ship unaudited.
 """
 
 from __future__ import annotations
@@ -31,6 +41,25 @@ import time
 from collections import deque
 from contextlib import contextmanager
 from typing import Any, Deque, Optional, Tuple
+
+# ---------------------------------------------------------------- registry
+# subsystem -> "import.path:provider" of the module that owns the kernel.
+# The provider is a zero-arg callable returning a list of audit-contract
+# dicts (one per subsystem it hosts); scripts/graftcheck/registry.py
+# resolves and validates them. Keys are EXACTLY the subsystem strings
+# passed to tracked() — the registry-completeness test diffs the two.
+KERNEL_SITES = {
+    "knn_exact": "surrealdb_tpu.idx.knn:graftcheck_sites",
+    "knn_sharded": "surrealdb_tpu.parallel.mesh:graftcheck_sites",
+    "ivf": "surrealdb_tpu.idx.ivf:graftcheck_sites",
+    "ivf_sharded": "surrealdb_tpu.parallel.mesh:graftcheck_sites",
+    "graph_dense": "surrealdb_tpu.idx.graph_csr:graftcheck_sites",
+    "graph_csc": "surrealdb_tpu.idx.graph_csr:graftcheck_sites",
+    "graph_chain": "surrealdb_tpu.idx.graph_csr:graftcheck_sites",
+    "bm25": "surrealdb_tpu.ops.bm25:graftcheck_sites",
+    "ml_forward": "surrealdb_tpu.ml.model:graftcheck_sites",
+}
+
 
 _lock = _locks.Lock("compile_log")
 _seen: set = set()  # (subsystem, shape_key) already compiled

@@ -112,16 +112,24 @@ def try_bulk_relate(ctx, stm, pairs, edge_tb: str):
     """Bulk-run a RELATE statement's endpoint product through the edge
     writer (`_EdgeWriter`) — the same fast path INSERT RELATION takes.
     `pairs` is the [(from, with), ...] product; returns output rows, or
-    None when the statement shape needs the per-row pipeline. Only
-    data-free, non-UNIQUE, AFTER/NONE-output RELATEs over an eligible
-    table qualify: anything else (SET/CONTENT clauses can reference $in /
-    $out per edge, UNIQUE needs the existing-edge probe) falls back."""
+    None when the statement shape needs the per-row pipeline. Non-UNIQUE,
+    AFTER/NONE-output RELATEs over an eligible table qualify; a
+    SET/CONTENT clause joins the bulk path when it PROVABLY cannot differ
+    per edge — no $in/$out (or any per-doc context), no field reads, no
+    function calls — in which case it is evaluated ONCE and stamped onto
+    every edge (exactly what the per-row pipeline would have computed N
+    times). Anything else (UNIQUE needs the existing-edge probe, an
+    edge-dependent clause needs per-edge evaluation) falls back."""
     from surrealdb_tpu.iam.check import check_data_write, perms_apply
 
     if len(pairs) < cnf.BULK_INSERT_MIN:
         return None
-    if getattr(stm, "data", None) is not None:
-        return None
+    payload = None
+    data = getattr(stm, "data", None)
+    if data is not None:
+        payload = _relate_bulk_payload(ctx, data)
+        if payload is None:
+            return None
     if getattr(stm, "uniq", False) or getattr(stm, "only", False):
         return None
     output = getattr(stm, "output", None)
@@ -140,13 +148,117 @@ def try_bulk_relate(ctx, stm, pairs, edge_tb: str):
     ):
         return None
     plan = _TablePlan(ctx, edge_tb)
-    batch = [(Thing(edge_tb), {"in": f, "out": w}) for f, w in pairs]
+    if payload:
+        import copy
+
+        # nested containers must not be SHARED across edges (field defs /
+        # later UPDATEs would alias them); per-row evaluation made a fresh
+        # value per edge, so the bulk stamp deep-copies per edge too
+        deep = any(isinstance(v, (list, dict)) for v in payload.values())
+        batch = [
+            (
+                Thing(edge_tb),
+                {
+                    **(copy.deepcopy(payload) if deep else payload),
+                    "in": f,
+                    "out": w,
+                },
+            )
+            for f, w in pairs
+        ]
+    else:
+        batch = [(Thing(edge_tb), {"in": f, "out": w}) for f, w in pairs]
     out = _insert_table_batch(
         ctx, plan, batch, relation=True, ignore=False, out_kind=out_kind
     )
     if out_kind == "none":
         return []
     return [v for v in out if v is not _SKIPPED]
+
+
+# parameters the doc pipeline binds per edge/doc: an expression touching
+# any of these can differ per edge and must take the per-row path
+_RELATE_DOC_PARAMS = frozenset(
+    {"in", "out", "this", "parent", "before", "after", "value", "input", "event"}
+)
+
+
+def _edge_independent(expr) -> bool:
+    """True when `expr` provably evaluates to the SAME value for every
+    edge of the statement: literals, statement-level $params, and
+    array/object/binary/unary compositions thereof. Field reads, graph
+    idioms, subqueries and function calls (rand(), time::now(), ...) all
+    fail the proof — conservatively, anything unrecognized does."""
+    from surrealdb_tpu.sql.ast import (
+        ArrayLit,
+        BinaryOp,
+        Constant,
+        Literal,
+        ObjectLit,
+        Param,
+        ThingLit,
+        UnaryOp,
+    )
+
+    if isinstance(expr, (Literal, Constant)):
+        return True
+    if isinstance(expr, Param):
+        return expr.name not in _RELATE_DOC_PARAMS
+    if isinstance(expr, ThingLit):
+        # record-id literals with expression id parts (person:uuid()) are
+        # per-evaluation values; plain ids and literal/param id exprs
+        # qualify
+        from surrealdb_tpu.sql.ast import Expr as _Expr
+
+        if not isinstance(expr.id, _Expr):
+            return True
+        return _edge_independent(expr.id)
+    if isinstance(expr, ArrayLit):
+        return all(_edge_independent(i) for i in expr.items)
+    if isinstance(expr, ObjectLit):
+        return all(_edge_independent(v) for _, v in expr.pairs)
+    if isinstance(expr, UnaryOp):
+        return _edge_independent(expr.expr)
+    if isinstance(expr, BinaryOp):
+        return _edge_independent(expr.l) and _edge_independent(expr.r)
+    return False
+
+
+def _relate_bulk_payload(ctx, data) -> Optional[dict]:
+    """Evaluate an edge-independent SET/CONTENT clause ONCE; returns the
+    field dict to stamp on every edge, or None when the clause needs the
+    per-row pipeline. `id`/`in`/`out` keys are dropped — the per-row
+    pipeline forcibly overwrites them after apply_data, so stamping the
+    endpoints per pair preserves its semantics exactly."""
+    from surrealdb_tpu.sql.path import PField
+
+    if data.kind == "set":
+        payload: dict = {}
+        for idiom, op, expr in data.items:
+            parts = getattr(idiom, "parts", None)
+            if (
+                op != "="
+                or not parts
+                or len(parts) != 1
+                or not isinstance(parts[0], PField)
+                or parts[0].name in ("id", "in", "out")
+                or not _edge_independent(expr)
+            ):
+                return None
+            payload[parts[0].name] = expr.compute(ctx)
+        return payload
+    if data.kind == "content":
+        items = data.items
+        if hasattr(items, "compute"):
+            if not _edge_independent(items):
+                return None
+            v = items.compute(ctx)
+        else:
+            v = items
+        if not isinstance(v, dict):
+            return None  # per-row path raises the precise CONTENT error
+        return {k: val for k, val in v.items() if k not in ("id", "in", "out")}
+    return None
 
 
 class _TablePlan:

@@ -1195,3 +1195,113 @@ class GraphMirrors:
         query; the device already holds the counts, and the fused chain
         kernel downloads a single scalar)."""
         return self._chain_frontier(ctx, start, parts, count_only=True)
+
+
+def graftcheck_sites():
+    """Audit contracts of the three graph count/expand kernels (compile_log
+    subsystems `graph_dense` / `graph_csc` / `graph_chain`): representative
+    2-hop chains over pow2-padded adjacencies at the dispatch lane widths
+    the batched count paths serve."""
+    import jax
+    import jax.numpy as jnp
+
+    n0, n_cap, fsz, E = 256, 256, 64, 1024
+
+    def build_dense(shape):
+        import ml_dtypes
+
+        _kernels()
+        kernel = _JITTED["dense_count_batch"]
+        lanes = shape["lanes"]
+        As = tuple(
+            jax.ShapeDtypeStruct((n0, n0), jnp.dtype(ml_dtypes.bfloat16))
+            for _ in range(shape["hops"])
+        )
+        args = (
+            As,
+            jax.ShapeDtypeStruct((n0,), jnp.float32),
+            jax.ShapeDtypeStruct((lanes, fsz), jnp.int32),
+            jax.ShapeDtypeStruct((lanes, fsz), jnp.int32),
+        )
+        return (lambda A, od, fr, cw: kernel(A, od, fr, cw, n0=n0)), args
+
+    def build_csc(shape):
+        _kernels()
+        kernel = _JITTED["chain_count_batch"]
+        lanes = shape["lanes"]
+        csc_hops = tuple(
+            ((jax.ShapeDtypeStruct((n_cap + 1,), jnp.int32),
+              jax.ShapeDtypeStruct((E,), jnp.int32)),)
+            for _ in range(shape["hops"] - 1)
+        )
+        last_hop = ((jax.ShapeDtypeStruct((n_cap + 1,), jnp.int32),),)
+        args = (
+            csc_hops,
+            last_hop,
+            jax.ShapeDtypeStruct((lanes, fsz), jnp.int32),
+            jax.ShapeDtypeStruct((lanes, fsz), jnp.int32),
+        )
+        return (
+            lambda ch, lh, fr, cw: kernel(ch, lh, fr, cw, n_cap=n_cap),
+            args,
+        )
+
+    def build_chain(shape):
+        kernel = _kernels()
+        hops = tuple(
+            ((jax.ShapeDtypeStruct((n_cap + 1,), jnp.int32),
+              jax.ShapeDtypeStruct((E,), jnp.int32)),)
+            for _ in range(shape["hops"])
+        )
+        mds = tuple((8,) for _ in range(shape["hops"]))
+        out_sizes = tuple(n_cap for _ in range(shape["hops"]))
+        count_only = shape["count_only"]
+        args = (
+            hops,
+            jax.ShapeDtypeStruct((fsz,), jnp.int32),
+            jax.ShapeDtypeStruct((fsz,), jnp.int32),
+        )
+        return (
+            lambda h, fr, cw: kernel(
+                h, fr, cw, mds=mds, n_cap=n_cap, out_sizes=out_sizes,
+                count_only=count_only,
+            ),
+            args,
+        )
+
+    lane_shapes = [
+        {"label": f"l{lanes}_f{fsz}_n{n0}_h2", "lanes": lanes, "hops": 2}
+        for lanes in (1, 8)
+    ]
+    return [
+        {
+            "subsystem": "graph_dense",
+            "module": __name__,
+            "kind": "single",
+            "allowed_collectives": (),
+            "out_dtypes": ("float32",),
+            "shapes": lane_shapes,
+            "build": build_dense,
+        },
+        {
+            "subsystem": "graph_csc",
+            "module": __name__,
+            "kind": "single",
+            "allowed_collectives": (),
+            "out_dtypes": ("int32",),
+            "shapes": lane_shapes,
+            "build": build_csc,
+        },
+        {
+            "subsystem": "graph_chain",
+            "module": __name__,
+            "kind": "single",
+            "allowed_collectives": (),
+            "out_dtypes": ("int32",),
+            "shapes": [
+                {"label": "f64_n256_h2_expand", "hops": 2, "count_only": False},
+                {"label": "f64_n256_h3_count", "hops": 3, "count_only": True},
+            ],
+            "build": build_chain,
+        },
+    ]

@@ -632,6 +632,59 @@ class IvfState:
         return dd, rr
 
 
+def graftcheck_sites():
+    """Audit contract of the fused IVF probe+rerank kernel (compile_log
+    subsystem `ivf`): the warm-tile query shapes over a representative
+    (C lists × L members) quantizer, euclidean + the cosine/rerank mix."""
+    from surrealdb_tpu.utils.num import warm_tile_sizes
+
+    dim, cap, k = 64, 2048, 10
+    C, L, nprobe = 64, 32, 8
+
+    def build(shape):
+        import jax as _jax
+        import jax.numpy as jnp
+
+        args = (
+            _jax.ShapeDtypeStruct((shape["tile"], dim), jnp.float32),
+            _jax.ShapeDtypeStruct((C, dim), jnp.float32),
+            _jax.ShapeDtypeStruct((C, L), jnp.int32),
+            _jax.ShapeDtypeStruct((C, L), jnp.bool_),
+            _jax.ShapeDtypeStruct((cap, dim), jnp.float32),
+            _jax.ShapeDtypeStruct((cap,), jnp.bool_),
+        )
+        metric = shape["metric"]
+        probe_metric = metric if metric in _PROBE_METRICS else "euclidean"
+
+        def run(q, cents, rows, mask, x, slot_ok):
+            return _ivf_search(
+                q, cents, rows, mask, x, slot_ok,
+                metric=metric, probe_metric=probe_metric,
+                k=shape["k"], nprobe=nprobe,
+            )
+
+        return run, args
+
+    shapes = [
+        {"label": f"t{t}_d{dim}_c{cap}_C{C}_L{L}_p{nprobe}_{m}_k{k}",
+         "tile": t, "metric": m, "k": k}
+        for t, m in (
+            [(t, "euclidean") for t in warm_tile_sizes()] + [(8, "cosine")]
+        )
+    ]
+    return [
+        {
+            "subsystem": "ivf",
+            "module": __name__,
+            "kind": "single",
+            "allowed_collectives": (),
+            "out_dtypes": ("float32", "int32"),
+            "shapes": shapes,
+            "build": build,
+        }
+    ]
+
+
 @functools.partial(jax.jit, static_argnames=("metric", "probe_metric", "k", "nprobe"))
 def _ivf_search(q, cents, list_rows, list_mask, x, slot_ok, metric, probe_metric, k, nprobe):
     """q [Q, D] → (dists [Q, k], row slots [Q, k]); vmapped per query.

@@ -528,6 +528,58 @@ def _exact_device_batch(qs: np.ndarray, matrix, mask, metric: str, k: int):
     return _exact_device_launch(qs, matrix, mask, metric, k)()
 
 
+def graftcheck_sites():
+    """Audit contract of the exact fused distance+top-k kernel
+    (compile_log subsystem `knn_exact`; scripts/graftcheck lowers every
+    shape here to StableHLO and checks GC001–GC004). The shape matrix is
+    the dispatcher's warm-tile vocabulary — the same shapes the background
+    warmers pre-compile — over the serving metrics, plus the bf16 corpus
+    variant the accelerator upload path uses."""
+    from surrealdb_tpu.utils.num import warm_tile_sizes
+
+    dim, cap, k = 64, 2048, 10
+
+    def build(shape):
+        import jax
+        import jax.numpy as jnp
+
+        from surrealdb_tpu.ops.distances import knn_search
+
+        if shape["dtype"] == "bfloat16":
+            import ml_dtypes
+
+            cdt = jnp.dtype(ml_dtypes.bfloat16)
+        else:
+            cdt = jnp.float32
+        args = (
+            jax.ShapeDtypeStruct((shape["tile"], dim), jnp.float32),
+            jax.ShapeDtypeStruct((cap, dim), cdt),
+            jax.ShapeDtypeStruct((cap,), jnp.bool_),
+        )
+        metric, kk = shape["metric"], shape["k"]
+        return (lambda q, x, m: knn_search(q, x, m, metric, kk)), args
+
+    shapes = [
+        {"label": f"t{t}_d{dim}_c{cap}_{m}_k{k}_{dt}",
+         "tile": t, "metric": m, "k": k, "dtype": dt}
+        for t, m, dt in (
+            [(t, "euclidean", "float32") for t in warm_tile_sizes()]
+            + [(8, "cosine", "float32"), (8, "euclidean", "bfloat16")]
+        )
+    ]
+    return [
+        {
+            "subsystem": "knn_exact",
+            "module": __name__,
+            "kind": "single",
+            "allowed_collectives": (),
+            "out_dtypes": ("float32", "int32"),
+            "shapes": shapes,
+            "build": build,
+        }
+    ]
+
+
 class _KnnResult:
     """Admitted record set for the operator check (reference KnnPriorityList)."""
 

@@ -248,3 +248,47 @@ class CompiledModel:
             "ml_forward", (self.seq, cap, self.in_dim, self.out_dim)
         ):
             return np.asarray(fwd(jnp.asarray(x.astype(np.float32))))[:n]
+
+
+def graftcheck_sites():
+    """Audit contract of the jitted model forward (compile_log subsystem
+    `ml_forward`): a representative linear/MLP stack over the pow2-padded
+    batch caps the serving path mints, weights baked in as constants the
+    way CompiledModel._device_fn closes over them."""
+
+    def build(shape):
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(11)
+        dims = shape["dims"]
+        layers = [
+            {
+                "w": rng.standard_normal((dims[i], dims[i + 1])).astype(np.float32),
+                "b": np.zeros(dims[i + 1], np.float32),
+                "activation": shape["acts"][i],
+            }
+            for i in range(len(dims) - 1)
+        ]
+        model = CompiledModel({"format": "mlp", "layers": layers})
+        fwd = model._device_fn()
+        args = (jax.ShapeDtypeStruct((shape["cap"], dims[0]), jnp.float32),)
+        return fwd, args
+
+    shapes = [
+        {"label": "mlp16x32x8_relu_softmax_b1024", "cap": 1024,
+         "dims": (16, 32, 8), "acts": ("relu", "softmax")},
+        {"label": "linear16x4_b2048", "cap": 2048,
+         "dims": (16, 4), "acts": (None,)},
+    ]
+    return [
+        {
+            "subsystem": "ml_forward",
+            "module": __name__,
+            "kind": "single",
+            "allowed_collectives": (),
+            "out_dtypes": ("float32",),
+            "shapes": shapes,
+            "build": build,
+        }
+    ]

@@ -44,6 +44,52 @@ def bm25_topk(tf, df, doc_len, doc_count, total_len, k: int, k1=1.2, b=0.75):
     return vals, idx
 
 
+def graftcheck_sites():
+    """Audit contract of the fused BM25 scoring kernel (compile_log
+    subsystem `bm25`, launched by idx/ft_index.py + idx/ft_mirror.py with
+    (N candidates, T query terms) shape keys)."""
+
+    def build(shape):
+        import jax
+        import jax.numpy as jnp
+
+        n, t = shape["n"], shape["t"]
+        tf_dt = jnp.int32 if shape["tf_dtype"] == "int32" else jnp.float32
+        args = (
+            jax.ShapeDtypeStruct((n, t), tf_dt),
+            jax.ShapeDtypeStruct((t,), jnp.float32),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+        )
+        if shape.get("k"):
+            k = shape["k"]
+            return (
+                lambda tf, df, dl, dc, tl: bm25_topk(tf, df, dl, dc, tl, k),
+                args,
+            )
+        return bm25_scores, args
+
+    shapes = [
+        {"label": "n256_t8_f32", "n": 256, "t": 8, "tf_dtype": "float32"},
+        {"label": "n2048_t8_i32", "n": 2048, "t": 8, "tf_dtype": "int32"},
+        {"label": "n2048_t8_f32_top10", "n": 2048, "t": 8,
+         "tf_dtype": "float32", "k": 10},
+    ]
+    return [
+        {
+            "subsystem": "bm25",
+            "module": __name__,
+            "kind": "single",
+            "allowed_collectives": (),
+            # bm25_scores -> [N] f32; bm25_topk adds the int32 index plane
+            "out_dtypes": ("float32", "int32"),
+            "shapes": shapes,
+            "build": build,
+        }
+    ]
+
+
 def bm25_scores_host(tf, df, doc_len, doc_count, total_len, k1=1.2, b=0.75):
     """numpy twin of bm25_scores for candidate sets too small to amortize a
     device dispatch (threshold in cnf.TPU_FT_ONDEVICE_THRESHOLD)."""

@@ -300,6 +300,98 @@ def sharded_frontier_hop(
     return _hop(indptr, indices, frontier, frontier_mask)
 
 
+def graftcheck_sites():
+    """Audit contracts of the mesh runners (compile_log subsystems
+    `knn_sharded` / `ivf_sharded`). These are the kernels the ROADMAP's
+    multi-host refactor rides on: scripts/graftcheck lowers them under a
+    simulated 8-device mesh and asserts the ONLY collective in the
+    StableHLO is the declared O(k·devices) top-k merge all-gather — XLA
+    silently inserting an all-gather of the corpus (or a gather-then-
+    dynamic-slice reshard) is exactly the 10x regression the SNIPPETS
+    [2]/[3] HLO assertion exists to catch."""
+    n_dev, dim, cap, k = 8, 64, 2048, 10
+    C, L, nprobe = 64, 32, 8
+
+    def build_knn(shape):
+        mesh = make_mesh(n_dev)
+        args = (
+            jax.ShapeDtypeStruct((cap, dim), jnp.float32),
+            jax.ShapeDtypeStruct((cap,), jnp.bool_),
+            jax.ShapeDtypeStruct((shape["tile"], dim), jnp.float32),
+        )
+        metric, kk = shape["metric"], shape["k"]
+        return (
+            lambda c, m, q: sharded_knn(mesh, c, m, q, kk, metric),
+            args,
+        )
+
+    def build_ivf(shape):
+        mesh = make_mesh(n_dev)
+        args = (
+            jax.ShapeDtypeStruct((C, dim), jnp.float32),
+            jax.ShapeDtypeStruct((n_dev, C, L), jnp.int32),
+            jax.ShapeDtypeStruct((n_dev, C, L), jnp.bool_),
+            jax.ShapeDtypeStruct((cap, dim), jnp.float32),
+            jax.ShapeDtypeStruct((shape["tile"], dim), jnp.float32),
+            jax.ShapeDtypeStruct((cap,), jnp.bool_),
+        )
+        metric, kk = shape["metric"], shape["k"]
+        # mirror the serving path (idx/ivf.py search_batch_sharded): the
+        # probe metric follows the serving metric when the quantizer can
+        # probe in it — auditing euclidean probes under a cosine serve
+        # would bless a lowering the engine never compiles
+        from surrealdb_tpu.idx.ivf import _PROBE_METRICS
+
+        probe_metric = metric if metric in _PROBE_METRICS else "euclidean"
+
+        def run(cents, rows, mask, corpus, q, slot_ok):
+            return sharded_ivf_search(
+                mesh, cents, rows, mask, corpus, q, kk, nprobe,
+                metric=metric, probe_metric=probe_metric, slot_ok=slot_ok,
+            )
+
+        return run, args
+
+    def tiles():
+        from surrealdb_tpu.utils.num import warm_tile_sizes
+
+        return warm_tile_sizes()
+
+    knn_shapes = [
+        {"label": f"t{t}_d{dim}_c{cap}_{m}_k{k}_mesh{n_dev}",
+         "tile": t, "metric": m, "k": k}
+        for t, m in [(t, "euclidean") for t in tiles()] + [(8, "cosine")]
+    ]
+    ivf_shapes = [
+        {"label": f"t{t}_d{dim}_c{cap}_C{C}_L{L}_p{nprobe}_{m}_k{k}_mesh{n_dev}",
+         "tile": t, "metric": m, "k": k}
+        for t, m in [(t, "euclidean") for t in tiles()] + [(8, "cosine")]
+    ]
+    return [
+        {
+            "subsystem": "knn_sharded",
+            "module": __name__,
+            "kind": "sharded",
+            "mesh_devices": n_dev,
+            # the intentional top-k candidate merge (O(k·devices) payload)
+            "allowed_collectives": ("all-gather",),
+            "out_dtypes": ("float32", "int32"),
+            "shapes": knn_shapes,
+            "build": build_knn,
+        },
+        {
+            "subsystem": "ivf_sharded",
+            "module": __name__,
+            "kind": "sharded",
+            "mesh_devices": n_dev,
+            "allowed_collectives": ("all-gather",),
+            "out_dtypes": ("float32", "int32"),
+            "shapes": ivf_shapes,
+            "build": build_ivf,
+        },
+    ]
+
+
 @functools.partial(jax.jit, static_argnames=("n_nodes",))
 def dedup_frontier(nodes: jax.Array, mask: jax.Array, n_nodes: int):
     """On-device frontier dedup via a dense visited bitmap scatter.

@@ -1,0 +1,32 @@
+"""GL010 fixture: BaseException handlers that TERMINATE the exception
+outside the sanctioned supervisor files."""
+
+
+def swallow_everything():
+    try:
+        do_work()
+    except BaseException:  # terminates KeyboardInterrupt/SystemExit too
+        return None
+
+
+def convert_everything():
+    try:
+        do_work()
+    except (ValueError, BaseException) as e:  # tuple form must also flag
+        log(e)
+        return -1
+
+
+def bare_except_is_base_exception():
+    try:
+        do_work()
+    except:  # noqa: E722 — the point of the fixture
+        return None
+
+
+def do_work():
+    pass
+
+
+def log(e):
+    pass

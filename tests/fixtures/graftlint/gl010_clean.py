@@ -1,0 +1,37 @@
+"""GL010 clean twin: cleanup-then-re-raise keeps the exception alive, and
+narrow handlers are someone else's business (GL008 covers swallows)."""
+
+
+def cleanup_then_propagate():
+    try:
+        do_work()
+    except BaseException:
+        release_resources()
+        raise
+
+
+def reraise_as_var():
+    try:
+        do_work()
+    except BaseException as e:
+        note(e)
+        raise e
+
+
+def narrow_is_fine():
+    try:
+        do_work()
+    except ValueError:
+        return None
+
+
+def do_work():
+    pass
+
+
+def release_resources():
+    pass
+
+
+def note(e):
+    pass

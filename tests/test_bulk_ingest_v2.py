@@ -404,16 +404,76 @@ def test_bulk_relate_routes_through_edge_writer(small_bulk):
         assert edges(ds) == edges(ds2)
         cnt = q(ds, "SELECT count() FROM knows GROUP ALL")
         assert cnt[0]["count"] == 64
-        # UNIQUE / data clauses keep the per-row pipeline
+        # UNIQUE / edge-dependent data clauses keep the per-row pipeline
         small_bulk.setattr(cnf, "BULK_INSERT_MIN", 8)
         b0 = counter("bulk_insert_batches")
         ok(ds.execute(
             "RELATE $f->liked->$w UNIQUE", vars={"f": froms, "w": withs}
         )[-1])
         ok(ds.execute(
-            "RELATE $f->rated->$w SET score = 1", vars={"f": froms, "w": withs}
+            "RELATE $f->sourced->$w SET src = $in", vars={"f": froms, "w": withs}
         )[-1])
         assert counter("bulk_insert_batches") == b0
+        # an edge-INDEPENDENT SET joins the bulk edge writer (ISSUE 11)
+        r2 = ok(ds.execute(
+            "RELATE $f->rated->$w SET score = 1", vars={"f": froms, "w": withs}
+        )[-1])
+        assert counter("bulk_insert_batches") == b0 + 1
+        assert all(e["score"] == 1 for e in r2)
+    finally:
+        ds.close()
+        ds2.close()
+
+
+def test_bulk_relate_set_content_parity(small_bulk):
+    """The bulk stamp of an edge-independent SET/CONTENT clause must
+    produce byte-identical records to the per-row pipeline (the ROADMAP
+    carried item: clauses that provably don't reference $in/$out join the
+    bulk edge writer)."""
+    ds = Datastore("memory")
+    ds2 = Datastore("memory")
+    try:
+        for target in (ds, ds2):
+            q(target, "DEFINE TABLE person SCHEMALESS")
+            q(target, "INSERT INTO person $rows RETURN NONE",
+              {"rows": [{"id": i} for i in range(16)]})
+        froms = [Thing("person", i) for i in range(8)]
+        withs = [Thing("person", 8 + i) for i in range(8)]
+        vars_ = {
+            "f": froms, "w": withs,
+            "tag": "manual", "weights": [1, 2],
+        }
+        stmts = [
+            "RELATE $f->knows->$w SET kind = $tag, weight = 1 + 2, "
+            "meta = { src: $tag, ws: $weights }",
+            "RELATE $f->likes->$w CONTENT { kind: $tag, strength: 0.5 }",
+        ]
+        b0 = counter("bulk_insert_batches")
+        for stmt in stmts:
+            ok(ds.execute(stmt, vars=vars_)[-1])
+        assert counter("bulk_insert_batches") == b0 + len(stmts)
+        small_bulk.setattr(cnf, "BULK_INSERT_MIN", 10**9)  # per-row twin
+        for stmt in stmts:
+            ok(ds2.execute(stmt, vars=vars_)[-1])
+
+        def edges(target, tb):
+            rows = q(target, f"SELECT * OMIT id FROM {tb}")
+            return sorted(
+                (repr(r["in"]), repr(r["out"]),
+                 sorted((k, repr(v)) for k, v in r.items()
+                        if k not in ("in", "out")))
+                for r in rows
+            )
+
+        for tb in ("knows", "likes"):
+            assert edges(ds, tb) == edges(ds2, tb)
+        # nested containers must not alias across edges: mutate one edge's
+        # meta and assert its neighbours are untouched
+        rows = q(ds, "SELECT id FROM knows LIMIT 2")
+        q(ds, "UPDATE $r SET meta.ws += 99", {"r": rows[0]["id"]})
+        others = q(ds, "SELECT meta FROM knows WHERE id != $r",
+                   {"r": rows[0]["id"]})
+        assert all(o["meta"]["ws"] == [1, 2] for o in others)
     finally:
         ds.close()
         ds2.close()

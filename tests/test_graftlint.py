@@ -25,7 +25,7 @@ def rule_ids(findings):
 
 
 # ------------------------------------------------------------------ per rule
-@pytest.mark.parametrize("rule", ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008", "GL009"])
+@pytest.mark.parametrize("rule", ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008", "GL009", "GL010"])
 def test_rule_fires_on_bad_fixture_and_not_on_clean(rule):
     bad = lint(f"{rule.lower()}_bad.py", rules=[rule])
     assert rule in rule_ids(bad), f"{rule} failed to fire on its fixture"
@@ -73,6 +73,12 @@ def test_gl008_flags_unpaced_retry_and_swallow_separately():
     assert lint("gl008_clean.py", rules=["GL008"]) == []
 
 
+def test_gl010_bare_except_counts_as_base_exception():
+    keys = {f.key for f in lint("gl010_bad.py", rules=["GL010"])}
+    assert any("bare_except_is_base_exception" in k for k in keys), keys
+    assert len(keys) == 3  # named, tuple and bare forms all flagged
+
+
 def test_suppression_comment_silences_a_finding(tmp_path):
     f = tmp_path / "suppressed.py"
     f.write_text(
@@ -102,13 +108,16 @@ def test_baseline_grandfathers_then_catches_new(tmp_path):
 def test_repo_lints_clean_with_committed_baseline():
     """The acceptance criterion: surrealdb_tpu/ has no findings beyond the
     committed baseline, and the baseline stays bounded — 2 historical GL006
-    label entries plus the 13 GL008 swallow sites grandfathered when the
-    rule landed (ISSUE 9; every one is a deliberate best-effort guard with
-    a rationale comment). Shrink it; never grow it without review."""
+    label entries, the 13 GL008 swallow sites grandfathered when that rule
+    landed (ISSUE 9), and the 6 GL010 BaseException-converter sites
+    grandfathered when GL010 landed (ISSUE 11; each is a deliberate
+    propagate-to-waiters / surface-through-INFO pattern with a rationale
+    comment). Shrink it; never grow it without review."""
     findings = engine.lint_paths([os.path.join(REPO, "surrealdb_tpu")])
     baseline = engine.load_baseline()
-    assert len(baseline) <= 15, "baseline grew past the acceptance cap"
-    assert sum(1 for e in baseline.values() if e["rule"] != "GL008") <= 3
+    assert len(baseline) <= 21, "baseline grew past the acceptance cap"
+    assert sum(1 for e in baseline.values() if e["rule"] == "GL010") <= 6
+    assert sum(1 for e in baseline.values() if e["rule"] not in ("GL008", "GL010")) <= 3
     new, _stale = engine.apply_baseline(findings, baseline)
     assert new == [], "\n".join(f.render() for f in new)
 
@@ -178,7 +187,7 @@ def test_gl009_registry_matches_runtime():
 def test_every_rule_has_doc_and_registration():
     assert set(rules_mod.RULES) == {
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008", "GL009",
+        "GL008", "GL009", "GL010",
     }
     for rid, (fn, doc) in rules_mod.RULES.items():
         assert callable(fn) and doc
