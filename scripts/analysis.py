@@ -1,0 +1,75 @@
+"""Unified static-analysis entry point — `python -m scripts.analysis`.
+
+Runs the repo's three analysis layers in order, each as its own process
+(graftcheck MUST be: it pins JAX_PLATFORMS/XLA_FLAGS before jax loads):
+
+  1. graftlint  — file-local source AST rules GL001–GL011
+  2. graftcheck — compiled-IR kernel audit GC001–GC004 (jaxpr/StableHLO
+                  under the simulated 8-device mesh)
+  3. graftflow  — whole-program interprocedural flow rules GF001–GF004
+                  (+ the flow_audit report bundle.py embeds)
+
+scripts/tier1.sh calls THIS module, so the three tools cannot drift in
+invocation: a new layer added here is a new tier-1 gate everywhere.
+
+Exit code is a bitmask naming every failed layer (so CI output alone
+says which): 1 = graftlint, 2 = graftcheck, 4 = graftflow; 0 = all
+clean; 64 = usage error (reserved OUTSIDE the bitmask range so a typo'd
+--skip can never read as "graftcheck failed"). One summary line always
+prints last.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+
+LAYERS = (
+    # (name, exit-code bit, argv tail, timeout seconds)
+    ("graftlint", 1, ["-m", "scripts.graftlint"], 300),
+    ("graftcheck", 2, ["-m", "scripts.graftcheck"], 600),
+    ("graftflow", 4, ["-m", "scripts.graftflow"], 300),
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="analysis",
+        description="run graftlint + graftcheck + graftflow as one gate",
+    )
+    ap.add_argument(
+        "--skip", default="",
+        help="comma-separated layer names to skip (e.g. graftcheck — the "
+        "kernel audit needs jax and ~a minute; the AST layers are seconds)",
+    )
+    args = ap.parse_args(argv)
+    skip = {s.strip() for s in args.skip.split(",") if s.strip()}
+    unknown = skip - {name for name, _b, _a, _t in LAYERS}
+    if unknown:
+        print(f"error: unknown layer(s) in --skip: {sorted(unknown)}",
+              file=sys.stderr)
+        return 64  # usage error — outside the 1/2/4 layer bitmask
+
+    rc = 0
+    statuses = []
+    for name, bit, tail, timeout in LAYERS:
+        if name in skip:
+            statuses.append(f"{name}=SKIPPED")
+            continue
+        try:
+            proc = subprocess.run([sys.executable, *tail], timeout=timeout)
+            code = proc.returncode
+        except subprocess.TimeoutExpired:
+            code = 124
+        if code != 0:
+            rc |= bit
+            statuses.append(f"{name}=FAIL(rc={code})")
+        else:
+            statuses.append(f"{name}=OK")
+    print(f"analysis: {' '.join(statuses)} (exit {rc})")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,9 @@ its window (the usual suspects). Exit code 1 when anything was flagged,
 standalone surrealdb-tpu-bundle/1 files from GET /debug/bundle): column-
 mirror staleness flips, tables that appeared/vanished, compile-cache drift
 (shapes compiled in one round but not the other, on-demand compile counts),
-ANN quantizer state changes, dispatch counter ratios, and — on bundle/4 —
+ANN quantizer state changes, dispatch counter ratios, on bundle/5 the
+graftflow flow_audit drift (call-graph coverage shrink, new static
+lock-order edges, GF-rule pass->fail flips), and — on bundle/4 —
 graftcheck kernel_audit drift (per-kernel HLO-digest changes, declared- or
 lowered-collective changes, rule failures) — the round-over-round
 engine-state attribution the per-config metric deltas can't show.
@@ -241,6 +243,14 @@ def diff_bundles(old: dict, new: dict) -> dict:
         old.get("kernel_audit"), new.get("kernel_audit"), out["flags"]
     )
 
+    # ---- flow_audit drift (graftflow whole-program report, bundle/5+):
+    # shrinking call-graph stats mean the analyzer lost coverage (a
+    # resolution regression silently exempts paths from the GF001 proof);
+    # a rule flipping pass -> fail means a new interprocedural violation
+    out["flow_audit"] = _diff_flow_audit(
+        old.get("flow_audit"), new.get("flow_audit"), out["flags"]
+    )
+
     # ---- dispatch counter ratios (retry/split pressure)
     od = ((old.get("engine") or {}).get("dispatch") or {}).get("stats") or {}
     nd = ((new.get("engine") or {}).get("dispatch") or {}).get("stats") or {}
@@ -328,6 +338,60 @@ def _diff_kernel_audit(
             )
         if entry:
             out["kernels"][name] = entry
+    return out
+
+
+def _diff_flow_audit(
+    old: Optional[dict], new: Optional[dict], flags: List[str]
+) -> dict:
+    """Call-graph-stat / lock-graph / per-rule drift between two
+    flow_audit sections. Appends to `flags` in place."""
+    o_av = bool(isinstance(old, dict) and old.get("available"))
+    n_av = bool(isinstance(new, dict) and new.get("available"))
+    out: Dict[str, Any] = {"available": [o_av, n_av]}
+    if o_av and not n_av:
+        flags.append(
+            "flow_audit available in the old round but missing now — "
+            "the graftflow gate did not run before this bench"
+        )
+    if not (o_av and n_av):
+        return out
+    ocg, ncg = old.get("callgraph") or {}, new.get("callgraph") or {}
+    out["callgraph"] = {
+        k: [ocg.get(k), ncg.get(k)]
+        for k in ("nodes", "edges", "lock_sites", "unresolved_calls")
+    }
+    for stat in ("nodes", "edges", "lock_sites"):
+        o_n, n_n = int(ocg.get(stat) or 0), int(ncg.get(stat) or 0)
+        if o_n and n_n < o_n * 0.7:
+            flags.append(
+                f"flow_audit {stat} shrank {o_n} -> {n_n} — the call-graph "
+                "lost coverage; paths may have silently left the GF001 proof"
+            )
+    oe = {(e.get("from"), e.get("to")) for e in (old.get("lock_graph") or {}).get("edges") or []}
+    ne = {(e.get("from"), e.get("to")) for e in (new.get("lock_graph") or {}).get("edges") or []}
+    out["lock_graph"] = {
+        "edges": [len(oe), len(ne)],
+        "only_in_new": sorted(f"{a}->{b}" for a, b in ne - oe),
+        "only_in_old": sorted(f"{a}->{b}" for a, b in oe - ne),
+    }
+    if ne - oe:
+        flags.append(
+            f"{len(ne - oe)} new static lock-order edge(s) this round "
+            "(new acquires-while-holding paths — check them against the "
+            "declared hierarchy)"
+        )
+    orl, nrl = old.get("rules") or {}, new.get("rules") or {}
+    regressed = sorted(
+        rid for rid in set(orl) | set(nrl)
+        if str(orl.get(rid, "pass")) == "pass" and str(nrl.get(rid, "pass")) != "pass"
+    )
+    if regressed:
+        out["rule_regressions"] = regressed
+        flags.append(
+            f"flow_audit rule(s) flipped pass -> fail between rounds: "
+            f"{regressed}"
+        )
     return out
 
 

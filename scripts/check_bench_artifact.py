@@ -90,6 +90,11 @@ BUNDLE_SECTIONS_V9 = BUNDLE_SECTIONS_V8 + ("events",)
 # carrying a malformed audit would poison bench_diff --bundles drift
 # detection, so either `available: false` or a well-formed report.
 KERNEL_AUDIT_KEYS = ("schema", "kernels", "summary")
+# surrealdb-tpu-bundle/5 adds the graftflow flow_audit section, and for
+# /5 bundles it is MANDATORY with non-empty call-graph stats (nodes,
+# edges, lock sites resolved all > 0): an analyzer that silently found
+# nothing to analyze must make the artifact INVALID, not vacuously green.
+FLOW_AUDIT_STATS = ("nodes", "edges", "lock_sites")
 CLUSTER_OBS_KEYS = ("bundle", "slowest_profile", "live_nodes")
 COMPILES_KEYS = ("on_demand", "prewarm", "events")
 BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
@@ -134,6 +139,41 @@ def _check_kernel_audit(bundle: dict) -> List[str]:
                     f"bundle: kernel_audit kernel {name!r} shape "
                     f"{label!r} missing its hlo_sha256 digest"
                 )
+    return problems
+
+
+def _check_flow_audit(bundle: dict) -> List[str]:
+    """flow_audit (bundle/5+): structural whenever present; REQUIRED —
+    with non-empty call-graph stats — once the bundle declares schema /5
+    (section 11 is part of that schema's contract)."""
+    import re
+
+    m = re.match(r"surrealdb-tpu-bundle/(\d+)$", str(bundle.get("schema", "")))
+    strict = m is not None and int(m.group(1)) >= 5
+    fa = bundle.get("flow_audit")
+    if fa is None:
+        return ["bundle/5: missing the flow_audit section"] if strict else []
+    if not isinstance(fa, dict):
+        return ["bundle: flow_audit must be an object"]
+    if not fa.get("available"):
+        return (
+            ["bundle/5: flow_audit.available is false — the analyzer never ran"]
+            if strict
+            else []
+        )
+    cg = fa.get("callgraph")
+    if not isinstance(cg, dict):
+        return ["bundle: flow_audit missing its 'callgraph' stats object"]
+    problems = []
+    for key in FLOW_AUDIT_STATS:
+        n = cg.get(key)
+        if not isinstance(n, (int, float)) or n <= 0:
+            problems.append(
+                f"bundle: flow_audit.callgraph.{key} must be > 0 "
+                f"(got {n!r}) — a degraded analyzer is invalid, not green"
+            )
+    if not isinstance(fa.get("rules"), dict) or not fa["rules"]:
+        problems.append("bundle: flow_audit missing its per-rule results")
     return problems
 
 
@@ -182,6 +222,7 @@ def validate(path: str) -> List[str]:
                 if sec not in bundle:
                     problems.append(f"bundle: missing section {sec!r}")
             problems.extend(_check_kernel_audit(bundle))
+            problems.extend(_check_flow_audit(bundle))
     for key in ("scale", "configs", "results"):
         if key not in art:
             problems.append(f"missing top-level key {key!r}")

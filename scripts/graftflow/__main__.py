@@ -1,0 +1,149 @@
+"""graftflow CLI — `python -m scripts.graftflow [paths...]`.
+
+Exit codes: 0 = clean (every finding baselined, cross-check sound),
+1 = new findings or cross-check soundness gap, 2 = bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from scripts.graftlint.engine import repo_root
+
+from scripts.graftflow.report import default_baseline_path
+
+_BASELINE_COMMENT = (
+    "graftflow grandfathered findings: entries here do not fail the "
+    "run. Keys are line-number-free (rule + lock-edge names or "
+    "module-qualified symbols) so unrelated edits don't churn this "
+    "file. Shrink it; never grow it without a review."
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftflow",
+        description="whole-program interprocedural flow analysis for surrealdb_tpu",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to analyze (default: surrealdb_tpu/ at the repo root)",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON (default scripts/graftflow/baseline.json)",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline from current findings and exit 0",
+    )
+    ap.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--no-rules", action="store_true",
+        help="build the graph only (with --cross-check / --report)",
+    )
+    ap.add_argument(
+        "--cross-check", metavar="DUMP",
+        help="assert a SURREAL_SANITIZE_OUT dump's observed lock edges are "
+        "a subset of the static may-edge graph (soundness self-validation); "
+        "static-but-never-observed edges report as coverage gaps",
+    )
+    ap.add_argument(
+        "--report", default=None,
+        help="write the flow_audit JSON here (default: the "
+        "cnf.FLOW_AUDIT_REPORT path on a full-scope run)",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    from . import callgraph, crosscheck, report as report_mod, rules as rules_mod
+    from scripts.baselines import (
+        apply_baseline, load_baseline, write_baseline,
+    )
+
+    if args.list_rules:
+        for rid, (_fn, doc) in sorted(rules_mod.RULES.items()):
+            print(f"{rid}  {doc}")
+        return 0
+
+    full_scope = not args.paths and not args.rules
+    paths = args.paths or [os.path.join(repo_root(), "surrealdb_tpu")]
+    g = callgraph.build(paths)
+
+    rc = 0
+    findings = []
+    baselined = 0
+    if not args.no_rules:
+        rules = (
+            [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+            if args.rules
+            else None
+        )
+        findings = rules_mod.run_rules(g, rules=rules)
+        if args.update_baseline:
+            if not full_scope:
+                print(
+                    "error: --update-baseline requires the default full "
+                    "scope (no path arguments, no --rules) — a restricted "
+                    "run would silently drop every other grandfathered entry",
+                    file=sys.stderr,
+                )
+                return 2
+            path = write_baseline(
+                findings, args.baseline or default_baseline_path(),
+                _BASELINE_COMMENT,
+            )
+            print(f"baseline written: {path} ({len(findings)} findings)")
+            return 0
+        baseline = load_baseline(args.baseline or default_baseline_path())
+        new, stale = apply_baseline(findings, baseline)
+        for f in new:
+            print(f.render())
+        for k in stale:
+            print(f"warning: stale baseline entry (finding fixed — remove it): {k}")
+        baselined = len(findings) - len(new)
+        print(
+            f"graftflow: {len(g.functions)} function(s), "
+            f"{g.call_edges} call edge(s), {len(g.lock_sites)} lock site(s), "
+            f"{len(findings)} finding(s), {baselined} baselined, "
+            f"{len(new)} new"
+        )
+        if new:
+            rc = 1
+
+    if args.cross_check:
+        static = set(rules_mod.lock_edges(g))
+        errors, warnings, gaps = crosscheck.check_dump(
+            args.cross_check, static, set(g.lock_names)
+        )
+        for w in warnings:
+            print(f"cross-check warning: {w}")
+        for e in errors:
+            print(f"cross-check ERROR: {e}")
+        print(
+            f"cross-check: {len(errors)} error(s), {len(warnings)} "
+            f"warning(s), {len(gaps)} static edge(s) never observed "
+            f"(interleaving-coverage gaps) ({args.cross_check})"
+        )
+        if errors:
+            rc = 1
+
+    report_path = args.report
+    if report_path is None and full_scope and not args.no_rules:
+        from surrealdb_tpu import cnf
+
+        report_path = cnf.FLOW_AUDIT_REPORT
+    if report_path:
+        rep = report_mod.build_report(g, findings, baselined)
+        report_mod.write_report(rep, report_path)
+        print(f"flow_audit report: {report_path}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1339 @@
+"""Whole-program call graph + per-function flow summaries.
+
+The analysis substrate every GF rule runs on. Construction is a MAY
+analysis throughout — when a receiver's type cannot be proven, the
+resolver over-approximates (all project methods of that name, bounded),
+never under: GF001's cross-check contract is that the static edge graph
+is a SUPERSET of anything the runtime sanitizer can observe, so dropping
+a possible callee is the one unsound direction.
+
+Resolution layers, most to least precise:
+
+1. module-qualified direct calls (`mod.fn(...)`, `from mod import fn`),
+   including relative imports;
+2. `self.m(...)` through the enclosing class's project MRO, plus
+   overrides in project SUBCLASSES (virtual dispatch — a call through a
+   `BackendTransaction`-typed attribute may land in `MemTransaction`);
+3. class attribution: locals/attributes assigned from a project-class
+   constructor (or from another attributed attribute — bindings
+   propagate through `x.attr = self.other_attr` chains to a fixpoint);
+4. unique/bounded name matching for untyped receivers, behind a
+   deny-list of container/stdlib method names (`.get()`, `.items()`, …)
+   so dict traffic never aliases into engine methods.
+
+Thread hand-offs are first-class: a call to `bg.spawn/spawn_service/
+start_thread/timer` or a pool `.submit(...)` records a BOUNDARY edge to
+the callable argument (unwrapping the `contextvars.copy_context().run`
+idiom) — the body is analyzed as a root of its own thread, and held-lock
+sets never propagate across the boundary.
+
+Lock model: every `locks.Lock/RLock("name")` creation site is indexed
+(module global / class attribute / local), `with`-blocks and
+`.acquire()`/`.release()` pairs maintain a per-function may-held stack,
+and each function gets a summary of (held-set, acquisition) and
+(held-set, call) events — rules.gf001 turns those into the global
+acquires-while-holding edge graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from scripts.graftlint.engine import Module, collect_modules, repo_root
+
+_SUPPRESS_RE = re.compile(r"#\s*graftflow:\s*disable(-file)?=([A-Za-z0-9_,]+)")
+
+# untyped-receiver name matching never resolves these (container/stdlib
+# protocol names — a `d.get(k)` must not alias into an engine method)
+AMBIG_DENY = frozenset(
+    {
+        "get", "set", "put", "add", "pop", "keys", "values", "items",
+        "append", "extend", "update", "clear", "copy", "remove", "insert",
+        "sort", "index", "count", "join", "split", "strip", "encode",
+        "decode", "format", "read", "write", "open", "close", "send",
+        "recv", "wait", "notify", "notify_all", "acquire", "release",
+        "start", "stop", "cancel", "result", "done", "reset", "flush",
+        "setdefault", "discard", "union", "intersection", "match",
+        "search", "sub", "findall", "group", "groups", "exists", "delete",
+        "name", "warning", "error", "debug", "save",
+    }
+)
+# untyped-receiver name matching resolves only when the candidate set is
+# tiny: 2-3 same-name methods are usually one interface's implementations
+# (Transaction.batch / BackendTransaction.batch); more is guessing across
+# abstraction layers (`.commit()` has 4 — engine txn, abstract backend,
+# mem, file — and merging them poisons every transitive may-set above)
+AMBIG_CAP = 3
+
+# thread-spawn indirection: callee name -> index of the callable argument
+SPAWN_CALLABLE_ARG = {
+    "spawn": 2,          # bg.spawn(kind, target, fn, *args)
+    "spawn_service": 2,  # bg.spawn_service(kind, target, fn, *args)
+    "start_thread": 1,   # bg.start_thread(task_id, fn, *args)
+    "timer": 1,          # bg.timer(delay, fn, *args)
+}
+
+LOCKS_MODULE = "surrealdb_tpu.utils.locks"
+LOCKS_ALIASES = ("locks", "_locks")
+
+# tracing/telemetry surface that READS the request contextvars (GF002):
+# a spawned body reaching any of these without propagation orphans spans
+CONTEXT_READERS = frozenset(
+    {
+        "surrealdb_tpu.tracing.current",
+        "surrealdb_tpu.tracing.current_trace_id",
+        "surrealdb_tpu.tracing.annotate",
+        "surrealdb_tpu.tracing.annotate_append",
+        "surrealdb_tpu.tracing.push",
+        "surrealdb_tpu.tracing.pop",
+        "surrealdb_tpu.tracing.export_spans",
+        "surrealdb_tpu.telemetry.span",
+        "surrealdb_tpu.telemetry.trace_annotation",
+    }
+)
+
+HOST_SYNC_ATTRS = frozenset({"block_until_ready", "device_get", "tolist"})
+HOST_SYNC_NP = frozenset({"asarray", "array"})
+HOST_SYNC_NP_NAMES = frozenset({"np", "numpy", "onp", "jnp"})
+
+
+# ------------------------------------------------------------------ entities
+@dataclass
+class FuncInfo:
+    qualname: str  # module-qualified dotted name (Class.method, fn.inner)
+    module: str
+    rel: str
+    name: str
+    node: ast.AST  # FunctionDef / AsyncFunctionDef / Lambda
+    cls: Optional["ClassInfo"] = None
+    parent: Optional["FuncInfo"] = None  # lexical parent for closures
+    lineno: int = 0
+    # summaries (filled by _analyze_bodies)
+    acquires: List[tuple] = field(default_factory=list)  # (name, line, held)
+    calls: List[tuple] = field(default_factory=list)  # (targets, line, held, boundary, propagated)
+    blocking: List[tuple] = field(default_factory=list)  # (kind, detail, line)
+    reads_context: bool = False
+    spawn_sites: List[tuple] = field(default_factory=list)  # (line, bodies, propagated, kind)
+    tx_sites: List[tuple] = field(default_factory=list)  # (var, line, finished, escaped, passes)
+    param_names: List[str] = field(default_factory=list)
+    # GF003 param summary (fixed point): params this fn finishes/escapes
+    finishes_params: Set[str] = field(default_factory=set)
+    escapes_params: Set[str] = field(default_factory=set)
+    passes_params: List[tuple] = field(default_factory=list)  # (param, targets, arg_idx)
+    # one-level return-type inference: class qualnames this fn returns
+    ret_classes: Set[str] = field(default_factory=set)
+    # closure: rules traversal
+    may_acquire: Set[str] = field(default_factory=set)
+    may_read_context: bool = False
+
+
+@dataclass
+class ClassInfo:
+    qualname: str
+    module: str
+    rel: str
+    node: ast.ClassDef
+    base_exprs: List[ast.AST] = field(default_factory=list)
+    bases: List["ClassInfo"] = field(default_factory=list)  # resolved, project-only
+    subclasses: List["ClassInfo"] = field(default_factory=list)
+    methods: Dict[str, FuncInfo] = field(default_factory=dict)
+    attr_locks: Dict[str, Set[str]] = field(default_factory=dict)
+    attr_types: Dict[str, Set[str]] = field(default_factory=dict)  # attr -> class qualnames
+
+    def mro(self) -> List["ClassInfo"]:
+        out, seen = [], set()
+        stack = [self]
+        while stack:
+            c = stack.pop(0)
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            out.append(c)
+            stack.extend(c.bases)
+        return out
+
+    def all_subclasses(self) -> List["ClassInfo"]:
+        out, seen = [], set()
+        stack = list(self.subclasses)
+        while stack:
+            c = stack.pop()
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            out.append(c)
+            stack.extend(c.subclasses)
+        return out
+
+
+@dataclass
+class LockSite:
+    name: Optional[str]  # None = dynamic
+    kind: str  # "Lock" | "RLock"
+    rel: str
+    line: int
+    binding: str  # "global:<mod>.<var>" | "attr:<Class>.<attr>" | "local:<fn>.<var>" | "anon"
+
+
+class ModuleInfo:
+    def __init__(self, m: Module, modname: str):
+        self.m = m
+        self.name = modname
+        self.rel = m.rel
+        # alias -> ("module", dotted) | ("symbol", dotted)
+        self.imports: Dict[str, Tuple[str, str]] = {}
+        self.functions: Dict[str, FuncInfo] = {}  # top-level only
+        self.classes: Dict[str, ClassInfo] = {}  # top-level only
+        self.global_locks: Dict[str, Set[str]] = {}  # global var -> lock names
+        # graftflow suppressions (separate namespace from graftlint's)
+        self.suppressed: Dict[int, set] = {}
+        self.file_suppressed: set = set()
+        for i, ln in enumerate(m.lines, start=1):
+            sm = _SUPPRESS_RE.search(ln)
+            if not sm:
+                continue
+            rules = {r.strip().upper() for r in sm.group(2).split(",") if r.strip()}
+            if sm.group(1):
+                self.file_suppressed |= rules
+            elif ln.lstrip().startswith("#"):
+                self.suppressed.setdefault(i + 1, set()).update(rules)
+            else:
+                self.suppressed.setdefault(i, set()).update(rules)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressed:
+            return True
+        return rule in self.suppressed.get(line, ())
+
+
+# ------------------------------------------------------------------ graph
+class Graph:
+    """The whole-program index + per-function summaries."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleInfo] = {}  # dotted name -> info
+        self.functions: Dict[str, FuncInfo] = {}  # qualname -> info
+        self.classes: Dict[str, ClassInfo] = {}
+        self.by_method_name: Dict[str, List[FuncInfo]] = {}
+        self.lock_sites: List[LockSite] = []
+        self.lock_names: Set[str] = set()
+        self.rlock_names: Set[str] = set()
+        self.attr_locks: Dict[str, Set[str]] = {}  # attr name -> lock names (global)
+        self.attr_types: Dict[str, Set[str]] = {}  # attr name -> class qualnames (global)
+        self.unresolved_calls: int = 0
+        self.call_edges: int = 0
+        self.boundary_edges: int = 0
+
+    # -------------------------------------------------------------- lookup
+    def module_by_tail(self, dotted: str) -> Optional[ModuleInfo]:
+        mi = self.modules.get(dotted)
+        if mi is not None:
+            return mi
+        for name, info in self.modules.items():
+            if name.endswith("." + dotted):
+                return info
+        return None
+
+    def import_module(self, mi: "ModuleInfo", alias: str) -> Optional[str]:
+        """The dotted module an import alias denotes — `import x.y as z`
+        AND `from pkg import submod` both count (a "symbol" import whose
+        target is itself a project module is a module alias)."""
+        ent = mi.imports.get(alias)
+        if ent is None:
+            return None
+        kind, dotted = ent
+        if kind == "module":
+            return dotted
+        if self.module_by_tail(dotted) is not None:
+            return dotted
+        return None
+
+    def func_of(self, module: str, symbol: str) -> Optional[FuncInfo]:
+        mi = self.module_by_tail(module)
+        if mi is None:
+            return None
+        f = mi.functions.get(symbol)
+        if f is not None:
+            return f
+        c = mi.classes.get(symbol)
+        if c is not None:
+            return c.methods.get("__init__")
+        return None
+
+    def class_of(self, module: str, symbol: str) -> Optional[ClassInfo]:
+        mi = self.module_by_tail(module)
+        return mi.classes.get(symbol) if mi is not None else None
+
+    def methods_named(self, name: str) -> List[FuncInfo]:
+        return self.by_method_name.get(name, [])
+
+    def rel_module(self, rel: str) -> Optional[ModuleInfo]:
+        for mi in self.modules.values():
+            if mi.rel == rel:
+                return mi
+        return None
+
+
+def _module_name(rel: str) -> str:
+    name = rel[:-3] if rel.endswith(".py") else rel
+    parts = name.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(p for p in parts if p)
+
+
+def build(paths: Sequence[str], root: Optional[str] = None) -> Graph:
+    """Parse + index + summarize every module under `paths`."""
+    modules = collect_modules(list(paths), root=root or repo_root())
+    g = Graph()
+    infos: List[ModuleInfo] = []
+    for m in modules:
+        if getattr(m, "syntax_error", None) is not None:
+            continue
+        mi = ModuleInfo(m, _module_name(m.rel))
+        g.modules[mi.name] = mi
+        infos.append(mi)
+    for mi in infos:
+        _index_imports(mi)
+        _index_defs(g, mi)
+    _resolve_bases(g)
+    _infer_return_types(g)
+    for mi in infos:
+        _index_lock_creations(g, mi)
+    _propagate_attr_bindings(g)
+    for mi in infos:
+        _analyze_bodies(g, mi)
+    _fixpoints(g)
+    return g
+
+
+# ------------------------------------------------------------------ indexing
+def _index_imports(mi: ModuleInfo) -> None:
+    pkg_parts = mi.name.split(".")
+    for node in ast.walk(mi.m.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                alias = a.asname or a.name.split(".")[0]
+                target = a.name if a.asname else a.name.split(".")[0]
+                mi.imports[alias] = ("module", target)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = pkg_parts[: len(pkg_parts) - node.level]
+                mod = ".".join(base + ([node.module] if node.module else []))
+            else:
+                mod = node.module or ""
+            for a in node.names:
+                alias = a.asname or a.name
+                if not mod:
+                    continue
+                mi.imports[alias] = ("symbol", f"{mod}.{a.name}")
+
+
+def _index_defs(g: Graph, mi: ModuleInfo) -> None:
+    def walk(body, prefix: str, cls: Optional[ClassInfo], parent: Optional[FuncInfo]):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{node.name}" if prefix else node.name
+                fi = FuncInfo(
+                    qualname=f"{mi.name}.{qual}",
+                    module=mi.name,
+                    rel=mi.rel,
+                    name=node.name,
+                    node=node,
+                    cls=cls,
+                    parent=parent,
+                    lineno=node.lineno,
+                )
+                fi.param_names = [a.arg for a in node.args.args]
+                g.functions[fi.qualname] = fi
+                if cls is not None and parent is None:
+                    cls.methods[node.name] = fi
+                    g.by_method_name.setdefault(node.name, []).append(fi)
+                elif cls is None and parent is None:
+                    mi.functions[node.name] = fi
+                walk(node.body, qual, cls, fi)
+            elif isinstance(node, ast.ClassDef):
+                qual = f"{prefix}.{node.name}" if prefix else node.name
+                ci = ClassInfo(
+                    qualname=f"{mi.name}.{qual}",
+                    module=mi.name,
+                    rel=mi.rel,
+                    node=node,
+                    base_exprs=list(node.bases),
+                )
+                g.classes[ci.qualname] = ci
+                if parent is None and cls is None:
+                    mi.classes[node.name] = ci
+                walk(node.body, qual, ci, None)
+            elif isinstance(node, (ast.If, ast.Try)):
+                # defs behind TYPE_CHECKING / fallback guards still count
+                for sub in ast.iter_child_nodes(node):
+                    if hasattr(sub, "body") and isinstance(
+                        getattr(sub, "body", None), list
+                    ):
+                        walk(sub.body, prefix, cls, parent)
+
+    walk(mi.m.tree.body, "", None, None)
+
+
+def _resolve_bases(g: Graph) -> None:
+    for ci in g.classes.values():
+        mi = g.modules.get(ci.module)
+        if mi is None:
+            continue
+        for b in ci.base_exprs:
+            target = None
+            if isinstance(b, ast.Name):
+                target = _resolve_symbol_class(g, mi, b.id)
+            elif isinstance(b, ast.Attribute) and isinstance(b.value, ast.Name):
+                mod = g.import_module(mi, b.value.id)
+                if mod is not None:
+                    target = g.class_of(mod, b.attr)
+            if target is not None:
+                ci.bases.append(target)
+                target.subclasses.append(ci)
+
+
+def _infer_return_types(g: Graph) -> None:
+    """One level of return-type inference: a function whose `return`
+    statements construct project classes types its callers' bindings
+    (`txn = ds.transaction(...)` -> Transaction)."""
+    for fi in g.functions.values():
+        mi = g.modules.get(fi.module)
+        if mi is None or isinstance(fi.node, ast.Lambda):
+            continue
+        ann = getattr(fi.node, "returns", None)
+        ci = None
+        if isinstance(ann, ast.Name):
+            ci = _resolve_symbol_class(g, mi, ann.id)
+        elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            ci = _resolve_symbol_class(g, mi, ann.value.strip('"'))
+        if ci is not None:
+            fi.ret_classes.add(ci.qualname)
+        for sub in _walk_shallow(fi.node):
+            if isinstance(sub, ast.Return) and isinstance(sub.value, ast.Call):
+                ci = _ctor_class(g, mi, sub.value)
+                if ci is not None:
+                    fi.ret_classes.add(ci.qualname)
+
+
+def _callee_for_typing(g: Graph, mi: ModuleInfo, call: ast.Call) -> Optional[FuncInfo]:
+    """Resolve a call's target for TYPE inference only (deny-list-free
+    unique-name matching is safe here: it can only yield class names)."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        if f.id in mi.functions:
+            return mi.functions[f.id]
+        ent = mi.imports.get(f.id)
+        if ent is not None and ent[0] == "symbol":
+            mod, _, sym = ent[1].rpartition(".")
+            return g.func_of(mod, sym)
+        return None
+    if isinstance(f, ast.Attribute):
+        if isinstance(f.value, ast.Name):
+            mod = g.import_module(mi, f.value.id)
+            if mod is not None:
+                return g.func_of(mod, f.attr)
+        cands = g.methods_named(f.attr)
+        if len(cands) == 1:
+            return cands[0]
+    return None
+
+
+def _resolve_symbol_class(g: Graph, mi: ModuleInfo, name: str) -> Optional[ClassInfo]:
+    if name in mi.classes:
+        return mi.classes[name]
+    ent = mi.imports.get(name)
+    if ent is None:
+        return None
+    kind, dotted = ent
+    if kind == "symbol":
+        mod, _, sym = dotted.rpartition(".")
+        return g.class_of(mod, sym)
+    return None
+
+
+def _assign_parts(node: ast.AST):
+    """(targets, value) for Assign AND AnnAssign-with-value — annotated
+    assignments (`self._lock: object = locks.Lock(...)`) must not drop
+    bindings from the MAY analysis."""
+    if isinstance(node, ast.Assign):
+        return node.targets, node.value
+    if isinstance(node, ast.AnnAssign) and node.value is not None:
+        return [node.target], node.value
+    return None
+
+
+# ------------------------------------------------------------------ locks
+def _lock_creation(mi: ModuleInfo, node: ast.AST) -> Optional[Tuple[Optional[str], str]]:
+    """(lock name or None-if-dynamic, 'Lock'|'RLock') when `node` is a
+    `locks.Lock/RLock(...)` call, else None."""
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+        return None
+    if node.func.attr not in ("Lock", "RLock"):
+        return None
+    recv = node.func.value
+    if not isinstance(recv, ast.Name):
+        return None
+    ent = mi.imports.get(recv.id)
+    is_locks = recv.id in LOCKS_ALIASES
+    if ent is not None:
+        kind, dotted = ent
+        is_locks = dotted == LOCKS_MODULE or dotted.endswith(".locks") or is_locks
+    if not is_locks:
+        return None
+    a0 = node.args[0] if node.args else None
+    if a0 is None:
+        for kw in node.keywords:
+            if kw.arg == "name":
+                a0 = kw.value
+    if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+        return a0.value, node.func.attr
+    return None, node.func.attr
+
+
+def _find_lock_in(mi: ModuleInfo, expr: ast.AST) -> Optional[Tuple[Optional[str], str, ast.AST]]:
+    for sub in ast.walk(expr):
+        hit = _lock_creation(mi, sub)
+        if hit is not None:
+            return hit[0], hit[1], sub
+    return None
+
+
+def _index_lock_creations(g: Graph, mi: ModuleInfo) -> None:
+    """Creation sites + their bindings (module global / class attr / local)."""
+
+    def note(name, kind, line, binding):
+        g.lock_sites.append(LockSite(name, kind, mi.rel, line, binding))
+        if name is not None:
+            g.lock_names.add(name)
+            if kind == "RLock":
+                g.rlock_names.add(name)
+
+    def scan(body, scope: str, cls: Optional[ClassInfo], fn: Optional[FuncInfo]):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sub_fn = g.functions.get(
+                    f"{mi.name}.{scope}.{node.name}" if scope else f"{mi.name}.{node.name}"
+                )
+                scan(node.body, f"{scope}.{node.name}" if scope else node.name, cls, sub_fn)
+                continue
+            if isinstance(node, ast.ClassDef):
+                ci = g.classes.get(
+                    f"{mi.name}.{scope}.{node.name}" if scope else f"{mi.name}.{node.name}"
+                )
+                scan(node.body, f"{scope}.{node.name}" if scope else node.name, ci, None)
+                continue
+            parts = _assign_parts(node)
+            if parts is not None:
+                targets_, value_ = parts
+                hit = _find_lock_in(mi, value_)
+                if hit is not None:
+                    name, kind, call = hit
+                    for t in targets_:
+                        if isinstance(t, ast.Name):
+                            if fn is None and cls is None:
+                                mi.global_locks.setdefault(t.id, set())
+                                if name is not None:
+                                    mi.global_locks[t.id].add(name)
+                                note(name, kind, call.lineno, f"global:{mi.name}.{t.id}")
+                            else:
+                                note(name, kind, call.lineno, f"local:{scope}.{t.id}")
+                        elif isinstance(t, ast.Attribute):
+                            owner = cls
+                            if (
+                                isinstance(t.value, ast.Name)
+                                and t.value.id == "self"
+                                and fn is not None
+                                and fn.cls is not None
+                            ):
+                                owner = fn.cls
+                            if owner is not None:
+                                owner.attr_locks.setdefault(t.attr, set())
+                                if name is not None:
+                                    owner.attr_locks[t.attr].add(name)
+                                note(name, kind, call.lineno, f"attr:{owner.qualname}.{t.attr}")
+                            else:
+                                note(name, kind, call.lineno, "anon")
+                            if name is not None:
+                                g.attr_locks.setdefault(t.attr, set()).add(name)
+                        else:
+                            note(name, kind, call.lineno, "anon")
+                    continue
+            # recurse into compound statements (if/try/with/for bodies)
+            for attr in ("body", "orelse", "finalbody", "handlers"):
+                sub_body = getattr(node, attr, None)
+                if isinstance(sub_body, list):
+                    stmts = []
+                    for s in sub_body:
+                        if isinstance(s, ast.ExceptHandler):
+                            stmts.extend(s.body)
+                        elif isinstance(s, ast.stmt):
+                            stmts.append(s)
+                    if stmts:
+                        scan(stmts, scope, cls, fn)
+            # bare (unassigned) creation inside an expression statement
+            if isinstance(node, ast.Expr):
+                hit = _find_lock_in(mi, node.value)
+                if hit is not None:
+                    note(hit[0], hit[1], hit[2].lineno, "anon")
+
+    scan(mi.m.tree.body, "", None, None)
+    # seed class attr_locks into the class-agnostic map too
+    for ci in mi.classes.values():
+        for attr, names in ci.attr_locks.items():
+            g.attr_locks.setdefault(attr, set()).update(names)
+
+
+def _param_ann_types(g: Graph, mi: ModuleInfo, fi: FuncInfo) -> Dict[str, Set[str]]:
+    """Param name -> class qualnames, from annotations (incl. string
+    annotations). `self.tr = backend` with `backend: BackendTransaction`
+    is how the kvs layer's virtual dispatch gets attributed."""
+    out: Dict[str, Set[str]] = {}
+    args = getattr(fi.node, "args", None)
+    if args is None:
+        return out
+    for a in list(args.args) + list(args.kwonlyargs):
+        ann = a.annotation
+        ci = None
+        if isinstance(ann, ast.Name):
+            ci = _resolve_symbol_class(g, mi, ann.id)
+        elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            ci = _resolve_symbol_class(g, mi, ann.value)
+        if ci is not None:
+            out.setdefault(a.arg, set()).add(ci.qualname)
+    return out
+
+
+def _propagate_attr_bindings(g: Graph) -> None:
+    """`x.attr = <expr>` chains: when the RHS resolves to known lock names
+    or class types (a constructor, an annotated parameter, `self.other`,
+    a typed function's return, or another attributed attribute), the LHS
+    attribute inherits them — iterated to a fixpoint so
+    `txn._commit_lock = self.commit_lock` style hand-offs resolve."""
+    for _ in range(4):
+        changed = False
+        for fi in list(g.functions.values()):
+            mi = g.modules.get(fi.module)
+            if mi is None or isinstance(fi.node, ast.Lambda):
+                continue
+            params = _param_ann_types(g, mi, fi)
+            for node in _walk_shallow(fi.node):
+                parts = _assign_parts(node)
+                if parts is None:
+                    continue
+                targets_, value_ = parts
+                for t in targets_:
+                    if not isinstance(t, ast.Attribute):
+                        continue
+                    names = _attr_expr_locks(g, mi, value_)
+                    if names:
+                        cur = g.attr_locks.setdefault(t.attr, set())
+                        if not names <= cur:
+                            cur |= names
+                            changed = True
+                    types = _attr_expr_types(g, mi, value_, params)
+                    if types:
+                        cur = g.attr_types.setdefault(t.attr, set())
+                        if not types <= cur:
+                            cur |= types
+                            changed = True
+        if not changed:
+            break
+
+
+def _attr_expr_locks(g: Graph, mi: ModuleInfo, expr: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Attribute) and sub.attr in g.attr_locks:
+            out |= g.attr_locks[sub.attr]
+        elif isinstance(sub, ast.Name) and sub.id in mi.global_locks:
+            out |= mi.global_locks[sub.id]
+    hit = _find_lock_in(mi, expr)
+    if hit is not None and hit[0] is not None:
+        out.add(hit[0])
+    return out
+
+
+def _attr_expr_types(
+    g: Graph, mi: ModuleInfo, expr: ast.AST,
+    params: Optional[Dict[str, Set[str]]] = None,
+) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            ci = _ctor_class(g, mi, sub)
+            if ci is not None:
+                out.add(ci.qualname)
+            elif (
+                isinstance(sub.func, ast.Name)
+                and sub.func.id == "ThreadPoolExecutor"
+                or (
+                    isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "ThreadPoolExecutor"
+                )
+            ):
+                out.add("ThreadPoolExecutor")
+            else:
+                callee = _callee_for_typing(g, mi, sub)
+                if callee is not None:
+                    out |= callee.ret_classes
+        elif isinstance(sub, ast.Attribute) and sub.attr in g.attr_types:
+            out |= g.attr_types[sub.attr]
+        elif isinstance(sub, ast.Name) and params and sub.id in params:
+            out |= params[sub.id]
+    return out
+
+
+def _ctor_class(g: Graph, mi: ModuleInfo, call: ast.Call) -> Optional[ClassInfo]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return _resolve_symbol_class(g, mi, f.id)
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        mod = g.import_module(mi, f.value.id)
+        if mod is not None:
+            return g.class_of(mod, f.attr)
+    return None
+
+
+# ------------------------------------------------------------------ body analysis
+class _FnScope:
+    """Flow-insensitive local maps for one function (+ lexical parents)."""
+
+    def __init__(self, g: Graph, mi: ModuleInfo, fi: FuncInfo):
+        self.g = g
+        self.mi = mi
+        self.fi = fi
+        self.local_locks: Dict[str, Set[str]] = {}
+        self.local_types: Dict[str, Set[str]] = {}
+        node = fi.node
+        # parameter annotations
+        args = getattr(node, "args", None)
+        if args is not None:
+            for a in list(args.args) + list(args.kwonlyargs):
+                ann = a.annotation
+                ci = None
+                if isinstance(ann, ast.Name):
+                    ci = _resolve_symbol_class(g, mi, ann.id)
+                elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                    ci = _resolve_symbol_class(g, mi, ann.value)
+                if ci is not None:
+                    self.local_types.setdefault(a.arg, set()).add(ci.qualname)
+        # assignments (skip nested function bodies — they get their own scope)
+        params = {k: set(v) for k, v in self.local_types.items()}
+        for sub in _walk_shallow(node):
+            parts = _assign_parts(sub)
+            if parts is None:
+                continue
+            sub_targets, sub_value = parts
+            names = _attr_expr_locks(g, mi, sub_value)
+            types = _attr_expr_types(g, mi, sub_value, params)
+            for t in sub_targets:
+                if isinstance(t, ast.Name):
+                    if names:
+                        self.local_locks.setdefault(t.id, set()).update(names)
+                    if types:
+                        self.local_types.setdefault(t.id, set()).update(types)
+
+    def lock_names_of(self, expr: ast.AST) -> Set[str]:
+        """Lock names an acquisition expression may denote."""
+        g, mi = self.g, self.mi
+        if isinstance(expr, ast.Name):
+            scope: Optional[_FnScope] = self
+            fi = self.fi
+            while fi is not None:
+                sc = scope if fi is self.fi else _FnScope(g, mi, fi)
+                if expr.id in sc.local_locks:
+                    return set(sc.local_locks[expr.id])
+                fi = fi.parent
+                scope = None
+            if expr.id in mi.global_locks:
+                return set(mi.global_locks[expr.id])
+            return set()
+        if isinstance(expr, ast.Attribute):
+            # self.attr through the class MRO first
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                cls = _enclosing_class(self.fi)
+                if cls is not None:
+                    for c in cls.mro():
+                        if expr.attr in c.attr_locks and c.attr_locks[expr.attr]:
+                            return set(c.attr_locks[expr.attr])
+            # typed receiver
+            for ci in self._types_of(expr.value):
+                if isinstance(ci, ClassInfo):
+                    for c in ci.mro():
+                        if expr.attr in c.attr_locks and c.attr_locks[expr.attr]:
+                            return set(c.attr_locks[expr.attr])
+            # class-agnostic attribute fallback (may-alias union)
+            if expr.attr in g.attr_locks:
+                return set(g.attr_locks[expr.attr])
+        return set()
+
+    def _types_of(self, expr: ast.AST) -> List[object]:
+        g, mi = self.g, self.mi
+        out: List[object] = []
+        quals: Set[str] = set()
+        if isinstance(expr, ast.Name):
+            scope: Optional[_FnScope] = self
+            fi = self.fi
+            while fi is not None:
+                sc = scope if fi is self.fi else _FnScope(g, mi, fi)
+                if expr.id in sc.local_types:
+                    quals |= sc.local_types[expr.id]
+                    break
+                fi = fi.parent
+                scope = None
+        elif isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                cls = _enclosing_class(self.fi)
+                if cls is not None:
+                    for c in cls.mro():
+                        if expr.attr in c.attr_types:
+                            quals |= c.attr_types[expr.attr]
+            if not quals and expr.attr in g.attr_types:
+                quals |= g.attr_types[expr.attr]
+        elif isinstance(expr, ast.Call):
+            ci = _ctor_class(g, mi, expr)
+            if ci is not None:
+                quals.add(ci.qualname)
+        for q in quals:
+            if q == "ThreadPoolExecutor":
+                out.append("ThreadPoolExecutor")
+            else:
+                ci = g.classes.get(q)
+                if ci is not None:
+                    out.append(ci)
+        return out
+
+
+def _enclosing_class(fi: FuncInfo) -> Optional[ClassInfo]:
+    f: Optional[FuncInfo] = fi
+    while f is not None:
+        if f.cls is not None:
+            return f.cls
+        f = f.parent
+    return None
+
+
+def _walk_shallow(fn_node: ast.AST):
+    """Walk a function body WITHOUT descending into nested function/class
+    definitions (those are separate scopes)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _analyze_bodies(g: Graph, mi: ModuleInfo) -> None:
+    for fi in list(g.functions.values()):
+        if fi.module != mi.name:
+            continue
+        _analyze_fn(g, mi, fi)
+
+
+def _analyze_fn(g: Graph, mi: ModuleInfo, fi: FuncInfo) -> None:
+    scope = _FnScope(g, mi, fi)
+    held: List[str] = []
+    # call resolution memo (id(node) -> (targets, boundary)) shared with
+    # _analyze_tx — resolution is the dominant cost of the build and every
+    # Call node would otherwise be resolved twice
+    resolved: Dict[int, tuple] = {}
+
+    def record_acquire(names: Set[str], line: int) -> List[str]:
+        acquired = []
+        for n in sorted(names):
+            fi.acquires.append((n, line, tuple(held)))
+            acquired.append(n)
+        held.extend(acquired)
+        return acquired
+
+    def pop_names(names: Set[str]) -> None:
+        for n in names:
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == n:
+                    del held[i]
+                    break
+
+    def visit_call(node: ast.Call) -> None:
+        # lock creation is data, not control flow
+        if _lock_creation(mi, node) is not None:
+            return
+        # acquire()/release() on a lock-resolvable receiver
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in ("acquire", "release"):
+            names = scope.lock_names_of(f.value)
+            if names:
+                if f.attr == "acquire":
+                    record_acquire(names, node.lineno)
+                else:
+                    pop_names(names)
+                return
+        targets, boundary, propagated, spawn_kind = _resolve_call(g, mi, scope, node)
+        resolved[id(node)] = (targets, boundary)
+        if targets:
+            fi.calls.append(
+                (tuple(t.qualname for t in targets), node.lineno, tuple(held),
+                 boundary, propagated)
+            )
+            if boundary:
+                g.boundary_edges += len(targets)
+                fi.spawn_sites.append(
+                    (node.lineno, tuple(t.qualname for t in targets), propagated,
+                     spawn_kind)
+                )
+            else:
+                g.call_edges += len(targets)
+        elif boundary:
+            # a spawn whose body we cannot resolve still counts as a site
+            fi.spawn_sites.append((node.lineno, (), propagated, spawn_kind))
+        elif isinstance(node.func, (ast.Attribute, ast.Name)):
+            g.unresolved_calls += 1
+        if boundary and spawn_kind.startswith("bg."):
+            # the spawn HELPER itself runs on the calling thread — its
+            # registry bookkeeping (bg.registry etc.) happens under
+            # whatever the caller holds, unlike the spawned body
+            qn = _qualified_target(g, mi, node)
+            if qn is not None:
+                mod, _, sym = qn.rpartition(".")
+                helper = g.func_of(mod, sym)
+                if helper is not None:
+                    fi.calls.append(
+                        ((helper.qualname,), node.lineno, tuple(held),
+                         False, False)
+                    )
+                    g.call_edges += 1
+        # blocking-op classification (GF004 raw material)
+        recv, attr = _recv_attr(node)
+        if attr in HOST_SYNC_ATTRS:
+            fi.blocking.append(("host_sync", attr, node.lineno))
+        elif attr in HOST_SYNC_NP and recv in HOST_SYNC_NP_NAMES:
+            fi.blocking.append(("host_sync", f"{recv}.{attr}", node.lineno))
+        elif attr == "sleep" and recv in ("time", "_time"):
+            fi.blocking.append(("sleep", "time.sleep", node.lineno))
+        elif recv is None and attr == "sleep":
+            ent = mi.imports.get("sleep")
+            if ent is not None and ent[1] == "time.sleep":
+                fi.blocking.append(("sleep", "time.sleep", node.lineno))
+        # context-reader classification (GF002 raw material)
+        qn = _qualified_target(g, mi, node)
+        if qn in CONTEXT_READERS:
+            fi.reads_context = True
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # separate scope (indexed already)
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    if isinstance(sub, ast.Call):
+                        visit_call(sub)
+                names = scope.lock_names_of(item.context_expr)
+                if names:
+                    acquired.extend(record_acquire(names, item.context_expr.lineno))
+            for st in node.body:
+                visit(st)
+            for n in reversed(acquired):
+                pop_names({n})
+            return
+        if isinstance(node, ast.Call):
+            visit_call(node)
+            for sub in ast.iter_child_nodes(node):
+                visit(sub)
+            return
+        for sub in ast.iter_child_nodes(node):
+            visit(sub)
+
+    body = getattr(fi.node, "body", None)
+    if isinstance(body, list):
+        for st in body:
+            visit(st)
+    elif body is not None:  # Lambda
+        visit(body)
+    _analyze_tx(g, mi, scope, fi, resolved)
+
+
+def _recv_attr(node: ast.Call) -> Tuple[Optional[str], str]:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        recv = f.value.id if isinstance(f.value, ast.Name) else None
+        return recv, f.attr
+    if isinstance(f, ast.Name):
+        return None, f.id
+    return None, ""
+
+
+def _qualified_target(g: Graph, mi: ModuleInfo, node: ast.Call) -> Optional[str]:
+    """Fully-qualified dotted name of a `mod.attr(...)` / imported-symbol
+    call, resolved through this module's imports (no project lookup)."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        mod = g.import_module(mi, f.value.id)
+        if mod is not None:
+            return f"{mod}.{f.attr}"
+        if f.value.id in ("tracing", "telemetry"):
+            return f"surrealdb_tpu.{f.value.id}.{f.attr}"
+    elif isinstance(f, ast.Name):
+        ent = mi.imports.get(f.id)
+        if ent is not None and ent[0] == "symbol":
+            return ent[1]
+    return None
+
+
+def _is_copy_context_run(expr: ast.AST) -> bool:
+    """`contextvars.copy_context().run` / `<ctx>.run` where ctx came from
+    copy_context() — the explicit-propagation idiom."""
+    if not (isinstance(expr, ast.Attribute) and expr.attr == "run"):
+        return False
+    v = expr.value
+    if isinstance(v, ast.Call):
+        _, attr = _recv_attr(v)
+        return attr == "copy_context"
+    return False
+
+
+def _resolve_callable(
+    g: Graph, mi: ModuleInfo, scope: _FnScope, expr: ast.AST
+) -> List[FuncInfo]:
+    """Resolve a callable ARGUMENT (spawn bodies, ctx.run targets)."""
+    if isinstance(expr, ast.Name):
+        t = _lookup_name(g, mi, scope, expr.id)
+        return [t] if t is not None else []
+    if isinstance(expr, ast.Attribute):
+        fake = ast.Call(func=expr, args=[], keywords=[])
+        ast.copy_location(fake, expr)
+        targets, _, _, _ = _resolve_call(g, mi, scope, fake, callable_ref=True)
+        return list(targets)
+    if isinstance(expr, ast.Lambda):
+        qual = f"{scope.fi.qualname}.<lambda>L{expr.lineno}"
+        fi = g.functions.get(qual)
+        if fi is None:
+            fi = FuncInfo(
+                qualname=qual, module=mi.name, rel=mi.rel, name="<lambda>",
+                node=expr, cls=scope.fi.cls, parent=scope.fi, lineno=expr.lineno,
+            )
+            g.functions[qual] = fi
+            _analyze_fn(g, mi, fi)
+        return [fi]
+    return []
+
+
+def _lookup_name(g: Graph, mi: ModuleInfo, scope: _FnScope, name: str) -> Optional[FuncInfo]:
+    # nested defs (lexical scope chain), then module functions, then imports
+    fi = scope.fi
+    while fi is not None:
+        cand = g.functions.get(f"{fi.qualname}.{name}")
+        if cand is not None:
+            return cand
+        fi = fi.parent
+    if name in mi.functions:
+        return mi.functions[name]
+    ci = _resolve_symbol_class(g, mi, name)
+    if ci is not None:
+        return ci.methods.get("__init__")
+    ent = mi.imports.get(name)
+    if ent is not None and ent[0] == "symbol":
+        mod, _, sym = ent[1].rpartition(".")
+        return g.func_of(mod, sym)
+    return None
+
+
+def _method_lookup(ci: ClassInfo, name: str) -> List[FuncInfo]:
+    """Virtual dispatch: the method in the class's MRO plus every override
+    in project subclasses (a `BackendTransaction`-typed call may land in
+    `MemTransaction`)."""
+    out: List[FuncInfo] = []
+    for c in ci.mro():
+        m = c.methods.get(name)
+        if m is not None:
+            out.append(m)
+            break
+    for sub in ci.all_subclasses():
+        m = sub.methods.get(name)
+        if m is not None and m not in out:
+            out.append(m)
+    return out
+
+
+def _resolve_call(
+    g: Graph, mi: ModuleInfo, scope: _FnScope, node: ast.Call, callable_ref: bool = False
+) -> Tuple[List[FuncInfo], bool, bool, str]:
+    """-> (targets, boundary, propagated, spawn_kind). `boundary` marks a
+    thread hand-off (targets are the spawned BODY, not the spawn helper)."""
+    f = node.func
+
+    # --- thread boundaries -------------------------------------------------
+    if isinstance(f, ast.Attribute):
+        recv_types = scope._types_of(f.value)
+        recv_name = f.value.id if isinstance(f.value, ast.Name) else None
+        # bg.spawn*/start_thread/timer
+        qn = _qualified_target(g, mi, node)
+        spawn_attr = f.attr if (qn or "").endswith(f"bg.{f.attr}") or recv_name == "bg" else None
+        if spawn_attr in SPAWN_CALLABLE_ARG and not callable_ref:
+            idx = SPAWN_CALLABLE_ARG[spawn_attr]
+            bodies, propagated = _spawn_bodies(g, mi, scope, node, idx)
+            return bodies, True, propagated, f"bg.{spawn_attr}"
+        # pool.submit(fn, ...)
+        if (
+            f.attr == "submit"
+            and not callable_ref
+            and (
+                "ThreadPoolExecutor" in recv_types
+                or (
+                    not recv_types
+                    and recv_name is not None
+                    and re.search(r"pool|executor", recv_name, re.I)
+                )
+            )
+        ):
+            bodies, propagated = _spawn_bodies(g, mi, scope, node, 0)
+            return bodies, True, propagated, "pool.submit"
+        # ctx.run(fn, ...): same-thread call through a Context object
+        if f.attr == "run" and node.args and not callable_ref:
+            is_ctx_run = _is_copy_context_run(f) or (
+                isinstance(f.value, ast.Name)
+                and re.fullmatch(r"_?ctx|context", f.value.id or "") is not None
+            )
+            if is_ctx_run:
+                bodies = _resolve_callable(g, mi, scope, node.args[0])
+                return bodies, False, True, ""
+
+    # --- ordinary calls ----------------------------------------------------
+    if isinstance(f, ast.Name):
+        t = _lookup_name(g, mi, scope, f.id)
+        return ([t] if t is not None else []), False, False, ""
+    if isinstance(f, ast.Attribute):
+        # module-qualified
+        if isinstance(f.value, ast.Name):
+            mod = g.import_module(mi, f.value.id)
+            if mod is not None:
+                t = g.func_of(mod, f.attr)
+                return ([t] if t is not None else []), False, False, ""
+            if f.value.id == "self":
+                cls = _enclosing_class(scope.fi)
+                if cls is not None:
+                    ms = _method_lookup(cls, f.attr)
+                    if ms:
+                        return ms, False, False, ""
+        # typed receiver
+        recv_types = scope._types_of(f.value)
+        out: List[FuncInfo] = []
+        for rt in recv_types:
+            if isinstance(rt, ClassInfo):
+                out.extend(m for m in _method_lookup(rt, f.attr) if m not in out)
+        if out:
+            return out, False, False, ""
+        # bounded name-match fallback for untyped receivers
+        if f.attr not in AMBIG_DENY:
+            cands = g.methods_named(f.attr)
+            if 0 < len(cands) <= AMBIG_CAP:
+                return list(cands), False, False, ""
+    return [], False, False, ""
+
+
+def _spawn_bodies(
+    g: Graph, mi: ModuleInfo, scope: _FnScope, node: ast.Call, idx: int
+) -> Tuple[List[FuncInfo], bool]:
+    args = list(node.args)
+    for kw in node.keywords:
+        if kw.arg == "fn":
+            args = args[:idx] + [kw.value] + args[idx:]
+    if len(args) <= idx:
+        return [], False
+    body_expr = args[idx]
+    propagated = False
+    if isinstance(body_expr, ast.Attribute) and _is_copy_context_run(body_expr):
+        # the REAL body is the next positional argument
+        propagated = True
+        if len(args) > idx + 1:
+            body_expr = args[idx + 1]
+        else:
+            return [], True
+    # explicit trace/ctx argument or keyword anywhere in the call
+    for a in list(node.args) + [kw.value for kw in node.keywords]:
+        for sub in ast.walk(a):
+            if isinstance(sub, ast.Call):
+                _, attr = _recv_attr(sub)
+                if attr in ("copy_context", "current", "current_trace_id"):
+                    propagated = True
+    for kw in node.keywords:
+        if kw.arg and re.search(r"trace|ctx", kw.arg):
+            propagated = True
+    bodies = _resolve_callable(g, mi, scope, body_expr)
+    if not propagated:
+        # body takes an explicit trace/ctx parameter -> caller-propagated
+        for b in bodies:
+            if any(re.search(r"trace|ctx", p) for p in b.param_names):
+                propagated = True
+    return bodies, propagated
+
+
+# ------------------------------------------------------------------ GF003 raw
+def _owner_refs(expr: ast.AST) -> Set[str]:
+    """Names whose OWNERSHIP an expression could carry outward: the bare
+    name, container literals holding it, call ARGUMENTS — but not receiver
+    uses (`t.get_obj(...)` yields a derived value, not the handle)."""
+    out: Set[str] = set()
+
+    def rec(e: ast.AST) -> None:
+        if isinstance(e, ast.Name):
+            out.add(e.id)
+        elif isinstance(e, ast.Call):
+            for a in e.args:
+                rec(a)
+            for kw in e.keywords:
+                rec(kw.value)
+        elif isinstance(e, (ast.Attribute, ast.Subscript)):
+            return  # derived value off the handle, not the handle
+        else:
+            for c in ast.iter_child_nodes(e):
+                rec(c)
+
+    rec(expr)
+    return out
+
+
+def _analyze_tx(
+    g: Graph, mi: ModuleInfo, scope: _FnScope, fi: FuncInfo,
+    resolved: Optional[Dict[int, tuple]] = None,
+) -> None:
+    """Transaction-handle tracking for GF003 (+ the param summaries the
+    interprocedural fixpoint consumes). `resolved` is _analyze_fn's call
+    memo; nodes it never visited (decorators, arg defaults) fall back to
+    a fresh resolution."""
+    node = fi.node
+    tx_vars: Dict[str, ast.AST] = {}
+    for sub in _walk_shallow(node):
+        if (
+            isinstance(sub, ast.Assign)
+            and isinstance(sub.value, ast.Call)
+            and isinstance(sub.value.func, ast.Attribute)
+            and sub.value.func.attr == "transaction"
+            and len(sub.targets) == 1
+            and isinstance(sub.targets[0], ast.Name)
+        ):
+            tx_vars[sub.targets[0].id] = sub
+    params = set(fi.param_names)
+    finished: Set[str] = set()
+    escaped: Set[str] = set()
+    passed: Dict[str, List[tuple]] = {}  # var -> [(targets, arg_idx, line)]
+    watch = set(tx_vars) | params
+
+    for sub in _walk_shallow(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("commit", "cancel", "commit_direct"):
+            if isinstance(sub.value, ast.Name) and sub.value.id in watch:
+                finished.add(sub.value.id)
+        elif isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)):
+            v = sub.value
+            if v is not None:
+                escaped |= _owner_refs(v) & watch
+        elif isinstance(sub, ast.Call):
+            memo = (resolved or {}).get(id(sub))
+            if memo is not None:
+                targets, boundary = memo
+            else:
+                targets, boundary, _, _ = _resolve_call(g, mi, scope, sub)
+            for i, a in enumerate(sub.args):
+                hit = [
+                    n.id for n in ast.walk(a)
+                    if isinstance(n, ast.Name) and n.id in watch
+                ]
+                for name in hit:
+                    if targets and not boundary:
+                        passed.setdefault(name, []).append(
+                            (tuple(t.qualname for t in targets), i, sub.lineno)
+                        )
+                    else:
+                        escaped.add(name)  # unresolved/boundary: assume handled
+            for kw in sub.keywords:
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Name) and n.id in watch:
+                        escaped.add(n.id)
+        elif isinstance(sub, ast.Assign):
+            if not (
+                isinstance(sub.value, ast.Call)
+                and isinstance(sub.value.func, ast.Attribute)
+                and sub.value.func.attr == "transaction"
+            ):
+                escaped |= _owner_refs(sub.value) & watch
+
+    fi.finishes_params = finished & params
+    fi.escapes_params = escaped & params
+    for p in params:
+        for targets, i, _line in passed.get(p, []):
+            fi.passes_params.append((p, targets, i))
+    for var, site in tx_vars.items():
+        fi.tx_sites.append(
+            (var, site.lineno, var in finished, var in escaped, passed.get(var, []))
+        )
+
+
+# ------------------------------------------------------------------ fixpoints
+def _fixpoints(g: Graph) -> None:
+    # may_acquire: transitive lock-name closure over non-boundary edges
+    for fi in g.functions.values():
+        fi.may_acquire = {n for n, _l, _h in fi.acquires}
+        fi.may_read_context = fi.reads_context
+    changed = True
+    while changed:
+        changed = False
+        for fi in g.functions.values():
+            for targets, _line, _held, boundary, prop in fi.calls:
+                if boundary:
+                    continue
+                for qn in targets:
+                    t = g.functions.get(qn)
+                    if t is None:
+                        continue
+                    if not t.may_acquire <= fi.may_acquire:
+                        fi.may_acquire |= t.may_acquire
+                        changed = True
+                    # a ctx.run(fn) call propagates the context explicitly,
+                    # so fn's reads are attributed — not an orphan source
+                    if t.may_read_context and not prop and not fi.may_read_context:
+                        fi.may_read_context = True
+                        changed = True
+    # GF003 finishes-param closure: passing a watched param into a callee
+    # that finishes (or escapes) it counts as finishing it here
+    changed = True
+    while changed:
+        changed = False
+        for fi in g.functions.values():
+            for p, targets, i in fi.passes_params:
+                if p in fi.finishes_params or p in fi.escapes_params:
+                    continue
+                for qn in targets:
+                    t = g.functions.get(qn)
+                    if t is None:
+                        continue
+                    pname = t.param_names[i] if i < len(t.param_names) else None
+                    # methods: positional args shift past `self`
+                    if (
+                        t.cls is not None
+                        and t.param_names
+                        and t.param_names[0] == "self"
+                    ):
+                        pname = (
+                            t.param_names[i + 1]
+                            if i + 1 < len(t.param_names)
+                            else None
+                        )
+                    if pname is None:
+                        fi.escapes_params.add(p)  # *args etc: assume handled
+                        changed = True
+                        break
+                    if pname in t.finishes_params:
+                        fi.finishes_params.add(p)
+                        changed = True
+                        break
+                    if pname in t.escapes_params:
+                        fi.escapes_params.add(p)
+                        changed = True
+                        break

@@ -1,0 +1,70 @@
+"""Soundness self-validation: runtime-observed lock edges vs the static
+may-edge graph.
+
+`python -m scripts.graftflow --cross-check <SURREAL_SANITIZE_OUT dump>`
+closes the loop between the two halves of the lock tooling:
+
+- every edge the instrumented run OBSERVED between engine locks must be
+  in graftflow's static may-edge graph — a missing edge means the call
+  graph failed to resolve a real path (an analysis soundness bug), which
+  would silently exempt that path from the GF001 order proof;
+- edges touching lock names outside the engine's creation sites are
+  warnings (test-local locks);
+- static edges the run never exercised are reported as
+  interleaving-coverage GAPS — the orderings only graftflow is checking,
+  i.e. exactly the value the static layer adds over the sanitizer.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Set, Tuple
+
+
+def check_dump(
+    path: str,
+    static_edges: Set[Tuple[str, str]],
+    known_names: Set[str],
+) -> Tuple[List[str], List[str], List[str]]:
+    """-> (errors, warnings, coverage_gaps). Errors fail the gate."""
+    with open(path) as f:
+        doc = json.load(f)
+    errors: List[str] = []
+    warnings: List[str] = []
+    if not doc.get("enabled"):
+        warnings.append(
+            "dump was recorded with the sanitizer DISABLED — no edges to check"
+        )
+    observed: Dict[Tuple[str, str], int] = {}
+    for e in doc.get("edges", []):
+        observed[(e["from"], e["to"])] = e.get("count", 1)
+    for (a, b), count in sorted(observed.items()):
+        outside = [n for n in (a, b) if n not in known_names]
+        if outside:
+            warnings.append(
+                f"observed edge {a} -> {b} touches lock(s) outside the "
+                f"engine's creation sites: {', '.join(sorted(set(outside)))} "
+                "(test-local)"
+            )
+            continue
+        if (a, b) in static_edges:
+            continue
+        if a == b:
+            # same-name re-entry across instances: the static graph folds
+            # re-entrant RLocks away; surface it, don't fail soundness
+            warnings.append(
+                f"observed same-name nesting {a} -> {b} not in the static "
+                "graph (distinct instances of one named family)"
+            )
+            continue
+        errors.append(
+            f"SOUNDNESS GAP: observed edge {a} -> {b} (count {count}) is "
+            "missing from the static may-edge graph — a real path escaped "
+            "call-graph resolution; GF001 is not proving that ordering"
+        )
+    gaps = [
+        f"{a} -> {b}"
+        for (a, b) in sorted(static_edges - set(observed))
+        if a in known_names and b in known_names
+    ]
+    return errors, warnings, gaps

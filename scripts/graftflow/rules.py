@@ -1,0 +1,350 @@
+"""graftflow rules GF001–GF004 over the whole-program call graph.
+
+Each rule is `fn(graph) -> List[Finding]`. Like graftlint, the rules are
+conventions-as-code, not soundness proofs — but unlike graftlint they see
+across function and module boundaries, so a property holds over every
+statically-possible path, not just the paths one file shows. Findings
+support `# graftflow: disable=GF00X` at the witness site and the shared
+baseline mechanics (scripts/baselines.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import Graph
+
+RULES: Dict[str, Tuple] = {}
+
+# GF004: lock acquisitions BELOW this hierarchy level are coordination
+# locks (builds, dispatch, commit, registries, cluster) — stalls there
+# serialize the pipeline. Levels >= 70 are storage/observability leaves:
+# micro-critical-sections every layer may take.
+GF004_LOCK_LEVEL_CEILING = 70
+
+# GF004 entry points: every function in these files (graftlint's hot set,
+# same `# graftlint: hot-path` opt-in marker for additional files)
+GF004_HOT_FILES = frozenset({"surrealdb_tpu/dbs/dispatch.py"})
+
+
+def _rule(rule_id: str, doc: str):
+    def deco(fn):
+        RULES[rule_id] = (fn, doc)
+        return fn
+
+    return deco
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    key: str  # stable, line-number-free
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def _hierarchy():
+    """The declared lock order, from the REAL module (so the static and
+    runtime halves can never drift); None disables the order checks."""
+    try:
+        from surrealdb_tpu.utils import locks
+
+        return locks
+    except Exception:  # noqa: BLE001 — analysis must not require the engine
+        return None
+
+
+def _local_name(g: Graph, qualname: str) -> str:
+    f = g.functions.get(qualname)
+    if f is None:
+        return qualname
+    prefix = f.module + "."
+    return qualname[len(prefix):] if qualname.startswith(prefix) else qualname
+
+
+def _suppressed(g: Graph, rule: str, rel: str, line: int) -> bool:
+    mi = g.rel_module(rel)
+    return mi is not None and mi.is_suppressed(rule, line)
+
+
+# ------------------------------------------------------------------ GF001
+def lock_edges(g: Graph) -> Dict[Tuple[str, str], dict]:
+    """The static acquires-while-holding MAY-edge graph: (held, acquired)
+    -> first witness {site rel, line, via callee, fn}. Re-entrant RLock
+    self-edges are dropped (the runtime sanitizer treats re-acques of the
+    same instance as non-events; statically every same-name RLock pair is
+    presumed the re-entrant case)."""
+    edges: Dict[Tuple[str, str], dict] = {}
+
+    def add(a: str, b: str, rel: str, line: int, fn: str, via: Optional[str]):
+        if a == b and b in g.rlock_names:
+            return
+        edges.setdefault(
+            (a, b), {"rel": rel, "line": line, "fn": fn, "via": via}
+        )
+
+    for fi in g.functions.values():
+        for name, line, held in fi.acquires:
+            for h in held:
+                add(h, name, fi.rel, line, fi.qualname, None)
+        for targets, line, held, boundary, _prop in fi.calls:
+            if boundary or not held:
+                continue
+            for qn in targets:
+                t = g.functions.get(qn)
+                if t is None:
+                    continue
+                for m in t.may_acquire:
+                    for h in held:
+                        add(h, m, fi.rel, line, fi.qualname, qn)
+    return edges
+
+
+@_rule("GF001", "static lock-order proof against utils/locks.HIERARCHY")
+def gf001(g: Graph) -> List[Finding]:
+    locks = _hierarchy()
+    if locks is None:
+        return []
+    h = locks.HIERARCHY
+    edges = lock_edges(g)
+    out: List[Finding] = []
+    for (a, b), w in sorted(edges.items()):
+        if (a, b) in locks.ORDER_EXCEPTIONS:
+            continue
+        site = f"{w['rel']}:{w['line']}"
+        via = f" via {_local_name(g, w['via'])}" if w.get("via") else ""
+        if a == b:
+            if a in locks.SELF_NESTING_OK:
+                continue
+            out.append(
+                Finding(
+                    "GF001", w["rel"], w["line"],
+                    f"static self-nesting of non-reentrant lock {a!r} "
+                    f"(held while re-acquiring{via}) — same instance "
+                    "deadlocks, distinct instances nest unordered",
+                    f"GF001:self:{a}",
+                )
+            )
+            continue
+        la, lb = h.get(a), h.get(b)
+        if la is None or lb is None:
+            continue  # undeclared names are GL011's jurisdiction
+        if la > lb:
+            out.append(
+                Finding(
+                    "GF001", w["rel"], w["line"],
+                    f"static order inversion: {a} (level {la}) may be held "
+                    f"while acquiring {b} (level {lb}) "
+                    f"in {_local_name(g, w['fn'])}{via}",
+                    f"GF001:inversion:{a}->{b}",
+                )
+            )
+        elif la == lb:
+            out.append(
+                Finding(
+                    "GF001", w["rel"], w["line"],
+                    f"static same-level nesting: {a} and {b} are both level "
+                    f"{la} but may nest in {_local_name(g, w['fn'])}{via}",
+                    f"GF001:same-level:{a}->{b}",
+                )
+            )
+    # potential-deadlock cycles (the ABBA no test ever interleaves);
+    # single-node SCCs are self-loops, already reported as self-nesting
+    for cyc in locks._cycles_of(set(edges)):  # noqa: SLF001 — shared analyzer
+        if len(cyc) < 2:
+            continue
+        wit = None
+        for (a, b), w in edges.items():
+            if a in cyc and b in cyc:
+                wit = w
+                break
+        out.append(
+            Finding(
+                "GF001",
+                wit["rel"] if wit else "",
+                wit["line"] if wit else 0,
+                f"static lock-order cycle (potential deadlock): "
+                f"{' -> '.join(cyc + cyc[:1])} — an interleaving no test "
+                "executes can still deadlock here",
+                f"GF001:cycle:{'->'.join(cyc)}",
+            )
+        )
+    return out
+
+
+# ------------------------------------------------------------------ GF002
+@_rule("GF002", "spawned body reads trace context without propagation")
+def gf002(g: Graph) -> List[Finding]:
+    out: List[Finding] = []
+    for fi in g.functions.values():
+        for line, bodies, propagated, kind in fi.spawn_sites:
+            if propagated:
+                continue
+            readers = [
+                qn for qn in bodies
+                if g.functions.get(qn) is not None
+                and g.functions[qn].may_read_context
+            ]
+            for qn in readers:
+                out.append(
+                    Finding(
+                        "GF002", fi.rel, line,
+                        f"{kind} body {_local_name(g, qn)!r} reads the "
+                        "tracing/telemetry context (spans/annotations) but "
+                        "the spawn propagates none — spans recorded on that "
+                        "thread orphan from the arming trace; wrap with "
+                        "contextvars.copy_context().run or pass the trace "
+                        "explicitly",
+                        f"GF002:{fi.rel}:{_local_name(g, fi.qualname)}:"
+                        f"{_local_name(g, qn)}",
+                    )
+                )
+    return out
+
+
+# ------------------------------------------------------------------ GF003
+@_rule("GF003", "txn handle escapes into callees that never finish it")
+def gf003(g: Graph) -> List[Finding]:
+    out: List[Finding] = []
+    for fi in g.functions.values():
+        for var, line, finished, escaped, passes in fi.tx_sites:
+            if finished or escaped:
+                continue
+            if not passes:
+                continue  # no escape at all: graftlint GL004's local case
+            handled = False
+            for targets, arg_idx, _pline in passes:
+                for qn in targets:
+                    t = g.functions.get(qn)
+                    if t is None:
+                        handled = True  # unknown callee: assume responsible
+                        continue
+                    idx = arg_idx
+                    if t.cls is not None and t.param_names[:1] == ["self"]:
+                        idx = arg_idx + 1
+                    pname = (
+                        t.param_names[idx] if idx < len(t.param_names) else None
+                    )
+                    if pname is None:
+                        handled = True  # *args: cannot prove, stay quiet
+                    elif pname in t.finishes_params or pname in t.escapes_params:
+                        handled = True
+            if handled:
+                continue
+            callees = sorted(
+                {_local_name(g, qn) for targets, _i, _l in passes for qn in targets}
+            )
+            out.append(
+                Finding(
+                    "GF003", fi.rel, line,
+                    f"transaction `{var}` in {_local_name(g, fi.qualname)} "
+                    f"escapes only into {callees}, and no resolved callee "
+                    "commits, cancels, or re-escapes it on any path — the "
+                    "snapshot leaks until GC (graftlint GL004 sanctioned "
+                    "the escape; the callee graph disproves it)",
+                    f"GF003:{fi.rel}:{_local_name(g, fi.qualname)}:{var}",
+                )
+            )
+    return out
+
+
+# ------------------------------------------------------------------ GF004
+def _hot_files(g: Graph) -> Set[str]:
+    hot = set(GF004_HOT_FILES)
+    for mi in g.modules.values():
+        if any("graftlint: hot-path" in ln for ln in mi.m.lines[:50]):
+            hot.add(mi.rel)
+    return hot
+
+
+@_rule("GF004", "blocking op transitively reachable from dispatch entry points")
+def gf004(g: Graph) -> List[Finding]:
+    locks = _hierarchy()
+    hot = _hot_files(g)
+    entries = [fi for fi in g.functions.values() if fi.rel in hot]
+    # BFS over same-thread call edges; parents reconstruct the chain
+    parent: Dict[str, Optional[str]] = {fi.qualname: None for fi in entries}
+    queue = [fi.qualname for fi in entries]
+    while queue:
+        qn = queue.pop(0)
+        fi = g.functions.get(qn)
+        if fi is None:
+            continue
+        for targets, _line, _held, boundary, _prop in fi.calls:
+            if boundary:
+                continue  # spawned work does not block the pipeline
+            for t in targets:
+                if t not in parent:
+                    parent[t] = qn
+                    queue.append(t)
+
+    def chain(qn: str) -> str:
+        steps: List[str] = []
+        cur: Optional[str] = qn
+        while cur is not None and len(steps) < 8:
+            steps.append(_local_name(g, cur))
+            cur = parent.get(cur)
+        return " <- ".join(steps)
+
+    out: List[Finding] = []
+    seen: Set[str] = set()
+    for qn in parent:
+        fi = g.functions.get(qn)
+        if fi is None:
+            continue
+        in_hot = fi.rel in hot
+        for kind, detail, line in fi.blocking:
+            if kind == "host_sync" and in_hot:
+                continue  # textually in a hot file: graftlint GL005's case
+            key = f"GF004:{fi.rel}:{_local_name(g, qn)}:{detail}"
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(
+                Finding(
+                    "GF004", fi.rel, line,
+                    f"blocking {detail} reachable from the dispatch hot "
+                    f"path ({chain(qn)}) — this stalls every rider of a "
+                    "coalesced batch",
+                    key,
+                )
+            )
+        if locks is None or in_hot:
+            continue  # the pipeline's own locks are its protocol
+        for name, line, _held in fi.acquires:
+            level = locks.HIERARCHY.get(name)
+            if level is not None and level >= GF004_LOCK_LEVEL_CEILING:
+                continue  # storage/observability leaf: micro-critical-section
+            key = f"GF004:{fi.rel}:{_local_name(g, qn)}:lock:{name}"
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(
+                Finding(
+                    "GF004", fi.rel, line,
+                    f"coordination lock {name!r} acquired on a path "
+                    f"reachable from the dispatch hot path ({chain(qn)}) — "
+                    "contention here convoys coalesced batches",
+                    key,
+                )
+            )
+    return out
+
+
+# ------------------------------------------------------------------ runner
+def run_rules(g: Graph, rules: Optional[List[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for rule_id, (fn, _doc) in RULES.items():
+        if rules is not None and rule_id not in rules:
+            continue
+        for f in fn(g):
+            if f.path and _suppressed(g, f.rule, f.path, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
+    return findings

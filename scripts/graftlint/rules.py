@@ -681,6 +681,95 @@ def gl010(modules: List[Module]) -> List[Finding]:
     return out
 
 
+# ------------------------------------------------------------------ GL011
+# Every named engine lock must be declared in utils/locks.HIERARCHY: the
+# runtime sanitizer can only prove an order for levels it knows, and the
+# graftflow static lock-order proof (GF001) skips undeclared names
+# entirely — an undeclared lock is a lock with NO deadlock coverage.
+# Today an unhierarchied name is only caught when a sanitized test run
+# happens to nest it; this rule fails it at lint time, before any test.
+GL011_ALLOWED_FILES = frozenset({"surrealdb_tpu/utils/locks.py"})
+GL011_LOCK_RECEIVERS = frozenset({"locks", "_locks"})
+GL011_LOCKS_MODULE = "surrealdb_tpu.utils.locks"
+
+
+def _gl011_lock_aliases(m: Module) -> Set[str]:
+    """Every local alias the locks module is importable under in this
+    file — `import surrealdb_tpu.utils.locks as lk` must not dodge the
+    rule just by not being named 'locks'/'_locks'."""
+    out = set(GL011_LOCK_RECEIVERS)
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == GL011_LOCKS_MODULE and a.asname:
+                    out.add(a.asname)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                full = f"{node.module}.{a.name}"
+                if full == GL011_LOCKS_MODULE or (
+                    a.name == "locks" and node.module.endswith("utils")
+                ):
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _gl011_hierarchy():
+    """Imported from the REAL module (linting runs from the repo root) so
+    the rule and the runtime check can never drift; None skips the check."""
+    try:
+        from surrealdb_tpu.utils.locks import HIERARCHY
+
+        return set(HIERARCHY)
+    except Exception:  # noqa: BLE001 — lint must not require a working engine
+        return None
+
+
+@_rule("GL011", "locks.Lock/RLock name missing from the declared HIERARCHY")
+def gl011(modules: List[Module]) -> List[Finding]:
+    declared = _gl011_hierarchy()
+    out: List[Finding] = []
+    for m in modules:
+        if m.rel in GL011_ALLOWED_FILES:
+            continue
+        aliases = _gl011_lock_aliases(m)
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            recv, attr = _call_name(node)
+            if attr not in ("Lock", "RLock") or recv not in aliases:
+                continue
+            a0 = node.args[0] if node.args else None
+            if a0 is None:  # locks.Lock(name="...") is legal too
+                a0 = next(
+                    (kw.value for kw in node.keywords if kw.arg == "name"), None
+                )
+            if not (isinstance(a0, ast.Constant) and isinstance(a0.value, str)):
+                out.append(
+                    Finding(
+                        "GL011", m.rel, node.lineno, node.col_offset,
+                        f"locks.{attr} with a DYNAMIC (or missing) name — "
+                        "lock names are the unit of the declared order; use "
+                        "a static string registered in locks.HIERARCHY",
+                        f"GL011:{m.rel}:{m.enclosing_def(node)}:dynamic-name",
+                    )
+                )
+                continue
+            name = a0.value
+            if declared is not None and name not in declared:
+                out.append(
+                    Finding(
+                        "GL011", m.rel, node.lineno, node.col_offset,
+                        f"lock name {name!r} is not declared in "
+                        "utils/locks.HIERARCHY — it has no level, so neither "
+                        "the runtime sanitizer nor graftflow GF001 can prove "
+                        "any ordering against it; declare it (with a level) "
+                        "before acquiring it",
+                        f"GL011:{m.rel}:name:{name}",
+                    )
+                )
+    return out
+
+
 @_rule("GL008", "retry loop without backoff/attempt cap; bare except-swallow")
 def gl008(modules: List[Module]) -> List[Finding]:
     out: List[Finding] = []

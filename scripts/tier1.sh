@@ -3,21 +3,25 @@
 # wrapped so CI and humans run the identical command, plus the repo's
 # static-analysis and concurrency-sanitizer gates:
 #
-#   0. `python -m scripts.graftlint` — engine-specific lint (GL001–GL010);
-#      findings beyond scripts/graftlint/baseline.json fail the gate.
-#   0.5 `python -m scripts.graftcheck` — compiled-IR kernel audit
-#      (GC001–GC004): every compile_log-registered kernel lowered to
-#      jaxpr/StableHLO (simulated 8-device mesh for the shard_map
-#      runners) and checked for host callbacks, f64 promotion,
-#      undeclared collectives and dynamic shapes; writes the
-#      kernel_audit report bundle.py embeds.
+#   0. `python -m scripts.analysis` — the unified static-analysis gate:
+#      graftlint (source AST, GL001–GL011) -> graftcheck (compiled-IR
+#      kernel audit GC001–GC004, its own process so it can pin the
+#      simulated 8-device mesh before jax loads; writes the kernel_audit
+#      report bundle.py embeds) -> graftflow (whole-program
+#      interprocedural flow GF001–GF004; writes the flow_audit report =
+#      bundle section 11). The bitmask exit code names the failed layer;
+#      running them through one module means the three tools cannot
+#      drift in invocation.
 #   1. the pytest tier-1 suite (exit code preserved; log in /tmp/_t1.log,
 #      DOTS_PASSED recount printed — driver-proof pass counting).
 #   2. a SURREAL_SANITIZE=1 smoke subset re-run: instrumented locks record
 #      the acquisition graph (dumped to /tmp/_t1_locks.json), then
 #      `--lock-order` cross-checks observed edges against the declared
 #      hierarchy (utils/locks.HIERARCHY) — order cycles, guarded-state
-#      violations and inversions fail the gate.
+#      violations and inversions fail the gate — and
+#      `graftflow --cross-check` asserts the OBSERVED edges are a subset
+#      of the STATIC may-edge graph (analysis soundness: a real path the
+#      call graph failed to resolve fails here, not silently).
 #
 # On a non-zero pytest exit the suite dumps a flight-recorder bundle (task
 # registry, compile log, slow/error rings, traces, lock report) to
@@ -52,26 +56,26 @@ if [ "$1" = "--sanitize-full" ]; then
   fi
   python -m scripts.graftlint --no-lint --lock-order /tmp/_t1_locks_full.json
   lock_rc=$?
+  python -m scripts.graftflow --no-rules --cross-check /tmp/_t1_locks_full.json
+  flow_rc=$?
   [ "$full_rc" -ne 0 ] && echo "GATE FAILED: sanitize-full pytest (rc=$full_rc)"
   [ "$lock_rc" -ne 0 ] && echo "GATE FAILED: sanitize-full lock-order cross-check"
+  [ "$flow_rc" -ne 0 ] && echo "GATE FAILED: sanitize-full graftflow observed-vs-static cross-check"
   [ "$full_rc" -ne 0 ] && exit "$full_rc"
-  exit "$lock_rc"
+  [ "$lock_rc" -ne 0 ] && exit "$lock_rc"
+  exit "$flow_rc"
 fi
 
-# ---- gate 0: static analysis ------------------------------------------------
-python -m scripts.graftlint
-lint_rc=$?
-
-# ---- gate 0.5: compiled-IR kernel audit -------------------------------------
-# its own process: graftcheck pins JAX_PLATFORMS/XLA_FLAGS (8 simulated
-# host devices) BEFORE jax loads, which an interpreter that already
-# imported jax cannot do. The report lands where bundle.py reads it.
-# the report path follows the same knob bundle.py reads, so bundles
-# embedded by the rest of this run always see THIS gate's audit
+# ---- gate 0: unified static analysis ----------------------------------------
+# graftlint -> graftcheck -> graftflow, each its own process (graftcheck
+# pins JAX_PLATFORMS/XLA_FLAGS before jax loads). The report paths follow
+# the same knobs bundle.py reads, so bundles embedded by the rest of this
+# run always see THIS gate's kernel_audit + flow_audit.
 audit_report="${SURREAL_KERNEL_AUDIT_REPORT:-/tmp/_graftcheck_report.json}"
-rm -f "$audit_report"
-timeout -k 10 600 python -m scripts.graftcheck
-gcheck_rc=$?
+flow_report="${SURREAL_FLOW_AUDIT_REPORT:-/tmp/_graftflow_report.json}"
+rm -f "$audit_report" "$flow_report"
+python -m scripts.analysis
+analysis_rc=$?
 
 # ---- gate 1: the canonical tier-1 suite ------------------------------------
 rm -f /tmp/_t1.log /tmp/_t1_bundle.json
@@ -104,20 +108,25 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 san_rc=$?
 [ "$san_rc" -ne 0 ] && tail -20 /tmp/_t1_sanitize.log
 lock_rc=1
+flow_rc=1
 if [ -s /tmp/_t1_locks.json ]; then
   python -m scripts.graftlint --no-lint --lock-order /tmp/_t1_locks.json
   lock_rc=$?
+  # soundness self-validation: every edge the instrumented run OBSERVED
+  # must be in graftflow's STATIC may-edge graph
+  python -m scripts.graftflow --no-rules --cross-check /tmp/_t1_locks.json
+  flow_rc=$?
 else
   echo "lock-order: no sanitizer dump produced (smoke run rc=$san_rc)"
 fi
 
 # ---- verdict ---------------------------------------------------------------
-[ "$lint_rc" -ne 0 ] && echo "GATE FAILED: graftlint (rc=$lint_rc)"
-[ "$gcheck_rc" -ne 0 ] && echo "GATE FAILED: graftcheck kernel audit (rc=$gcheck_rc)"
+[ "$analysis_rc" -ne 0 ] && echo "GATE FAILED: static analysis (rc=$analysis_rc: 1=graftlint 2=graftcheck 4=graftflow bitmask)"
 [ "$rc" -ne 0 ] && echo "GATE FAILED: tier-1 pytest (rc=$rc)"
 [ "$san_rc" -ne 0 ] && echo "GATE FAILED: sanitizer smoke subset (rc=$san_rc)"
 [ "$lock_rc" -ne 0 ] && echo "GATE FAILED: lock-order cross-check (rc=$lock_rc)"
+[ "$flow_rc" -ne 0 ] && echo "GATE FAILED: graftflow observed-vs-static cross-check (rc=$flow_rc)"
 # pytest's exit code still wins for compatibility with the driver recount
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
-if [ "$lint_rc" -ne 0 ] || [ "$gcheck_rc" -ne 0 ] || [ "$san_rc" -ne 0 ] || [ "$lock_rc" -ne 0 ]; then exit 1; fi
+if [ "$analysis_rc" -ne 0 ] || [ "$san_rc" -ne 0 ] || [ "$lock_rc" -ne 0 ] || [ "$flow_rc" -ne 0 ]; then exit 1; fi
 exit 0

@@ -32,6 +32,17 @@ versioned document — the artifact you attach to any perf report:
                      last `python -m scripts.graftcheck` run wrote
                      (cnf.KERNEL_AUDIT_REPORT); `available: false` when
                      no audit has run on this host.
+11. `flow_audit`   — the graftflow whole-program flow-analysis report
+                     (scripts/graftflow): call-graph stats (nodes, call
+                     edges, lock sites resolved), the static
+                     acquires-while-holding lock graph, and per-rule
+                     results GF001–GF004 — read from
+                     cnf.FLOW_AUDIT_REPORT, or computed in-process
+                     (memoized; the analysis is pure AST) when no
+                     `python -m scripts.graftflow` run wrote the file.
+                     check_bench_artifact rejects a /5 bundle whose
+                     call-graph stats are empty: a silently-degraded
+                     analyzer must be INVALID, not vacuously green.
 
 Served by `GET /debug/bundle` (system-user-gated) and embedded via
 `INFO FOR ROOT` (`system.bundle`); bench.py embeds one per artifact so a
@@ -47,15 +58,16 @@ On a cluster node `GET /debug/bundle?cluster=1` federates instead
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, Optional
 
-BUNDLE_SCHEMA = "surrealdb-tpu-bundle/4"
+BUNDLE_SCHEMA = "surrealdb-tpu-bundle/5"
 
 # the sections every consumer may rely on
 SECTIONS = (
     "traces", "slow_queries", "errors", "tasks", "compiles", "engine",
-    "locks", "faults", "events", "kernel_audit",
+    "locks", "faults", "events", "kernel_audit", "flow_audit",
 )
 
 
@@ -89,8 +101,50 @@ def debug_bundle(
         "faults": faults.snapshot(),
         "events": events.snapshot(),
         "kernel_audit": _kernel_audit_state(),
+        "flow_audit": _flow_audit_state(),
     }
     return out
+
+
+_flow_audit_cache: Optional[Dict[str, Any]] = None
+# raw lock (diagnostics plumbing, not an engine lock): N concurrent first
+# bundles must run the ~5s in-process analysis ONCE, not N times
+_flow_audit_lock = threading.Lock()
+
+
+def _flow_audit_state() -> Dict[str, Any]:
+    """The last graftflow flow_audit report. File handoff first (the
+    tier-1 gate's run, or the conftest prime); when absent — a bare
+    pytest or bench process in a repo checkout — the analysis runs
+    in-process once under a lock (pure AST, no jax) and is memoized.
+    A generate() failure is NOT cached: the next bundle retries rather
+    than latching every later /5 artifact INVALID on a transient."""
+    import json
+    import os
+
+    from surrealdb_tpu import cnf
+
+    path = cnf.FLOW_AUDIT_REPORT
+    try:
+        if path and os.path.exists(path):
+            with open(path) as f:
+                rep = json.load(f)
+            if isinstance(rep, dict) and isinstance(rep.get("callgraph"), dict):
+                return {"available": True, "source": path, **rep}
+    except (OSError, ValueError):
+        pass  # a corrupt report file must never fail a diagnostics dump
+    global _flow_audit_cache
+    with _flow_audit_lock:
+        if _flow_audit_cache is None:
+            try:
+                from scripts.graftflow.report import generate
+
+                _flow_audit_cache = {
+                    "available": True, "source": "in-process", **generate(),
+                }
+            except Exception:  # noqa: BLE001 — no repo checkout / transient:
+                return {"available": False, "source": path}  # degrade, retry
+        return _flow_audit_cache
 
 
 def _kernel_audit_state() -> Dict[str, Any]:
@@ -226,8 +280,9 @@ def _vector_state(ds) -> Dict[str, Any]:
         entry: Dict[str, Any] = {"rows": m.count() if hasattr(m, "count") else None}
         try:
             entry["ann"] = m.ivf_status()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — a bundle must never fail,
+            # but an unreadable quantizer state is itself a diagnostic
+            entry["ann_error"] = f"{type(e).__name__}: {e}"
         out[".".join(key)] = entry
     return out
 

@@ -288,6 +288,13 @@ COMPILE_LOG_CAP = _env_int("SURREAL_COMPILE_LOG_CAP", 512)
 KERNEL_AUDIT_REPORT = os.environ.get(
     "SURREAL_KERNEL_AUDIT_REPORT", "/tmp/_graftcheck_report.json"
 )
+# Where `python -m scripts.graftflow` writes the flow_audit report and
+# where bundle.py reads it back as the bundle's flow_audit section (same
+# file-handoff contract as KERNEL_AUDIT_REPORT; bundle.py falls back to an
+# in-process analysis when the file is absent in a repo checkout).
+FLOW_AUDIT_REPORT = os.environ.get(
+    "SURREAL_FLOW_AUDIT_REPORT", "/tmp/_graftflow_report.json"
+)
 
 # Concurrency sanitizer (utils/locks.py): instrumented lock wrappers record
 # the lock-acquisition graph, detect order cycles (potential deadlocks) and

@@ -141,14 +141,22 @@ class IndexBuilder:
 
             self._flip_status(key, session, ns, db, tb, name, "ready")
             self._set(key, status="ready", count=count, finished=time.time())
-        except BaseException as e:  # surface failures through INFO — both
+        except Exception as e:  # surface failures through INFO — both
             # the live status and the persisted def (so a stuck 'building'
             # never lies about an aborted build)
             self._set(key, status="error", error=str(e))
             try:
                 self._flip_status(key, session, ns, db, tb, name, "error")
-            except BaseException:
-                pass
+            except Exception as e2:
+                # the live status already says error; keep the secondary
+                # failure visible instead of erasing it
+                self._set(key, flip_error=str(e2))
+        except BaseException as e:
+            # shutdown-class (KeyboardInterrupt/SystemExit/injected panic):
+            # record the aborted build, then PROPAGATE — bg.run marks the
+            # task failed and the interpreter keeps its shutdown signal
+            self._set(key, status="error", error=str(e))
+            raise
 
     def _flip_status(self, key, session, ns, db, tb, name, status: str) -> None:
         def flip(ctx, txn):

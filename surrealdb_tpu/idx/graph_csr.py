@@ -618,7 +618,9 @@ class GraphMirrors:
                 self.build_table(ds, ns, db, tb)
                 self.warm_count_kernels(ns, db)
         except Exception:
-            pass
+            # the bg task record carries the error detail; the counter makes
+            # a string of failed prewarms visible on /metrics
+            telemetry.inc("prewarm_errors", subsystem="graph")
         finally:
             with self._lock:
                 self._prewarm_running.discard(key3)
@@ -722,7 +724,9 @@ class GraphMirrors:
                             ):
                                 dense_kernel(As, op["outdeg"], frs, cws, n0=n0)
                         except Exception:
-                            pass
+                            telemetry.inc(
+                                "prewarm_errors", subsystem="graph_count"
+                            )
                 continue
             # dense doesn't fit (oversized tables / fat multiplicities):
             # warm the CSC cumsum form the serving path will use instead
@@ -753,7 +757,7 @@ class GraphMirrors:
                         ):
                             csc_kernel(csc_hops, ((ptr2,),), frs, cws, n_cap=n_cap)
             except Exception:
-                pass
+                telemetry.inc("prewarm_errors", subsystem="graph_count")
 
     # ------------------------------------------------------------ traversal
     def _hop_mirrors(self, ns, db, spec) -> List[PointerCsr]:

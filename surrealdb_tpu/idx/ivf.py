@@ -507,7 +507,11 @@ class IvfState:
                             nprobe=nprobe,
                         )
                 except Exception:
-                    pass
+                    from surrealdb_tpu import telemetry
+
+                    # a failed tile warm = an on-demand compile inside some
+                    # future request; count it so cold latency is attributable
+                    telemetry.inc("prewarm_errors", subsystem="ivf")
 
         from surrealdb_tpu import bg
 

@@ -517,7 +517,12 @@ def _warm_exact_tiles(dim, matrix, mask_j, metric, k, served_tile, owner=None) -
                         jnp.zeros((t, dim), jnp.float32), matrix, mask_j, metric, k
                     )
             except Exception:
-                pass
+                from surrealdb_tpu import telemetry
+
+                # a failed tile warm means the first real query at this
+                # width pays the XLA compile — count it so a cold p99 is
+                # attributable from metrics alone
+                telemetry.inc("prewarm_errors", subsystem="knn_exact")
 
     from surrealdb_tpu import bg
 

@@ -222,11 +222,15 @@ class GroupCommit:
                 except BaseException as e:
                     # a crash past the drain must still resolve every
                     # drained slot — these txns are no longer in the queue,
-                    # so the submitter self-rescue can never reach them
+                    # so the submitter self-rescue can never reach them.
+                    # Slots _flush already resolved (done set) are left
+                    # alone: a member whose backend commit succeeded must
+                    # not be re-marked failed after its submitter returned.
                     for _, _, slot in batch:
-                        if slot.error is None:
-                            slot.error = e
-                        slot.done.set()
+                        if not slot.done.is_set():
+                            if slot.error is None:
+                                slot.error = e
+                            slot.done.set()
                     raise
                 continue
             if linger <= 0 or self._closed or not self._wake.wait(linger):
@@ -254,21 +258,33 @@ class GroupCommit:
             if lock is not None:
                 lock.acquire()
             try:
-                for txn, ctx, slot in batch:
+                for i, (txn, ctx, slot) in enumerate(batch):
                     try:
                         # the submitter's contextvars (trace/span identity)
                         # ride along: txn_commit spans attribute to the
                         # right request, not to the flusher thread
                         ctx.run(txn.commit_direct, sink)
-                    except BaseException as e:  # per-member outcome channel
+                    except Exception as e:  # per-member outcome channel
                         slot.error = e
+                    except BaseException as e:
+                        # process-shutdown class (KeyboardInterrupt /
+                        # SystemExit / injected panics): resolve THIS member
+                        # and every not-yet-committed one, then propagate —
+                        # already-committed members keep their success, and
+                        # the flush must not keep committing through it
+                        slot.error = e
+                        for _, _, s in batch[i + 1:]:
+                            if s.error is None:
+                                s.error = e
+                        raise
                 try:
                     sink.flush()
                 except Exception:
                     # derived-state upkeep is best-effort past this point:
                     # commits are durable, stale mirrors can't serve
-                    # (version mismatch), and the flusher must stay alive
-                    pass
+                    # (version mismatch), and the flusher must stay alive —
+                    # but the decline has to be countable
+                    telemetry.inc("column_mirror_delta", outcome="flush_error")
             finally:
                 if lock is not None:
                     lock.release()

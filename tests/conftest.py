@@ -33,6 +33,25 @@ def ds():
     return Datastore("memory")
 
 
+def pytest_configure(config):
+    """Prime the graftflow flow_audit report file once per run when it is
+    absent (a bare pytest invocation — the tier1.sh analysis gate writes
+    it before the suite otherwise). Without the prime, the FIRST
+    debug-bundle call of the process runs the ~5s in-process analysis,
+    and when that first call is a federated-bundle RPC handler the stall
+    can exceed the cluster RPC timeout and mark a healthy node
+    unreachable. ~5s once, then free for every later run on the host."""
+    try:
+        from surrealdb_tpu import cnf
+
+        if cnf.FLOW_AUDIT_REPORT and not os.path.exists(cnf.FLOW_AUDIT_REPORT):
+            from scripts.graftflow.report import generate, write_report
+
+            write_report(generate(), cnf.FLOW_AUDIT_REPORT)
+    except Exception:  # noqa: BLE001 — priming is best-effort; the bundle
+        pass  # fallback (surrealdb_tpu/bundle.py) still degrades cleanly
+
+
 def pytest_sessionfinish(session, exitstatus):
     """Flight-recorder CI hook: a failing suite dumps its own diagnostics
     (task registry, compile log, slow/error rings, traces) from INSIDE the

@@ -1,0 +1,33 @@
+"""GF001 fixture: an interprocedural ABBA that NO test ever executes —
+the two paths live in different functions, so graftlint's file-local
+rules and the runtime sanitizer (which only sees executed interleavings)
+are both blind to it. The static may-hold propagation must still derive
+the kvs.commit <-> kvs.mem cycle (and the kvs.mem -> kvs.commit
+inversion against the declared hierarchy)."""
+
+from surrealdb_tpu.utils import locks
+
+COMMIT = locks.Lock("kvs.commit")  # level 30 in the declared hierarchy
+MEM = locks.Lock("kvs.mem")  # level 74
+
+
+def path_one():
+    # declared order: commit (30) before mem (74) — fine on its own
+    with COMMIT:
+        _acquire_mem()
+
+
+def _acquire_mem():
+    with MEM:
+        pass
+
+
+def path_two():
+    # the other half of the ABBA: mem held, commit acquired via a callee
+    with MEM:
+        _acquire_commit()
+
+
+def _acquire_commit():
+    with COMMIT:
+        pass

@@ -1,0 +1,24 @@
+"""GF001 clean twin: the same interprocedural shape, but every path
+acquires in declared-hierarchy order — no inversion, no cycle."""
+
+from surrealdb_tpu.utils import locks
+
+COMMIT = locks.Lock("kvs.commit")  # level 30
+MEM = locks.Lock("kvs.mem")  # level 74
+
+
+def path_one():
+    with COMMIT:
+        _acquire_mem()
+
+
+def _acquire_mem():
+    with MEM:
+        pass
+
+
+def path_two():
+    # a second consistent path: still commit-before-mem
+    with COMMIT:
+        with MEM:
+            pass

@@ -1,0 +1,23 @@
+"""GF002 fixture: spawned bodies that read the tracing/telemetry context
+with no propagation at the spawn site — their spans orphan from the
+arming request's trace."""
+
+from surrealdb_tpu import bg, telemetry
+
+
+def span_body():
+    with telemetry.span("fixture_bg_span"):
+        pass
+
+
+def deep_body():
+    # the read is one call deeper — file-local rules cannot see it
+    span_body()
+
+
+def arm_direct():
+    bg.spawn("fixture", "direct", span_body)
+
+
+def arm_deep():
+    bg.spawn_service("fixture", "deep", deep_body)

@@ -1,0 +1,34 @@
+"""GF002 clean twin: every spawn either copies the context, passes the
+trace explicitly, or spawns a body that never reads it."""
+
+import contextvars
+
+from surrealdb_tpu import bg, telemetry, tracing
+
+
+def span_body():
+    with telemetry.span("fixture_bg_span"):
+        pass
+
+
+def traced_body(trace_ctx):
+    with telemetry.span("fixture_bg_span"):
+        pass
+
+
+def plain_body():
+    return 1 + 1
+
+
+def arm_copied():
+    # the copy_context().run wrapper carries the contextvars across
+    bg.spawn("fixture", "copied", contextvars.copy_context().run, span_body)
+
+
+def arm_explicit():
+    # the trace rides as an explicit argument the body re-installs
+    bg.spawn("fixture", "explicit", traced_body, tracing.current())
+
+
+def arm_reader_free():
+    bg.spawn("fixture", "plain", plain_body)

@@ -1,0 +1,15 @@
+"""GF003 fixture: a transaction handle escapes into a callee, which
+satisfies graftlint GL004 (ownership moved) — but the callee neither
+commits, cancels, nor re-escapes it on any path, so the snapshot leaks.
+Only the interprocedural view can prove that."""
+
+
+def leak_through_call(ds):
+    txn = ds.transaction(True)
+    _use_only(txn)
+
+
+def _use_only(t):
+    # reads and writes, never finishes, never hands it onward
+    t.set_obj(b"k", {"v": 1})
+    return t.get_obj(b"k")

@@ -1,0 +1,34 @@
+"""GF003 clean twin: handles passed into callees that finish them —
+directly, or through one more hop — and a callee that escapes onward."""
+
+
+def commit_through_call(ds):
+    txn = ds.transaction(True)
+    _finish(txn)
+
+
+def _finish(t):
+    t.commit()
+
+
+def commit_through_chain(ds):
+    txn = ds.transaction(True)
+    _chain(txn)
+
+
+def _chain(t):
+    # finishing one hop deeper still counts (the fixpoint closes it)
+    _finish(t)
+
+
+def escape_onward(ds):
+    txn = ds.transaction(True)
+    _store(txn)
+
+
+def _store(t):
+    # ownership moves to the registry — the holder is now responsible
+    _REGISTRY.append(t)
+
+
+_REGISTRY = []

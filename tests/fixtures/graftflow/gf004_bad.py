@@ -1,0 +1,12 @@
+"""GF004 fixture: a dispatch hot-path entry (this file opts in with the
+same marker graftlint GL005 honors) whose BLOCKING work lives in a
+helper module — textually invisible to file-local rules, reachable
+through the call graph."""
+# graftlint: hot-path
+
+from gf004_helper import helper_sync
+
+
+def entry(payloads):
+    # the launch phase itself looks clean; the stall is one call away
+    return helper_sync(payloads)

@@ -1,0 +1,10 @@
+"""GF004 clean twin: the hot-path entry's helper only touches an
+observability LEAF lock (level >= the ceiling) and does no host sync or
+sleeping — micro-critical-sections are the sanctioned shape."""
+# graftlint: hot-path
+
+from gf004_helper_clean import helper_leaf
+
+
+def entry(payloads):
+    return helper_leaf(payloads)

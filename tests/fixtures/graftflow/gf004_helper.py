@@ -1,0 +1,19 @@
+"""Helper reached from gf004_bad's hot-path entry: a backoff sleep, a
+host sync, and a coordination-lock acquisition — each stalls every rider
+of a coalesced batch when it runs on the dispatch path."""
+
+import time
+
+import numpy as np
+
+from surrealdb_tpu.utils import locks
+
+_COMMITISH = locks.Lock("kvs.commit")  # level 30: coordination, not a leaf
+
+
+def helper_sync(x):
+    time.sleep(0.01)
+    v = np.asarray(x)
+    with _COMMITISH:
+        pass
+    return v

@@ -1,0 +1,10 @@
+"""Helper reached from gf004_clean's entry: leaf-lock bookkeeping only."""
+
+from surrealdb_tpu.utils import locks
+
+_LEAF = locks.Lock("telemetry.registry")  # level 86: observability leaf
+
+
+def helper_leaf(x):
+    with _LEAF:
+        return len(x)

@@ -1,0 +1,16 @@
+"""GL011 fixture: lock names outside the declared hierarchy, and a
+dynamic name no hierarchy could ever cover."""
+
+from surrealdb_tpu.utils import locks
+
+
+def make_unregistered():
+    return locks.Lock("fixture.not_in_hierarchy")
+
+
+def make_unregistered_rlock():
+    return locks.RLock("fixture.also_missing")
+
+
+def make_dynamic(component: str):
+    return locks.Lock(f"fixture.{component}")

@@ -1,0 +1,11 @@
+"""GL011 clean twin: every created lock carries a declared, static name."""
+
+from surrealdb_tpu.utils import locks as _locks
+
+
+def make_commit_lock():
+    return _locks.Lock("kvs.commit")
+
+
+def make_registry_lock():
+    return _locks.RLock("idx.column.registry")

@@ -52,6 +52,74 @@ def test_diff_bundles_flags_staleness_and_compile_drift():
     assert rep["compiles"]["only_in_new"] == ["knn_exact:(64,)"]
 
 
+def _flow_audit(nodes=2000, edges=8000, lock_sites=32, rules=None, lock_edges=None):
+    return {
+        "available": True,
+        "schema": "surrealdb-tpu-flow-audit/1",
+        "callgraph": {
+            "nodes": nodes, "edges": edges, "lock_sites": lock_sites,
+            "unresolved_calls": 100,
+        },
+        "lock_graph": {
+            "edges": [
+                {"from": a, "to": b, "site": "x.py:1", "via": None}
+                for a, b in (lock_edges or [("kvs.commit", "kvs.mem")])
+            ]
+        },
+        "rules": rules or {"GF001": "pass", "GF002": "pass"},
+    }
+
+
+def test_diff_bundles_flags_flow_audit_drift():
+    old = _bundle()
+    old["flow_audit"] = _flow_audit()
+    new = _bundle()
+    new["flow_audit"] = _flow_audit(
+        nodes=900,  # > 30% coverage shrink
+        rules={"GF001": "fail(2)", "GF002": "pass"},
+        lock_edges=[("kvs.commit", "kvs.mem"), ("kvs.commit", "idx.store")],
+    )
+    rep = bench_diff.diff_bundles(old, new)
+    text = "\n".join(rep["flags"])
+    assert "lost coverage" in text
+    assert "pass -> fail" in text and "GF001" in text
+    assert "new static lock-order edge" in text
+    assert rep["flow_audit"]["lock_graph"]["only_in_new"] == [
+        "kvs.commit->idx.store"
+    ]
+
+
+def test_flow_audit_missing_in_new_round_is_flagged():
+    old = _bundle()
+    old["flow_audit"] = _flow_audit()
+    new = _bundle()
+    rep = bench_diff.diff_bundles(old, new)
+    assert any("graftflow gate did not run" in f for f in rep["flags"])
+
+
+def test_v5_bundle_flow_audit_rules():
+    # older bundle schemas: section optional, structural when present
+    assert cba._check_flow_audit({"schema": "surrealdb-tpu-bundle/3"}) == []
+    ok = {"schema": "surrealdb-tpu-bundle/5", "flow_audit": _flow_audit()}
+    assert cba._check_flow_audit(ok) == []
+    # /5 contract: the section is mandatory...
+    missing = {"schema": "surrealdb-tpu-bundle/5"}
+    assert any("missing the flow_audit" in p for p in cba._check_flow_audit(missing))
+    # ...the analyzer must have RUN...
+    never_ran = {
+        "schema": "surrealdb-tpu-bundle/5",
+        "flow_audit": {"available": False},
+    }
+    assert any("never ran" in p for p in cba._check_flow_audit(never_ran))
+    # ...and a degraded analyzer (0 lock sites found) is INVALID, not green
+    degraded = {
+        "schema": "surrealdb-tpu-bundle/5",
+        "flow_audit": _flow_audit(lock_sites=0),
+    }
+    probs = cba._check_flow_audit(degraded)
+    assert any("lock_sites" in p and "degraded" in p for p in probs)
+
+
 def test_diff_bundles_quiet_when_nothing_drifts():
     b = _bundle(columns={"t.t.p": {"rows": 5, "stale": False}})
     assert bench_diff.diff_bundles(b, json.loads(json.dumps(b)))["flags"] == []
