@@ -63,7 +63,7 @@ import time
 import numpy as np
 
 SCALE = float(os.environ.get("SURREAL_BENCH_SCALE", "1.0"))
-CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6,7,8").split(","))
+CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9").split(","))
 ROUND = os.environ.get("SURREAL_BENCH_ROUND", "r10")
 OUT_PATH = os.environ.get(
     "SURREAL_BENCH_OUT",
@@ -99,7 +99,16 @@ PROFILE = "--profile" in sys.argv[1:] or os.environ.get("SURREAL_PROFILE") == "1
 # and the live-node list its shard timings must cover. Config 8's chaos
 # line adds an `events` accounting (breaker events, degraded reads and
 # how many of those carry no trace_id — bench_gate floors them).
-SCHEMA = "surrealdb-tpu-bench/9"
+# schema/10 (r14, vectorized SELECT pipeline): new config 9 `ordered_agg` —
+# ORDER BY+LIMIT and GROUP BY aggregate statements measured columnar vs
+# row path on IDENTICAL data, each with `same_results` asserted, plus the
+# window's `column_pipeline{outcome}` counter snapshot (every
+# decline-to-row-path is counted — zero silent wrong answers is a
+# validator rule, not a hope). Config 7's cluster object gains
+# `agg_pushdown`: the coordinator merged per-shard PARTIAL aggregates
+# (two-phase, like BM25 global stats) instead of shipping rows, proven by
+# the cluster_agg{outcome=pushed} counter and per-shard partial counts.
+SCHEMA = "surrealdb-tpu-bench/10"
 
 D = 768
 NI = max(int(1_000_000 * SCALE), 1024)  # item corpus (configs 2/4/5)
@@ -1036,6 +1045,93 @@ def bench_filtered_scan(ds, s):
     return ratio
 
 
+def bench_ordered_agg(ds, s):
+    """Config 9: the vectorized SELECT pipeline (ops/pipeline.py) — an
+    ORDER BY+LIMIT statement (mask -> argsort -> top-k, late
+    materialization) and a GROUP BY aggregate statement (factorize +
+    segment-reduce) measured columnar vs the row-at-a-time postprocess on
+    the SAME item corpus. Results asserted identical per statement; value
+    = combined columnar qps, vs_baseline = combined speedup."""
+    from surrealdb_tpu import cnf as _cnf, telemetry as _tm
+
+    # ties on val resolve by scan order on both paths (stable sorts), so
+    # the full sort stays on the vectorized lexsort plane
+    order_sql = (
+        "SELECT id, val FROM item WHERE flag = true ORDER BY val DESC LIMIT 20"
+    )
+    agg_sql = (
+        "SELECT flag, count() AS n, math::sum(val) AS s, math::min(val) AS mn, "
+        "math::max(val) AS mx, math::mean(val) AS avg "
+        "FROM item WHERE val < 500 GROUP BY flag"
+    )
+
+    def norm(rows):
+        return json.dumps(rows, default=repr, sort_keys=True)
+
+    out = {}
+    pushed0 = {
+        k: _tm.get_counter("column_pipeline", outcome=k)
+        for k in ("ordered", "grouped")
+    }
+    saved = _cnf.COLUMN_MIRROR
+    for name, sql, nq_col, nq_row in (
+        ("order", order_sql, 12, 3),
+        ("agg", agg_sql, 12, 3),
+    ):
+        # row-path baseline first (the mirror build then can't hide inside
+        # the timed columnar pass); finally-restored so a failing baseline
+        # query can't leave mirrors off for every later config
+        _cnf.COLUMN_MIRROR = False
+        try:
+            t0 = time.perf_counter()
+            row_res = run(ds, s, sql)[-1]["result"]
+            for _ in range(nq_row - 1):
+                run(ds, s, sql)
+            row_qps = nq_row / (time.perf_counter() - t0)
+        finally:
+            _cnf.COLUMN_MIRROR = saved
+        col_qps, col_p50, col_results = timed_queries(
+            ds, s, [(sql, None) for _ in range(nq_col)], warmup=1
+        )
+        out[name] = {
+            "col_qps": round(col_qps, 2),
+            "row_qps": round(row_qps, 3),
+            "p50_ms": round(col_p50, 2),
+            "ratio": round(col_qps / row_qps, 2) if row_qps else None,
+            "same_results": norm(col_results[0]) == norm(row_res),
+            "rows": len(col_results[0]),
+        }
+    engaged = {
+        k: _tm.get_counter("column_pipeline", outcome=k) - pushed0[k]
+        for k in ("ordered", "grouped")
+    }
+    pipeline = {
+        k[0][1]: int(v)
+        for k, v in _tm.counters_matching("column_pipeline").items()
+    }
+    ratios = [v["ratio"] for v in out.values() if v["ratio"]]
+    ratio = round(min(ratios), 2) if ratios else None
+    emit(
+        {
+            "metric": f"ordered_agg_{NI}rows",
+            "value": out["order"]["col_qps"],
+            "unit": "qps",
+            "vs_baseline": ratio,
+            "order": out["order"],
+            "agg": out["agg"],
+            "pipeline": pipeline,
+            "pipeline_engaged": engaged,
+            "same_results": out["order"]["same_results"] and out["agg"]["same_results"],
+        }
+    )
+    assert out["order"]["same_results"], "ordered columnar result diverged"
+    assert out["agg"]["same_results"], "aggregate columnar result diverged"
+    assert engaged["ordered"] > 0 and engaged["grouped"] > 0, (
+        f"pipeline never engaged: {engaged}"
+    )
+    return ratio
+
+
 def bench_cluster(rng):
     """Config 7: 2-node sharded serving (surrealdb_tpu/cluster/) over its
     own small corpus — measures coordinator kNN qps and PROVES merged-
@@ -1085,6 +1181,10 @@ def bench_cluster(rng):
                     "id": i,
                     "emb": corpus[i].tolist(),
                     "val": float(vals[i]),
+                    # int group/aggregate column: the partial-aggregate
+                    # pushdown merges int sums byte-exactly (float sums
+                    # refuse and fall back to the replay path)
+                    "grp": i % 7,
                     # distinct tf profiles -> distinct BM25 scores, so the
                     # byte-identical comparison is order-meaningful
                     "body": " ".join(
@@ -1095,7 +1195,7 @@ def bench_cluster(rng):
             ]
             for target in (ref.execute, ds1.execute):
                 r = target("INSERT INTO item $rows", s, {"rows": [
-                    {k: row[k] for k in ("id", "emb", "val")} for row in rows
+                    {k: row[k] for k in ("id", "emb", "val", "grp")} for row in rows
                 ]})
                 assert r[0]["status"] == "OK", r
                 r = target("INSERT INTO doc $rows", s, {"rows": [
@@ -1128,6 +1228,17 @@ def bench_cluster(rng):
             "WHERE body @1@ 'w3 w7' ORDER BY sc DESC LIMIT 10"
         )
         qv = {"q": (corpus[17] + 0.01).tolist()}
+        # GROUP BY pushdown: the coordinator must merge per-shard PARTIAL
+        # aggregates (cluster_agg{outcome=pushed}) instead of shipping and
+        # replaying every surviving row — with byte-identical results
+        agg_sql = (
+            "SELECT grp, count() AS n, math::sum(grp) AS sg, "
+            "math::min(grp) AS mn, math::max(grp) AS mx "
+            "FROM item GROUP BY grp ORDER BY grp"
+        )
+        from surrealdb_tpu import telemetry as _tm2
+
+        agg_pushed0 = _tm2.get_counter("cluster_agg", outcome="pushed")
         parity = {
             "where": ref.execute(where_sql, s)[0]["result"]
             == ds1.execute(where_sql, s)[0]["result"],
@@ -1135,7 +1246,12 @@ def bench_cluster(rng):
             == ds1.execute(knn_sql, s, dict(qv))[0]["result"],
             "bm25": ref.execute(bm_sql, s)[0]["result"]
             == ds1.execute(bm_sql, s)[0]["result"],
+            "agg": ref.execute(agg_sql, s)[0]["result"]
+            == ds1.execute(agg_sql, s)[0]["result"],
         }
+        agg_pushdown = (
+            _tm2.get_counter("cluster_agg", outcome="pushed") > agg_pushed0
+        )
 
         # ---- one request, one span tree across nodes
         tid = _uuid.uuid4().hex
@@ -1201,11 +1317,13 @@ def bench_cluster(rng):
                     "trace_nodes": trace_nodes,
                     "ingest_bulk_path": ingest_parity,
                     "ingest_bulk_rows": int(bulk_rows),
+                    "agg_pushdown": agg_pushdown,
                 },
                 "cluster_obs": cluster_obs,
             }
         )
         assert all(parity.values()), f"cluster parity broken: {parity}"
+        assert agg_pushdown, "cluster GROUP BY never took the partial-aggregate path"
         assert ingest_parity, (
             f"cluster ingest fell off the bulk path: {bulk_rows} < {4 * n}"
         )
@@ -1543,7 +1661,7 @@ def main() -> None:
     if "3" in CONFIGS:
         ingest_docs(ds, s, rng)
         run_cfg("3", lambda: bench_bm25(ds, s, rng))
-    if CONFIGS & {"2", "4", "5", "6"}:
+    if CONFIGS & {"2", "4", "5", "6", "9"}:
         need_corpus()
     if "7" in CONFIGS:
         run_cfg("7", lambda: bench_cluster(rng))
@@ -1553,6 +1671,8 @@ def main() -> None:
         run_cfg("5", lambda: bench_ml_scan(ds, s, rng))
     if "6" in CONFIGS:
         run_cfg("6", lambda: bench_filtered_scan(ds, s))
+    if "9" in CONFIGS:
+        run_cfg("9", lambda: bench_ordered_agg(ds, s))
     if "4" in CONFIGS:
         ingest_hybrid_edges(ds, s, rng)
         wait_ann_ready(ds)

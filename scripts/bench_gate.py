@@ -64,6 +64,9 @@ FLOOR_SCAN_RATIO = float(os.environ.get("SURREAL_BENCH_GATE_SCAN_RATIO", "5.0"))
 FLOOR_INGEST = float(os.environ.get("SURREAL_BENCH_GATE_INGEST_FLOOR", "5000.0"))
 FLOOR_INGEST_RATIO = float(os.environ.get("SURREAL_BENCH_GATE_INGEST_RATIO", "5.0"))
 CHAOS_MAX_ERRORS = int(os.environ.get("SURREAL_BENCH_GATE_CHAOS_ERRORS", "3"))
+# vectorized SELECT pipeline (config 9): ORDER BY+LIMIT and GROUP BY
+# aggregate columnar/row speedup floor (the ISSUE 13 acceptance bar)
+FLOOR_PIPE_RATIO = float(os.environ.get("SURREAL_BENCH_GATE_PIPE_RATIO", "5.0"))
 TIMEOUT = int(os.environ.get("SURREAL_BENCH_GATE_TIMEOUT", "1200"))
 
 
@@ -73,7 +76,7 @@ def main() -> int:
     env.update(
         {
             "SURREAL_BENCH_SCALE": SCALE,
-            "SURREAL_BENCH_CONFIGS": "2,6,8",
+            "SURREAL_BENCH_CONFIGS": "2,6,8,9",
             "SURREAL_BENCH_ROUND": "gate",
             "SURREAL_BENCH_OUT": out,
         }
@@ -253,6 +256,40 @@ def main() -> int:
                     "read(s) carry no trace_id — unattributable failovers"
                 )
 
+    # ---- config 9: vectorized-pipeline floors (schema/10) -------------
+    pipe_summary = None
+    pipe_line = next(
+        (
+            r
+            for r in art["results"]
+            if str(r.get("config")) == "9"
+            and str(r.get("metric", "")).startswith("ordered_agg")
+        ),
+        None,
+    )
+    if pipe_line is None:
+        failures.append("no config-9 ordered_agg line in artifact")
+    else:
+        pipe_summary = {
+            "order": pipe_line.get("order"),
+            "agg": pipe_line.get("agg"),
+        }
+        for part in ("order", "agg"):
+            obj = pipe_line.get(part) or {}
+            if obj.get("same_results") is not True:
+                failures.append(
+                    f"ordered_agg: {part} columnar results diverged from row path"
+                )
+            ratio = obj.get("ratio")
+            if ratio is None or ratio < FLOOR_PIPE_RATIO:
+                failures.append(
+                    f"ordered_agg {part} columnar/row speedup {ratio}x < "
+                    f"floor {FLOOR_PIPE_RATIO}x"
+                )
+        perrs = pipe_line.get("errors") or {}
+        if any(perrs.values()):
+            failures.append(f"ordered_agg errors != 0: {perrs}")
+
     summary = {
         "qps": qps,
         "recall_at_10": recall,
@@ -265,6 +302,7 @@ def main() -> int:
         "ingest_rate_rows_s": line.get("ingest_rate_rows_s"),
         "ingest": ingest_summary,
         "chaos": chaos_summary,
+        "ordered_agg": pipe_summary,
         "artifact": out,
     }
     print(f"bench_gate: {json.dumps(summary)}")

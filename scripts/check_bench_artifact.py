@@ -23,7 +23,7 @@ import os
 import sys
 from typing import List
 
-SCHEMA = "surrealdb-tpu-bench/9"
+SCHEMA = "surrealdb-tpu-bench/10"
 # earlier rounds' committed artifacts stay validatable under their own rules
 KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/1",
@@ -34,6 +34,7 @@ KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/6",
     "surrealdb-tpu-bench/7",
     "surrealdb-tpu-bench/8",
+    "surrealdb-tpu-bench/9",
     SCHEMA,
 )
 
@@ -75,6 +76,9 @@ INGEST_KEYS = ("sustained_rows_s", "r10_rows_s", "delta_vs_r10", "parity_failure
 # and NEVER answered wrong (wrong_answers == 0 is a validity rule, not a
 # perf floor); /8 bundles also carry the failpoint engine's `faults`
 # section as their eighth section
+# schema/10 (vectorized SELECT pipeline): the ordered_agg config line's
+# per-shape objects must each prove parity and carry both qps sides
+ORDERED_AGG_KEYS = ("col_qps", "row_qps", "ratio", "same_results")
 CHAOS_KEYS = (
     "nodes", "rf", "killed_node", "reads", "failover_reads",
     "degraded_responses", "errors", "wrong_answers", "recovery_s",
@@ -190,7 +194,8 @@ def validate(path: str) -> List[str]:
     if art.get("schema") not in KNOWN_SCHEMAS:
         problems.append(f"schema is {art.get('schema')!r}, expected one of {KNOWN_SCHEMAS}")
     schema = art.get("schema")
-    v9 = schema == SCHEMA
+    v10 = schema == SCHEMA
+    v9 = v10 or schema == "surrealdb-tpu-bench/9"
     v8 = v9 or schema == "surrealdb-tpu-bench/8"
     v7 = v8 or schema == "surrealdb-tpu-bench/7"
     v6 = v7 or schema == "surrealdb-tpu-bench/6"
@@ -358,6 +363,48 @@ def validate(path: str) -> List[str]:
                             f"{where} ({metric}): a replicated chaos window "
                             "with a killed node must show degraded responses"
                         )
+        if v10 and metric.startswith("ordered_agg"):
+            # schema/10: the vectorized-pipeline config must PROVE parity
+            # per statement shape and show the pipeline actually engaged —
+            # a row-path-only "columnar" number is an invalid artifact
+            for part in ("order", "agg"):
+                obj = r.get(part)
+                if not isinstance(obj, dict):
+                    problems.append(f"{where} ({metric}): missing {part!r} object")
+                    continue
+                for key in ORDERED_AGG_KEYS:
+                    if key not in obj:
+                        problems.append(
+                            f"{where} ({metric}): {part} missing {key!r}"
+                        )
+                if obj.get("same_results") is not True:
+                    problems.append(
+                        f"{where} ({metric}): {part}.same_results must be true "
+                        "(the lowered pipeline diverged from the row path)"
+                    )
+            pe = r.get("pipeline_engaged")
+            if not (
+                isinstance(pe, dict)
+                and pe.get("ordered", 0) > 0
+                and pe.get("grouped", 0) > 0
+            ):
+                problems.append(
+                    f"{where} ({metric}): pipeline_engaged must show both the "
+                    "ordered and grouped lowerings serving in the window"
+                )
+            if not isinstance(r.get("pipeline"), dict):
+                problems.append(
+                    f"{where} ({metric}): missing the column_pipeline{{outcome}} "
+                    "counter snapshot ('pipeline')"
+                )
+        if v10 and metric.startswith("cluster_"):
+            cl = r.get("cluster")
+            if isinstance(cl, dict) and cl.get("agg_pushdown") is not True:
+                problems.append(
+                    f"{where} ({metric}): cluster.agg_pushdown must be true "
+                    "(the GROUP BY shipped rows instead of merging partial "
+                    "aggregates)"
+                )
         if v9 and (metric.startswith("cluster_") or metric.startswith("chaos_")):
             co = r.get("cluster_obs")
             if not isinstance(co, dict):

@@ -101,6 +101,7 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
   python -m pytest \
   tests/test_locks_sanitizer.py tests/test_dispatch.py \
   tests/test_flight_recorder.py tests/test_column_scan.py \
+  tests/test_column_pipeline.py \
   tests/test_kvs.py tests/test_e2e_crud.py tests/test_cluster.py \
   tests/test_bulk_ingest_v2.py tests/test_faults.py \
   tests/test_cluster_obs.py \

@@ -149,7 +149,7 @@ class _StmtCtx:
     __slots__ = (
         "degraded", "failed_nodes", "_budget", "_lock",
         "scatter_kind", "admission_wait_s", "merge_s", "rows_gathered",
-        "retries", "shards", "remote_slow", "remote_errors",
+        "retries", "shards", "remote_slow", "remote_errors", "pushdown",
     )
 
     def __init__(self, budget: int):
@@ -163,11 +163,13 @@ class _StmtCtx:
         self.rows_gathered: Optional[int] = None
         self.retries = 0
         # node -> {"calls", "rpc_s", "max_rpc_s", "rows", "retries",
-        #          "failovers", "errors"} (seconds internally; the profile
-        #          renders milliseconds)
+        #          "failovers", "errors", "partials"} (seconds internally;
+        #          the profile renders milliseconds)
         self.shards: Dict[str, dict] = {}
         self.remote_slow: List[dict] = []
         self.remote_errors: List[dict] = []
+        # pipeline-lowering accounting: {"agg": ...} / {"order_limit": k}
+        self.pushdown: Optional[dict] = None
 
     def take_retry(self) -> bool:
         with self._lock:
@@ -182,9 +184,18 @@ class _StmtCtx:
         if sh is None:
             sh = self.shards[node_id] = {
                 "calls": 0, "rpc_s": 0.0, "max_rpc_s": 0.0, "rows": 0,
-                "retries": 0, "failovers": 0, "errors": 0,
+                "retries": 0, "failovers": 0, "errors": 0, "partials": 0,
             }
         return sh
+
+    def record_partials(self, node_id: str, groups: int, rows: int) -> None:
+        """One shard's partial-aggregate contribution: how many groups it
+        returned and how many of its rows they aggregate — a skewed shard
+        is attributable straight off the EXPLAIN ANALYZE Shard row."""
+        with self._lock:
+            sh = self._shard(node_id)
+            sh["partials"] += groups
+            sh["rows"] += rows
 
     def record_rpc(
         self, node_id: str, dur_s: float,
@@ -245,10 +256,11 @@ class _StmtCtx:
                     "retries": sh["retries"],
                     "failovers": sh["failovers"],
                     "errors": sh["errors"],
+                    "partials": sh.get("partials", 0),
                 }
                 for n, sh in sorted(self.shards.items())
             }
-            return {
+            out = {
                 "sql": sql[:200],
                 "kind": kind,
                 "scatter": self.scatter_kind,
@@ -261,6 +273,9 @@ class _StmtCtx:
                 "failed_nodes": sorted(self.failed_nodes),
                 "shards": shards,
             }
+            if self.pushdown:
+                out["pushdown"] = dict(self.pushdown)
+            return out
 
 
 _STMT: "contextvars.ContextVar[Optional[_StmtCtx]]" = contextvars.ContextVar(
@@ -347,6 +362,11 @@ class ClusterExecutor:
         # embed it; raw lock — leaf-only, never nests)
         self._profile_lock = threading.Lock()
         self._slowest_profile: Optional[dict] = None
+        # write-degradation watermark at attach: the pipeline pushdowns
+        # stand down once THIS cluster has degraded/diverged a write
+        # (telemetry is process-global; the delta scopes it to this
+        # executor's lifetime)
+        self._degradation0 = self._write_degradation()
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)
@@ -1183,6 +1203,20 @@ class ClusterExecutor:
             with telemetry.span("cluster_scatter", kind="colocated"):
                 return self._colocated_select(stm, session, vars)
 
+        if (
+            knn is None
+            and matches is None
+            and (getattr(stm, "group", None) or getattr(stm, "group_all", False))
+        ):
+            # GROUP BY aggregate pushdown: each shard returns partial
+            # aggregates over its rows and the coordinator merges partials
+            # instead of shipping + replaying every surviving row. Shapes
+            # that cannot prove a byte-exact merge fall back to the full
+            # gather-and-replay scatter below.
+            resp = self._agg_pushdown(stm, session, vars)
+            if resp is not None:
+                return resp
+
         kind = "knn" if knn is not None else ("bm25" if matches is not None else "scan")
         self._set_scatter_kind(kind)
         with telemetry.span("cluster_scatter", kind=kind):
@@ -1224,13 +1258,16 @@ class ClusterExecutor:
                 "detail": {"duration_ms": round(dur * 1e3, 3)},
             }])
         profile = ctx.profile(repr(stm), type(stm).__name__, dur)
+        scatter_detail = {
+            "kind": profile["scatter"],
+            "nodes": len(profile["shards"]),
+            "admission_wait_ms": profile["admission_wait_ms"],
+        }
+        if profile.get("pushdown"):
+            scatter_detail["pushdown"] = profile["pushdown"]
         ops: List[dict] = [{
             "operation": "Cluster Scatter",
-            "detail": {
-                "kind": profile["scatter"],
-                "nodes": len(profile["shards"]),
-                "admission_wait_ms": profile["admission_wait_ms"],
-            },
+            "detail": scatter_detail,
         }]
         for node, sh in profile["shards"].items():
             ops.append({"operation": "Shard", "detail": dict(sh, node=node)})
@@ -1370,6 +1407,141 @@ class ClusterExecutor:
         )
         return {"status": out[0]["status"], "result": out[0]["result"]}
 
+    @staticmethod
+    def _write_degradation() -> float:
+        """Degraded/diverged writes observed by this coordinator. A replica
+        that missed an acked write serves an incomplete shard: the row-ship
+        paths cover it (divergence-aware dedup keeps the surviving copy),
+        but per-shard PARTIAL aggregates and per-shard top-k cuts count
+        each record at exactly one responsible replica and would silently
+        drop it — so the pipeline pushdowns stand down entirely once any
+        write degradation exists, until rebalance/anti-entropy (ROADMAP)
+        repairs the copies. Same caveat class as the r12 degraded-write
+        catch-up note; per-coordinator knowledge, like the retry budget."""
+        from surrealdb_tpu import telemetry
+
+        return telemetry.get_counter("cluster_failover_total", op="write") + sum(
+            telemetry.counters_matching("cluster_write_divergence").values()
+        )
+
+    def _agg_pushdown(self, stm, session, vars) -> Optional[dict]:
+        """Two-phase GROUP BY (the BM25 global-stats design generalized):
+        scatter one `agg_partial` op, merge the per-shard partials on the
+        coordinator, project + ORDER/LIMIT locally. Under replication each
+        shard aggregates only rows it is the first live replica of, so a
+        doc counts exactly once. Returns None to fall back to the full
+        gather-and-replay scatter — shapes that cannot prove a byte-exact
+        merge (float sums, NaN folds, cross-shard int/float ties) refuse
+        rather than answer approximately."""
+        from surrealdb_tpu import telemetry
+        from surrealdb_tpu.ops import pipeline as _pl
+
+        shape = _pl.grouped_shape(stm)
+        if shape is None:
+            telemetry.inc("cluster_agg", outcome="fallback_shape")
+            return None
+        if self._rf() > 1 and self._write_degradation() > self._degradation0:
+            telemetry.inc("cluster_agg", outcome="fallback_degraded")
+            return None
+        if getattr(stm, "split", None) or getattr(stm, "omit", None):
+            telemetry.inc("cluster_agg", outcome="fallback_shape")
+            return None
+        if len(stm.what) != 1:
+            telemetry.inc("cluster_agg", outcome="fallback_shape")
+            return None
+        targets = self._flatten_targets(self._eval_exprs(stm.what, session, vars))
+        if len(targets) != 1 or not isinstance(targets[0], Table):
+            telemetry.inc("cluster_agg", outcome="fallback_shape")
+            return None
+        tb = str(targets[0])
+        rf = self._rf()
+        req_base = {
+            "sql": repr(stm),
+            "ns": session.ns,
+            "db": session.db,
+            "tb": tb,
+            "vars": vars or None,
+        }
+        self._set_scatter_kind("agg")
+        ctx = _STMT.get(None)
+        gathered: Dict[str, dict] = {}
+        for attempt in range(2):
+            node_ids = self._all_nodes()
+            req = dict(req_base)
+            if rf > 1:
+                down = self._down_nodes()
+                live = [n for n in node_ids if n not in down] or node_ids
+                req.update(live=live, rf=rf)
+                node_ids = live
+            try:
+                with telemetry.span("cluster_scatter", kind="agg"):
+                    gathered = self._fan_out(
+                        node_ids, "agg_partial", req, idempotent=True
+                    )
+                break
+            except NodeUnavailableError:
+                # a believed-live node died mid-phase: re-plan once
+                if rf <= 1 or attempt:
+                    raise
+        parts: List[dict] = []
+        for nid in sorted(gathered):
+            resp = gathered[nid]
+            if resp.get("fallback") or not resp.get("exact", False):
+                telemetry.inc("cluster_agg", outcome="fallback_inexact")
+                return None
+            parts.append(resp)
+        t_merge = _time.perf_counter()
+        merged = _pl.merge_partials(shape, parts)
+        if merged is None:
+            telemetry.inc("cluster_agg", outcome="fallback_tie")
+            return None
+        rows = self._project_grouped(shape, merged, session, vars)
+        self._note_merge(t_merge, len(rows))
+        if ctx is not None:
+            # per-shard partial counts land in the profile only once the
+            # pushdown is COMMITTED to answering: an abandoned attempt must
+            # not stack its counts on the replay scatter's row accounting
+            for nid in sorted(gathered):
+                resp = gathered[nid]
+                ctx.record_partials(
+                    nid, len(resp.get("groups") or []), int(resp.get("rows") or 0)
+                )
+            ctx.pushdown = {"agg": True, "groups": len(rows)}
+        telemetry.inc("cluster_agg", outcome="pushed")
+        if stm.order or stm.limit is not None or stm.start is not None or getattr(stm, "only", False):
+            post = SelectStatement(
+                [_star_field()], [Param(_ROWS)],
+                order=stm.order, limit=stm.limit, start=stm.start,
+                only=getattr(stm, "only", False),
+            )
+            out = self.ds.process(
+                Query([post]), session, dict(vars or {}, **{_ROWS: rows})
+            )
+            return {"status": out[0]["status"], "result": out[0]["result"]}
+        return _ok(rows)
+
+    def _project_grouped(self, shape, merged: List[dict], session, vars) -> List[dict]:
+        """Merged partial groups -> final projected rows (the row path's
+        `_assign_field` naming over aggregate values and global-first
+        member values)."""
+        from surrealdb_tpu.dbs.context import Context
+        from surrealdb_tpu.dbs.executor import Executor
+        from surrealdb_tpu.dbs.iterator import _assign_field
+
+        ex = Executor(self.ds, session, vars)
+        ctx = Context(ex, session)
+        ex._open(False)
+        try:
+            rows: List[dict] = []
+            for grp in merged:
+                row: dict = {}
+                for gf, val, first in zip(shape.fields, grp["values"], grp["firsts"]):
+                    _assign_field(ctx, row, gf.field, val if gf.agg is not None else first)
+                rows.append(row)
+            return rows
+        finally:
+            ex._cancel()
+
     def _scatter_select(self, stm, session, vars, knn=None, matches=None) -> dict:
         """The universal gather-then-replay strategy (see module doc)."""
         cond = getattr(stm, "cond", None)
@@ -1399,21 +1571,32 @@ class ClusterExecutor:
         inner = f"SELECT *{extra_proj} FROM {from_txt}"
         if cond is not None:
             inner += f" WHERE {cond!r}"
-        # LIMIT pushdown: safe only when the statement neither reorders nor
-        # aggregates (each shard then over-fetches exactly the global cap —
-        # still sound under replication: a record's local rank on any
-        # holding node is never worse than its global rank)
+        # LIMIT pushdown: each shard over-fetches exactly the global cap —
+        # sound because a record's local rank on any holding node is never
+        # worse than its global rank. With a lowerable ORDER BY the shards
+        # sort by the SAME resolved keys (+ id, the key-order tiebreak the
+        # coordinator's scan-order re-sort restores globally) and return
+        # per-shard top-(start+limit) candidates instead of every survivor;
+        # the replay re-sorts the union, so the merged result is the
+        # single-node result over a provable candidate superset.
         push = self._static_limit(stm, session, vars)
         if (
             push is not None
             and knn is None
             and matches is None
-            and not stm.order
             and not stm.group
             and not getattr(stm, "group_all", False)
             and not stm.split
         ):
-            inner += f" LIMIT {push}"
+            if not stm.order:
+                inner += f" LIMIT {push}"
+            else:
+                order_sql = self._order_push_sql(stm, session, vars)
+                if order_sql is not None:
+                    inner += f"{order_sql} LIMIT {push}"
+                    ctx = _STMT.get(None)
+                    if ctx is not None:
+                        ctx.pushdown = {"order_limit": push}
 
         per_node = self._scatter_sql(
             self._all_nodes(), inner, session, scatter_vars,
@@ -1462,6 +1645,47 @@ class ClusterExecutor:
         if resp["status"] == "OK":
             resp["result"] = _merge.strip_cluster_fields(resp["result"])
         return resp
+
+    def _order_push_sql(self, stm, session, vars) -> Optional[str]:
+        """` ORDER BY ...` clause for the per-shard top-(start+limit) cut,
+        or None when the statement's ORDER BY cannot be proven equivalent
+        over raw rows: keys must resolve to plain source paths (the same
+        resolver the columnar pipeline uses), over ONE table (the id
+        tiebreak below equals global key order only within one table)."""
+        from surrealdb_tpu.ops.pipeline import resolve_order_specs
+        from surrealdb_tpu.sql.value import escape_ident
+
+        if len(stm.what) != 1:
+            return None
+        if getattr(stm, "value_mode", False):
+            # VALUE-mode ordering keys on the PROJECTED value (and digs the
+            # order idiom into dict-valued cells) — no raw-doc ORDER BY the
+            # shard can run reproduces that, so the per-shard cut would not
+            # be a provable candidate superset; keep the full gather
+            return None
+        if self._rf() > 1 and self._write_degradation() > self._degradation0:
+            # a diverged replica's stale order key could survive its
+            # shard's top-k cut where the fresh copy would not — only the
+            # full-gather replay stays provably exact (see _write_degradation)
+            return None
+        targets = self._flatten_targets(self._eval_exprs(stm.what, session, vars))
+        if len(targets) != 1 or not isinstance(targets[0], Table):
+            return None
+        specs = resolve_order_specs(stm)
+        if specs is None:
+            return None
+        if not specs:
+            return ""  # ORDER BY is provably a no-op: plain LIMIT cut
+        parts = [
+            ".".join(escape_ident(n) for n in s.path.split("."))
+            + (" ASC" if s.asc else " DESC")
+            for s in specs
+        ]
+        if not any(s.path == "id" for s in specs):
+            # key-order tiebreak: a shard's cut among tied rows must match
+            # the coordinator's stable scan-order tie resolution
+            parts.append("id ASC")
+        return " ORDER BY " + ", ".join(parts)
 
     def _static_limit(self, stm, session, vars) -> Optional[int]:
         try:

@@ -8,6 +8,8 @@ coordinator can graft them into the one request-wide trace.
 Ops:
     query     {sql, ns, db, vars}            -> {results}
     ft_stats  {ns, db, tb, field, query}     -> {dc, tl, df, terms} | {missing}
+    agg_partial {sql, ns, db, tb, vars, rf, live}
+                                             -> {groups, exact, rows} | {fallback}
     expand    {ns, db, part, ids}            -> {map: repr(id) -> expansion}
     ping      {}                             -> {ok}
     bundle    {trace_limit?, full_traces?}   -> {json: <node debug bundle>}
@@ -213,6 +215,59 @@ def _op_ft_stats(ds, req):
         ex._cancel()
 
 
+def _op_agg_partial(ds, req):
+    """Per-shard partial aggregates for the cluster GROUP BY pushdown
+    (ops/pipeline.py): this node computes factorize + segment-reduce over
+    ITS rows (columnar when the mirror serves, the row-scan twin
+    otherwise) and returns per-group partials — counts, exact sums,
+    min/max with mergeability flags, mean as sum+count, and the group's
+    first member values keyed by encoded record key so the coordinator can
+    reconstruct the single-node group order and first-member semantics.
+    Under replication (`rf`/`live` in the request) rows this node is not
+    the first live replica of are excluded — a doc counts exactly once
+    across the merged partials (the ft_stats responsibility rule)."""
+    from surrealdb_tpu.dbs.context import Context
+    from surrealdb_tpu.dbs.executor import Executor
+    from surrealdb_tpu.ops.pipeline import partial_aggregate
+    from surrealdb_tpu.sql.statements import SelectStatement
+    from surrealdb_tpu.syn import parse_query
+
+    from .placement import placement_key
+
+    tb = str(req.get("tb", ""))
+    sql = str(req.get("sql", ""))
+    vars = req.get("vars") or None
+    ast = parse_query(sql)
+    if len(ast.statements) != 1 or not isinstance(ast.statements[0], SelectStatement):
+        raise SurrealError("agg_partial expects one SELECT statement")
+    stm = ast.statements[0]
+    owner_ok = None
+    rf = int(req.get("rf") or 1)
+    live = [str(n) for n in (req.get("live") or [])]
+    node = getattr(ds, "cluster", None)
+    if rf > 1 and live and node is not None:
+        ring, self_id = node.ring, node.node_id
+
+        def owner_ok(rid):  # first-live-replica responsibility
+            owners = ring.owners_of_key(placement_key(rid.tb, rid.id), rf)
+            serving = next((n for n in owners if n in live), None)
+            return serving == self_id
+
+    sess = _session(req)
+    ex = Executor(ds, sess, vars)
+    ctx = Context(ex, sess)
+    for name, value in (vars or {}).items():
+        ctx.set_param(name, value)
+    ex._open(False)
+    try:
+        out = partial_aggregate(ctx, tb, stm, owner_ok=owner_ok)
+    finally:
+        ex._cancel()
+    if out is None:
+        return {"fallback": True}
+    return out
+
+
 def _op_bundle(ds, req):
     """This node's full debug bundle for the federated
     `/debug/bundle?cluster=1` merge — JSON-encoded (see module doc)."""
@@ -254,6 +309,7 @@ _OPS = {
     "query": _op_query,
     "expand": _op_expand,
     "ft_stats": _op_ft_stats,
+    "agg_partial": _op_agg_partial,
     "bundle": _op_bundle,
     "metrics": _op_metrics,
     "events": _op_events,

@@ -64,7 +64,28 @@ def select_compute(ctx, stm) -> Any:
         if stm.explain:
             from surrealdb_tpu.idx.planner import explain
 
-            plan = explain(c, stm, sources, full=stm.explain_full)
+            # whole-pipeline columnar lowering renders its own plan row
+            # (strategy columnar-pipeline + stages); EXPLAIN ANALYZE below
+            # then executes it for real and the per-stage rows+ms arrive
+            # via plan notes on the Execute row
+            plan = None
+            if len(sources) == 1 and isinstance(sources[0], ITable):
+                from surrealdb_tpu.ops.pipeline import explain_pipeline
+
+                detail = explain_pipeline(c, stm, sources[0].tb)
+                if detail is not None:
+                    plan = [
+                        {
+                            "detail": {"plan": detail, "table": sources[0].tb},
+                            "operation": "Iterate Index",
+                        }
+                    ]
+                    if stm.explain_full:
+                        plan.append(
+                            {"detail": {"type": "Memory"}, "operation": "Collector"}
+                        )
+            if plan is None:
+                plan = explain(c, stm, sources, full=stm.explain_full)
             if not getattr(stm, "explain_analyze", False):
                 return plan
             # EXPLAIN ANALYZE: the plan AND the execution it describes —
@@ -110,11 +131,22 @@ def select_compute(ctx, stm) -> Any:
         if fast is not None:
             return _only(stm, fast)
 
+        # whole-pipeline columnar lowering (ops/pipeline.py): ORDER BY +
+        # START/LIMIT as mask -> argsort/top-k, GROUP BY aggregates as
+        # factorize + segment-reduce, plain projections read off the
+        # columns — declines (counted) keep the planner/row path
+        if len(sources) == 1 and isinstance(sources[0], ITable):
+            from surrealdb_tpu.ops.pipeline import run_pipeline
+
+            res = run_pipeline(c, stm, sources[0].tb)
+            if res is not None:
+                return _only(stm, res[0])
+
         from surrealdb_tpu.idx.planner import plan_sources
 
         sources = plan_sources(c, stm, sources)
 
-        from surrealdb_tpu.dbs.iterator import IIndex, ITable
+        from surrealdb_tpu.dbs.iterator import IIndex
         from surrealdb_tpu.idx.planner import OrderPushdownBailout
 
         it = Iterator(c, stm, "select")

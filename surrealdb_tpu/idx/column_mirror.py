@@ -99,6 +99,13 @@ class Column:
             np.asarray(self._strs > c, dtype=bool),
         )
 
+    def str_array(self) -> np.ndarray:
+        """The string plane ("" where not a string) — the pipeline's sort /
+        group-key rank source and cell reconstruction."""
+        if self._strs is None:
+            self._strs = np.full(len(self.tags), "", dtype=object)
+        return self._strs
+
     def str_nonempty(self) -> np.ndarray:
         if self._nonempty is None:
             if self._strs is None:
@@ -427,8 +434,12 @@ class ColumnMirrors:
 
                     telemetry.inc("column_mirror_rebuilds", cause="ingest_prewarm")
                     self.build(ds, *key3)
-        except Exception:
-            pass  # best-effort: the lazy query-time path stays intact
+        except Exception:  # noqa: BLE001 — best-effort; query path stays intact
+            from surrealdb_tpu import telemetry
+
+            # counted, not silent: a repeatedly-failing prewarm shows up on
+            # /metrics instead of vanishing (the bg task record has details)
+            telemetry.inc("prewarm_errors", subsystem="column_mirror")
         finally:
             with self._lock:
                 self._running.discard(key3)
@@ -783,28 +794,53 @@ class ColumnScanPlan:
     surviving rows stream out in key order, docs fetched per block. The
     iterator skips re-evaluating the WHERE (`cond_satisfied`) — rows the
     mask algebra can't judge are re-checked here, per row, before yielding,
-    so output is always identical to the row path."""
+    so output is always identical to the row path.
+
+    With `order_specs` (the planner lowered the statement's ORDER BY onto
+    mirror columns) survivors stream in the statement's ORDER instead of
+    key order and the plan advertises `provides_order`: the iterator's
+    LIMIT fast path then stops pulling after start+limit rows (late
+    materialization — only the top rows' documents decode) and the
+    postprocess skips the re-sort. If the mirror cannot serve, the promised
+    order is unkeepable — OrderPushdownBailout re-runs the statement on the
+    plain scan + post-sort path."""
 
     cond_satisfied = True
 
-    def __init__(self, tb: str, stm, compiled: CompiledPredicate):
+    def __init__(self, tb: str, stm, compiled: Optional[CompiledPredicate],
+                 order_specs=None):
         self.tb = tb
         self.stm = stm
         self.compiled = compiled
+        self.order_specs = order_specs or None
+        self.provides_order = bool(order_specs)
 
     def explain(self) -> dict:
-        return {
-            "table": self.tb,
-            "strategy": "columnar-scan",
-            "predicate": self.compiled.source,
-        }
+        out: Dict[str, Any] = {"table": self.tb}
+        if self.order_specs:
+            out["strategy"] = "columnar-pipeline"
+            out["stages"] = ["mask", "sort", "materialize"]
+            out["order"] = [
+                {"key": s.path, "direction": "ASC" if s.asc else "DESC"}
+                for s in self.order_specs
+            ]
+        else:
+            out["strategy"] = "columnar-scan"
+        if self.compiled is not None:
+            out["predicate"] = self.compiled.source
+        return out
 
     def iterate(self, ctx):
         from surrealdb_tpu import telemetry
 
         with telemetry.span("scan_columnar", table=self.tb):
-            res = columnar_mask(ctx, self.tb, self.compiled)
+            res = self._mask(ctx)
         if res is None:
+            if self.order_specs:
+                # the promised ORDER cannot be produced — re-plan row path
+                from surrealdb_tpu.idx.planner import OrderPushdownBailout
+
+                raise OrderPushdownBailout()
             telemetry.inc("scan_strategy", strategy="row_fallback")
             yield from self._row_scan(ctx)
             return
@@ -826,24 +862,78 @@ class ColumnScanPlan:
             # delta-appended rows sit past the key-ordered prefix: stream
             # survivors in record-key order so output matches the row path
             cand = order[want[order]]
+        t_sort = _time.perf_counter()
+        doc_cache: dict = {}
+        if self.order_specs:
+            from surrealdb_tpu.ops.pipeline import order_permutation
+
+            cand = order_permutation(
+                ctx, self.tb, mirror, cand, self.order_specs, doc_cache,
+                value_mode=getattr(self.stm, "value_mode", False),
+            )
+            if cand is None:
+                from surrealdb_tpu.idx.planner import OrderPushdownBailout
+
+                raise OrderPushdownBailout()
+        note = {
+            "table": self.tb,
+            "plan": "ColumnScanPlan",
+            "strategy": "columnar-pipeline" if self.order_specs else "columnar-scan",
+            "stages": {
+                "mask": {"rows": int(cand.size)},
+            },
+        }
+        if self.order_specs:
+            note["stages"]["sort"] = {
+                "rows": int(cand.size),
+                "keys": [s.path for s in self.order_specs],
+                "ms": round((_time.perf_counter() - t_sort) * 1e3, 3),
+            }
         block = max(cnf.COLUMN_BLOCK_SIZE, 1)
         from surrealdb_tpu.sql.value import truthy
 
         cond = self.stm.cond
-        for lo in range(0, cand.size, block):
-            ctx.check_deadline()
-            for i in cand[lo : lo + block]:
-                i = int(i)
-                rid = Thing(self.tb, ids[i])
-                doc = txn.get_record(ns, db, self.tb, ids[i])
-                if doc is None:
-                    continue
-                if needs_row[i]:
-                    # mixed-type row: the mask abstained — row-path check
-                    with ctx.with_doc_value(doc, rid=rid) as c:
-                        if not truthy(cond.compute(c)):
-                            continue
-                yield rid, doc, None
+        yielded = 0
+        t_mat = _time.perf_counter()
+        try:
+            for lo in range(0, cand.size, block):
+                ctx.check_deadline()
+                for i in cand[lo : lo + block]:
+                    i = int(i)
+                    rid = Thing(self.tb, ids[i])
+                    doc = doc_cache.get(i)
+                    if doc is None:
+                        doc = txn.get_record(ns, db, self.tb, ids[i])
+                    if doc is None:
+                        continue
+                    if needs_row[i]:
+                        # mixed-type row: the mask abstained — row-path check
+                        with ctx.with_doc_value(doc, rid=rid) as c:
+                            if not truthy(cond.compute(c)):
+                                continue
+                    yielded += 1
+                    yield rid, doc, None
+        finally:
+            note["stages"]["materialize"] = {
+                "rows": yielded,
+                "ms": round((_time.perf_counter() - t_mat) * 1e3, 3),
+            }
+            telemetry.note_plan(note)
+
+    def _mask(self, ctx):
+        """(mask, needs_row, mirror) — the cond-less variant serves an
+        all-true mask so ORDER BY+LIMIT pushdown works without a WHERE."""
+        if self.compiled is not None:
+            return columnar_mask(ctx, self.tb, self.compiled)
+        ns, db = ctx.ns_db()
+        registry = getattr(ctx.ds(), "column_mirrors", None)
+        if registry is None:
+            return None
+        mirror = registry.serveable(ctx, (ns, db, self.tb))
+        if mirror is None or mirror.n == 0:
+            return None
+        ones = np.ones(mirror.n, dtype=bool)
+        return ones, np.zeros(mirror.n, dtype=bool), mirror
 
     def _row_scan(self, ctx):
         """Exact row-path twin (mirror unavailable): scan + per-row WHERE,
@@ -853,9 +943,10 @@ class ColumnScanPlan:
 
         cond = self.stm.cond
         for rid, doc in scan_table(ctx, self.tb):
-            with ctx.with_doc_value(doc, rid=rid) as c:
-                if not truthy(cond.compute(c)):
-                    continue
+            if cond is not None:
+                with ctx.with_doc_value(doc, rid=rid) as c:
+                    if not truthy(cond.compute(c)):
+                        continue
             yield rid, doc, None
 
 
@@ -925,30 +1016,48 @@ def try_columnar_count(ctx, stm, sources) -> Optional[list]:
 
 def column_scan_plan(ctx, stm, tb: str):
     """Planner hook: a ColumnScanPlan when the WHERE lowers onto columns and
-    the table is big enough to pay for mirroring; None keeps the row path."""
+    the table is big enough to pay for mirroring; None keeps the row path.
+    When the statement's ORDER BY also lowers (plain multi-key paths with
+    no grouping/splitting), the plan sorts survivors columnar and
+    advertises `provides_order` — the iterator's LIMIT fast path then
+    composes with the pushed sort instead of re-sorting (ISSUE 13)."""
     if not cnf.COLUMN_MIRROR:
         return None
     cond = getattr(stm, "cond", None)
-    if cond is None:
-        return None
     from surrealdb_tpu.iam.check import perms_apply
 
     if perms_apply(ctx):
         return None  # per-record PERMISSIONS must see every document
-    from surrealdb_tpu.ops.predicates import compile_where
+    compiled = None
+    if cond is not None:
+        from surrealdb_tpu.ops.predicates import compile_where
 
-    compiled = compile_where(ctx, cond)
-    if compiled is None:
-        return None
-    ns, db = ctx.ns_db()
-    txn = ctx.txn()
+        compiled = compile_where(ctx, cond)
+        if compiled is None:
+            return None
+    order_specs = None
+    if (
+        getattr(stm, "order", None)
+        and not getattr(stm, "group", None)
+        and not getattr(stm, "group_all", False)
+        and not getattr(stm, "split", None)
+    ):
+        from surrealdb_tpu.ops.pipeline import resolve_order_specs
+
+        specs = resolve_order_specs(stm)
+        if specs:
+            order_specs = specs
+    if compiled is None and not order_specs:
+        return None  # nothing lowers: keep the plain scan
     registry = getattr(ctx.ds(), "column_mirrors", None)
     if registry is None:
         return None
-    if registry.get((ns, db, tb)) is None:
-        # not yet mirrored: only worth building above the row floor
-        pre = keys.thing_prefix(ns, db, tb)
-        head = txn.keys(pre, prefix_end(pre), cnf.COLUMN_MIRROR_MIN_ROWS)
-        if len(head) < cnf.COLUMN_MIRROR_MIN_ROWS:
-            return None
-    return ColumnScanPlan(tb, stm, compiled)
+    from surrealdb_tpu.ops.pipeline import mirror_floor_ok
+
+    if not mirror_floor_ok(ctx, registry, tb):
+        return None
+    if order_specs:
+        from surrealdb_tpu import telemetry
+
+        telemetry.inc("column_pipeline", outcome="order_planned")
+    return ColumnScanPlan(tb, stm, compiled, order_specs)

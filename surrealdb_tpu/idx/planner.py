@@ -283,7 +283,13 @@ def plan_sources(ctx, stm, sources: List[Any]) -> List[Any]:
                 note = {"table": s.tb, "plan": strategy}
                 if strategy == "ColumnScanPlan":
                     # a slow columnar statement must name what was lowered
-                    note["predicate"] = plan.compiled.source
+                    if plan.compiled is not None:
+                        note["predicate"] = plan.compiled.source
+                    if plan.order_specs:
+                        note["order"] = [
+                            {"key": s.path, "direction": "ASC" if s.asc else "DESC"}
+                            for s in plan.order_specs
+                        ]
                 if isinstance(plan, KnnPlan):
                     # a kNN statement's latency is governed by the dispatch
                     # pipeline: pin the active knobs into the plan note so a

@@ -501,5 +501,14 @@ class Datastore:
             self.graph_mirrors.shutdown()
             bg.shutdown(owner=id(self))
         except Exception:  # noqa: BLE001 — teardown must never mask close()
-            pass
+            # counted, not silent: a teardown failure that skipped the rest
+            # of the shutdown chain is a leak suspect worth a metric. The
+            # recording itself is best-effort (interpreter shutdown can have
+            # torn modules down) — backend.close() below must still run.
+            import contextlib
+
+            with contextlib.suppress(Exception):
+                from surrealdb_tpu import telemetry
+
+                telemetry.inc("teardown_errors", stage="datastore_close")
         self.backend.close()

@@ -384,13 +384,13 @@ def collect_node_metrics(ds=None) -> None:
 
         bg.export_gauges()
     except Exception:  # noqa: BLE001 — metrics must never fail a scrape
-        pass
+        inc("scrape_section_errors", section="bg_gauges")
     if ds is not None:
         try:
             for subsystem, nbytes in mirror_memory_bytes(ds).items():
                 gauge_set("mirror_memory_bytes", nbytes, subsystem=subsystem)
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 — metrics must never fail a scrape
+            inc("scrape_section_errors", section="mirror_memory")
     jit = _jit_cache_stats()
     if jit is not None:
         hits, misses, size = jit
@@ -410,7 +410,7 @@ def collect_node_metrics(ds=None) -> None:
                         device=str(d.id),
                     )
         except Exception:  # noqa: BLE001 — metrics must never fail a scrape
-            pass
+            inc("scrape_section_errors", section="device_memory")
 
 
 def mirror_memory_bytes(ds) -> Dict[str, int]:

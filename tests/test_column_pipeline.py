@@ -1,0 +1,402 @@
+"""Vectorized SELECT pipeline (ops/pipeline.py): whole-pipeline columnar
+lowering — ORDER BY / GROUP BY aggregates / START-LIMIT / projections over
+the column mirror, plus the cluster partial-aggregate pushdown.
+
+The ISSUE 13 acceptance bars:
+  - randomized cross-path property tests: multi-key ORDER BY (mixed
+    ASC/DESC, NONE/missing/type-mixed cells, ties), GROUP BY with every
+    lowered aggregate, START/LIMIT boundaries — columnar == row path;
+  - unlowerable clauses decline (counted in column_pipeline{outcome}) and
+    fall back with identical output;
+  - the columnar top-k sets order_pushed: LIMIT early-exit composes with
+    the pushed sort (bounded doc decodes, no spill re-sort);
+  - EXPLAIN renders strategy columnar-pipeline; EXPLAIN ANALYZE carries
+    per-stage rows+ms plan notes and the cost decision;
+  - 3-node cluster parity vs the single-node twin with partial-aggregate
+    merge engaged (no full-row shipping) and exact-merge refusal falling
+    back to the replay path.
+"""
+
+import json
+import random
+
+import pytest
+
+import jax.numpy  # noqa: F401 — concurrent lazy first-import races otherwise
+
+from surrealdb_tpu import cnf, telemetry
+from surrealdb_tpu.sql.value import Thing
+
+
+@pytest.fixture(autouse=True)
+def _small_mirror_floor():
+    saved = (
+        cnf.COLUMN_MIRROR_MIN_ROWS,
+        cnf.COLUMN_MIRROR,
+        cnf.COLUMN_REBUILD_DEBOUNCE_SECS,
+    )
+    cnf.COLUMN_MIRROR_MIN_ROWS = 4
+    cnf.COLUMN_MIRROR = True
+    cnf.COLUMN_REBUILD_DEBOUNCE_SECS = 0.05
+    yield
+    (
+        cnf.COLUMN_MIRROR_MIN_ROWS,
+        cnf.COLUMN_MIRROR,
+        cnf.COLUMN_REBUILD_DEBOUNCE_SECS,
+    ) = saved
+
+
+def ok(r):
+    assert r["status"] == "OK", r
+    return r["result"]
+
+
+def norm(x):
+    return json.dumps(x, default=repr, sort_keys=True)
+
+
+def both_paths(ds, sql, vars=None):
+    cnf.COLUMN_MIRROR = True
+    col = ok(ds.execute(sql, vars=vars)[-1])
+    cnf.COLUMN_MIRROR = False
+    row = ok(ds.execute(sql, vars=vars)[-1])
+    cnf.COLUMN_MIRROR = True
+    return col, row
+
+
+def _pipeline_count(outcome: str) -> float:
+    return telemetry.get_counter("column_pipeline", outcome=outcome)
+
+
+# ------------------------------------------------------------------ data
+def _rows(rng: random.Random, n: int):
+    out = []
+    for i in range(n):
+        r = {"id": i}
+        roll = rng.random()
+        if roll < 0.45:
+            # heavy ties + int/float mixing + NaN-free plane
+            r["a"] = rng.choice([0, 1, 2, 2.0, 3, 5, -7, 2.5, -0.0, 1e18])
+        elif roll < 0.58:
+            r["a"] = rng.choice(["x", "yy", "", "Zed", "x"])
+        elif roll < 0.66:
+            r["a"] = rng.choice([True, False])
+        elif roll < 0.72:
+            r["a"] = None  # NULL
+        elif roll < 0.78:
+            pass  # missing -> NONE
+        elif roll < 0.88:
+            r["a"] = [rng.randint(0, 3)]  # type-mixed: OTHER cells
+        else:
+            r["a"] = {"y": rng.randint(0, 5)}
+        if rng.random() < 0.85:
+            r["b"] = rng.choice(["alpha", "beta", "gamma", "", "delta"])
+        if rng.random() < 0.7:
+            r["flag"] = rng.random() < 0.5
+        if rng.random() < 0.6:
+            r["v"] = rng.choice([1, 2, 3, 17, 2.0, -1.5, 0, float("nan")])
+        if rng.random() < 0.3:
+            r["nest"] = {"x": rng.randint(0, 9)}
+        out.append(r)
+    return out
+
+
+# ------------------------------------------------------------------ order
+def test_order_by_property_battery(ds):
+    rng = random.Random(1313)
+    ds.execute("DEFINE TABLE t SCHEMALESS")
+    ok(ds.execute("INSERT INTO t $rows", vars={"rows": _rows(rng, 350)})[-1])
+    stmts = [
+        "SELECT VALUE id FROM t ORDER BY a LIMIT 10",
+        "SELECT VALUE id FROM t ORDER BY a DESC LIMIT 10",
+        "SELECT VALUE id FROM t ORDER BY a ASC, b DESC LIMIT 25",
+        "SELECT VALUE id FROM t ORDER BY b DESC, a ASC, flag DESC LIMIT 40",
+        "SELECT VALUE id FROM t WHERE flag = true ORDER BY v DESC LIMIT 12",
+        "SELECT id, a, b FROM t WHERE a > 0 ORDER BY a DESC, b LIMIT 9 START 4",
+        "SELECT VALUE a FROM t ORDER BY a LIMIT 400",  # value-mode, dict cells
+        "SELECT a AS x, id FROM t ORDER BY x, id LIMIT 15",  # alias resolution
+        "SELECT VALUE id FROM t ORDER BY nest.x, a LIMIT 20",
+        "SELECT * FROM t WHERE v >= 0 ORDER BY v, b LIMIT 7",  # plan-path star
+        "SELECT VALUE id FROM t ORDER BY nosuch, a LIMIT 6",  # NONE key drops
+    ]
+    for sql in stmts:
+        col, row = both_paths(ds, sql)
+        assert norm(col) == norm(row), sql
+    assert _pipeline_count("ordered") > 0
+
+
+def test_start_limit_boundaries(ds):
+    ds.execute("DEFINE TABLE t SCHEMALESS")
+    rows = [{"id": i, "v": (i * 7) % 23} for i in range(80)]
+    ok(ds.execute("INSERT INTO t $rows", vars={"rows": rows})[-1])
+    for sql in (
+        "SELECT VALUE id FROM t ORDER BY v LIMIT 0",
+        "SELECT VALUE id FROM t ORDER BY v LIMIT 1",
+        "SELECT VALUE id FROM t ORDER BY v LIMIT 80",
+        "SELECT VALUE id FROM t ORDER BY v LIMIT 500",
+        "SELECT VALUE id FROM t ORDER BY v LIMIT 5 START 0",
+        "SELECT VALUE id FROM t ORDER BY v LIMIT 5 START 79",
+        "SELECT VALUE id FROM t ORDER BY v LIMIT 5 START 80",
+        "SELECT VALUE id FROM t ORDER BY v LIMIT 5 START 200",
+        "SELECT VALUE id FROM t ORDER BY v START 76",
+        "SELECT VALUE id FROM t WHERE v > 5 LIMIT 7 START 3",  # no ORDER
+    ):
+        col, row = both_paths(ds, sql)
+        assert norm(col) == norm(row), sql
+    # non-numeric LIMIT errors identically on both paths
+    cnf.COLUMN_MIRROR = True
+    e1 = ds.execute("SELECT VALUE id FROM t ORDER BY v LIMIT 'x'")[-1]
+    cnf.COLUMN_MIRROR = False
+    e2 = ds.execute("SELECT VALUE id FROM t ORDER BY v LIMIT 'x'")[-1]
+    cnf.COLUMN_MIRROR = True
+    assert e1["status"] == e2["status"] == "ERR"
+    assert e1["result"] == e2["result"]
+
+
+# ------------------------------------------------------------------ group
+def test_group_by_every_lowered_aggregate(ds):
+    rng = random.Random(77)
+    ds.execute("DEFINE TABLE t SCHEMALESS")
+    ok(ds.execute("INSERT INTO t $rows", vars={"rows": _rows(rng, 300)})[-1])
+    stmts = [
+        "SELECT b, count() FROM t GROUP BY b",
+        "SELECT b, count(flag) AS cf FROM t GROUP BY b",
+        "SELECT b, math::sum(v) AS s FROM t GROUP BY b",
+        "SELECT b, math::min(v) AS mn, math::max(v) AS mx FROM t GROUP BY b",
+        "SELECT b, math::mean(v) AS avg FROM t GROUP BY b",
+        # type-mixed aggregate column: strings/lists/objects excluded,
+        # NaN folds, int/float ties — all byte-identical
+        "SELECT flag, math::sum(a) AS s, math::min(a) AS mn, math::max(a) AS mx FROM t GROUP BY flag",
+        "SELECT a, count() FROM t GROUP BY a",  # type-mixed GROUP keys
+        "SELECT flag, b, count() FROM t GROUP BY flag, b",
+        "SELECT count() FROM t WHERE v > 1 GROUP ALL",
+        "SELECT count(), math::sum(v), math::mean(v) FROM t GROUP ALL",
+        "SELECT b, count() AS n FROM t GROUP BY b ORDER BY n DESC, b LIMIT 3",
+        "SELECT nest.x, count() FROM t GROUP BY nest.x",
+        "SELECT b, count() FROM t WHERE a = 'no-match-at-all' GROUP BY b",
+    ]
+    for sql in stmts:
+        col, row = both_paths(ds, sql)
+        assert norm(col) == norm(row), sql
+    assert _pipeline_count("grouped") > 0
+
+
+def test_group_key_numeric_collapse_parity(ds):
+    """-0.0 / 0 / 0.0 / true / 1 / 1.0 group-key collapse must match the
+    row path's dict equality exactly (np.unique(axis=0) compares rows
+    bitwise — the factorizer normalizes the zero signs)."""
+    ds.execute("DEFINE TABLE z SCHEMALESS")
+    rows = [{"id": i, "g": [-0.0, 0, 0.0, 1, True, 1.0][i % 6], "v": i} for i in range(60)]
+    ok(ds.execute("INSERT INTO z $rows", vars={"rows": rows})[-1])
+    col, row = both_paths(ds, "SELECT g, count() AS n, math::sum(v) AS s FROM z GROUP BY g")
+    assert norm(col) == norm(row)
+    assert len(col) == 2  # {-0.0-class, 1-class}
+
+
+def test_group_sum_exact_past_f64_window(ds):
+    """All-int sums whose fold leaves the f64-exact window re-fold in
+    python — byte-identical to the row path's arbitrary-precision sum."""
+    ds.execute("DEFINE TABLE big SCHEMALESS")
+    n = (1 << 52) + 1  # two of these overflow 2^53 mid-fold
+    rows = [{"id": i, "g": i % 2, "v": n} for i in range(8)]
+    ok(ds.execute("INSERT INTO big $rows", vars={"rows": rows})[-1])
+    col, row = both_paths(ds, "SELECT g, math::sum(v) AS s FROM big GROUP BY g")
+    assert norm(col) == norm(row)
+    assert col[0]["s"] == 4 * n  # exact int, not a rounded float
+
+
+# ------------------------------------------------------------------ declines
+def test_unlowerable_clauses_fall_back_identically(ds):
+    rng = random.Random(9)
+    ds.execute("DEFINE TABLE t SCHEMALESS")
+    ok(ds.execute("INSERT INTO t $rows", vars={"rows": _rows(rng, 150)})[-1])
+    ds.execute("DEFINE TABLE u SCHEMALESS")
+    ok(ds.execute(
+        "INSERT INTO u $rows",
+        vars={"rows": [{"id": i, "b": f"s{i % 5}", "v": i % 11} for i in range(80)]},
+    )[-1])
+    before = _pipeline_count("decline_where")
+    for sql in (
+        "SELECT b, math::median(v) AS m FROM t GROUP BY b",  # aggregate outside set
+        "SELECT b, math::stddev(v) FROM t GROUP BY b",
+        "SELECT string::uppercase(b) AS up, id FROM u ORDER BY up LIMIT 5",
+        "SELECT id, v FROM u WHERE string::len(b) > 1 ORDER BY v LIMIT 5",
+        "SELECT b, count() FROM t SPLIT b GROUP BY b",
+    ):
+        col, row = both_paths(ds, sql)
+        assert norm(col) == norm(row), sql
+    assert _pipeline_count("decline_where") > before
+    # the decline outcomes are all counted under one label key
+    outcomes = telemetry.counters_matching("column_pipeline")
+    assert outcomes, "column_pipeline{outcome} never incremented"
+
+
+def test_with_noindex_keeps_row_path(ds):
+    ds.execute("DEFINE TABLE t SCHEMALESS")
+    ok(ds.execute("INSERT INTO t $rows", vars={"rows": [{"id": i, "v": i % 5} for i in range(40)]})[-1])
+    plan = ok(ds.execute("SELECT VALUE id FROM t WITH NOINDEX ORDER BY v LIMIT 3 EXPLAIN")[-1])
+    assert plan[0]["operation"] == "Iterate Table"
+    col, row = both_paths(ds, "SELECT VALUE id FROM t WITH NOINDEX ORDER BY v LIMIT 3")
+    assert norm(col) == norm(row)
+
+
+# ------------------------------------------------------------------ order_pushed composition
+def test_columnar_topk_sets_order_pushed_and_bounds_decodes(ds):
+    """The satellite fix: a lowered sort sets order_pushed, so the LIMIT
+    fast path stops materializing past start+limit (late materialization)
+    instead of decoding every survivor and re-sorting."""
+    ds.execute("DEFINE TABLE t SCHEMALESS")
+    rows = [{"id": i, "v": (i * 37) % 1009, "pad": "x" * 50} for i in range(500)]
+    ok(ds.execute("INSERT INTO t $rows", vars={"rows": rows})[-1])
+    an = ok(ds.execute("SELECT * FROM t ORDER BY v DESC LIMIT 5 EXPLAIN ANALYZE")[-1])
+    assert an[0]["detail"]["plan"]["strategy"] == "columnar-pipeline"
+    execute = an[-1]
+    assert execute["operation"] == "Execute" and execute["detail"]["rows"] == 5
+    notes = execute["detail"]["plan_notes"]
+    stages = next(
+        n["stages"] for n in notes
+        if n.get("plan") == "ColumnScanPlan" and "stages" in n
+    )
+    # the sort ranked every survivor; only start+limit rows materialized
+    assert stages["sort"]["rows"] == 500
+    assert stages["materialize"]["rows"] == 5
+    got = ok(ds.execute("SELECT VALUE v FROM t ORDER BY v DESC LIMIT 5")[-1])
+    assert got == sorted((r["v"] for r in rows), reverse=True)[:5]
+
+
+def test_ordered_limit_composes_with_spill_buffer(ds, monkeypatch):
+    """With a tiny external-sort buffer, an ordered+limited columnar
+    statement must not spill-and-resort: the pushed sort bounds the result
+    set below the buffer."""
+    from surrealdb_tpu.dbs.store import ResultStore
+
+    monkeypatch.setattr(cnf, "EXTERNAL_SORTING_BUFFER_LIMIT", 50)
+    spills = {"n": 0}
+    orig = ResultStore._spill
+
+    def counting(self):
+        spills["n"] += 1
+        return orig(self)
+
+    monkeypatch.setattr(ResultStore, "_spill", counting)
+    ds.execute("DEFINE TABLE t SCHEMALESS")
+    rows = [{"id": i, "v": (i * 13) % 251} for i in range(300)]
+    ok(ds.execute("INSERT INTO t $rows", vars={"rows": rows})[-1])
+    got = ok(ds.execute("SELECT v FROM t ORDER BY v DESC LIMIT 10")[-1])
+    assert [r["v"] for r in got] == sorted((r["v"] for r in rows), reverse=True)[:10]
+    assert spills["n"] == 0, "ordered+limited columnar statement spilled"
+
+
+# ------------------------------------------------------------------ explain
+def test_explain_renders_pipeline_stages(ds):
+    ds.execute("DEFINE TABLE t SCHEMALESS")
+    ok(ds.execute("INSERT INTO t $rows", vars={"rows": [{"id": i, "g": i % 3, "v": i} for i in range(60)]})[-1])
+    plan = ok(ds.execute("SELECT g, count() FROM t GROUP BY g EXPLAIN")[-1])
+    d = plan[0]["detail"]["plan"]
+    assert d["strategy"] == "columnar-pipeline"
+    assert d["stages"] == ["mask", "factorize", "segment-reduce", "materialize"]
+    assert d["aggregates"] == ["count()"]
+    plan = ok(ds.execute("SELECT id, v FROM t WHERE v > 5 ORDER BY v DESC LIMIT 3 EXPLAIN")[-1])
+    d = plan[0]["detail"]["plan"]
+    assert d["strategy"] == "columnar-pipeline"
+    assert d["order"] == [{"key": "v", "direction": "DESC"}]
+    an = ok(ds.execute("SELECT g, count() FROM t GROUP BY g EXPLAIN ANALYZE")[-1])
+    notes = an[-1]["detail"]["plan_notes"]
+    pn = next(n for n in notes if n.get("plan") == "ColumnPipeline")
+    assert {"mask", "reduce", "materialize"} <= set(pn["stages"])
+    assert all("ms" in s for s in pn["stages"].values())
+    assert pn["cost"]["decision"] == "columnar"
+
+
+# ------------------------------------------------------------------ cluster
+def test_cluster_pipeline_parity_and_pushdown():
+    from surrealdb_tpu.cluster import ClusterConfig, attach
+    from surrealdb_tpu.dbs.session import Session
+    from surrealdb_tpu.kvs.ds import Datastore
+    from surrealdb_tpu.net.server import serve
+
+    servers = [
+        serve("memory", port=0, auth_enabled=False).start_background()
+        for _ in range(3)
+    ]
+    nodes = [{"id": f"n{i + 1}", "url": s.url} for i, s in enumerate(servers)]
+    dss = [s.httpd.RequestHandlerClass.ds for s in servers]
+    for i, node_ds in enumerate(dss):
+        attach(node_ds, ClusterConfig(nodes, f"n{i + 1}", secret="t"))
+    ref = Datastore("memory")
+    s = Session.owner("t", "t")
+    try:
+        rng = random.Random(4242)
+        rows = []
+        for i in range(240):
+            r = {"id": i, "grp": i % 7, "n": rng.randint(0, 50), "f": rng.random() < 0.5}
+            if rng.random() < 0.4:
+                r["s"] = rng.choice(["p", "q", "r"])
+            rows.append(r)
+        for t in (ref, dss[0]):
+            ok(t.execute("DEFINE TABLE it SCHEMALESS", s)[0])
+            ok(t.execute("INSERT INTO it $rows", s, {"rows": [dict(x) for x in rows]})[0])
+        pushed0 = telemetry.get_counter("cluster_agg", outcome="pushed")
+        stmts = [
+            "SELECT grp, count() FROM it GROUP BY grp",
+            "SELECT grp, count() AS c, math::sum(n) AS sn, math::min(n) AS mn, "
+            "math::max(n) AS mx, math::mean(n) AS avg FROM it GROUP BY grp ORDER BY grp",
+            "SELECT count() FROM it GROUP ALL",
+            "SELECT f, s, count() FROM it WHERE n > 10 GROUP BY f, s ORDER BY count DESC LIMIT 3",
+            "SELECT math::sum(n) FROM it WHERE f = true GROUP ALL",
+            "SELECT VALUE id FROM it WHERE n > 25 ORDER BY n DESC, id ASC LIMIT 9",
+            "SELECT id, n FROM it ORDER BY n ASC LIMIT 5 START 2",
+            "SELECT VALUE id FROM it ORDER BY n DESC, grp ASC LIMIT 11",
+        ]
+        for sql in stmts:
+            a = ref.execute(sql, s)
+            b = dss[0].execute(sql, s)
+            assert [r["status"] for r in a] == [r["status"] for r in b], sql
+            assert norm([r["result"] for r in a]) == norm([r["result"] for r in b]), sql
+        assert telemetry.get_counter("cluster_agg", outcome="pushed") > pushed0
+
+        # EXPLAIN ANALYZE: the Shard rows carry partial-aggregate counts
+        # and the scatter names the pushdown — no full-row shipping
+        an = ok(dss[0].execute("SELECT grp, count() FROM it GROUP BY grp EXPLAIN ANALYZE", s)[0])
+        scatter = an[0]["detail"]
+        assert scatter["kind"] == "agg" and scatter["pushdown"]["agg"] is True
+        shard_rows = [op["detail"] for op in an if op["operation"] == "Shard"]
+        assert len(shard_rows) == 3
+        assert all(sh["partials"] == 7 for sh in shard_rows)
+        merge = next(op["detail"] for op in an if op["operation"] == "Merge")
+        assert merge["rows_gathered"] == 7  # groups, not 240 rows
+
+        # float sums cannot merge byte-exactly: the statement must fall
+        # back to the replay path and STILL answer identically
+        for t in (ref, dss[0]):
+            ok(t.execute(
+                "INSERT INTO it $rows", s,
+                {"rows": [{"id": 1000 + i, "grp": i % 7, "n": 0.5 + i} for i in range(30)]},
+            )[0])
+        fb0 = telemetry.get_counter("cluster_agg", outcome="fallback_inexact")
+        sql = "SELECT grp, math::sum(n) AS sn FROM it GROUP BY grp"
+        a = ref.execute(sql, s)
+        b = dss[0].execute(sql, s)
+        assert norm([r["result"] for r in a]) == norm([r["result"] for r in b])
+        assert telemetry.get_counter("cluster_agg", outcome="fallback_inexact") > fb0
+    finally:
+        ref.close()
+        for srv in servers:
+            srv.shutdown()
+        for node_ds in dss:
+            node_ds.close()
+
+
+# ------------------------------------------------------------------ staleness under the pipeline
+def test_pipeline_never_serves_stale_after_commit(ds):
+    ds.execute("DEFINE TABLE t SCHEMALESS")
+    ok(ds.execute("INSERT INTO t $rows", vars={"rows": [{"id": i, "v": i % 10} for i in range(60)]})[-1])
+    ok(ds.execute("SELECT id, v FROM t ORDER BY v LIMIT 5")[-1])  # builds
+    ds.execute("CREATE t:900 SET v = -1")
+    got = ok(ds.execute("SELECT id, v FROM t ORDER BY v LIMIT 1")[-1])
+    assert [str(r["id"]) for r in got] == ["t:900"]
+    ds.execute("DELETE t:900")
+    got = ok(ds.execute("SELECT id, v FROM t ORDER BY v LIMIT 1")[-1])
+    assert [str(r["id"]) for r in got] == ["t:0"]
+    col, row = both_paths(ds, "SELECT v, count() FROM t GROUP BY v ORDER BY v")
+    assert norm(col) == norm(row)

@@ -35,6 +35,10 @@ def run(ds, s, sql, vars=None):
 
 @pytest.fixture()
 def small_limit(monkeypatch):
+    # pin the ROW path: these tests exercise the spill machinery itself,
+    # which the columnar pipeline (ISSUE 13) otherwise legitimately skips
+    # (mask -> argsort -> slice never materializes an unsorted result set)
+    monkeypatch.setattr(cnf, "COLUMN_MIRROR", False)
     monkeypatch.setattr(cnf, "EXTERNAL_SORTING_BUFFER_LIMIT", 100)
     spills = {"n": 0}
     orig = ResultStore._spill

@@ -108,18 +108,18 @@ def test_baseline_grandfathers_then_catches_new(tmp_path):
 def test_repo_lints_clean_with_committed_baseline():
     """The acceptance criterion: surrealdb_tpu/ has no findings beyond the
     committed baseline, and the baseline stays bounded — 2 historical GL006
-    label entries, 6 of the original 13 GL008 swallow sites (ISSUE 12
-    burned 7 down for real: the knn/ivf/graph warm loops and the group-
-    commit sink now count `prewarm_errors`/`column_mirror_delta` declines,
-    bundle ann state carries the error, the builder records flip failures),
-    and 4 of the original 6 GL010 BaseException-converter sites (ISSUE 12
-    made the group-commit flusher and the index builder resolve-then-
-    RE-RAISE shutdown-class exceptions; the dispatch propagate-to-waiters
-    sites remain deliberate). Shrink it; never grow it without review."""
+    label entries, 3 of the original 13 GL008 swallow sites (ISSUE 12
+    burned 7 down; ISSUE 13 burned 3 more: the column-mirror prewarm
+    rebuild counts `prewarm_errors`, Datastore.close teardown failures
+    count `teardown_errors`, and every metrics-scrape section failure
+    counts `scrape_section_errors` — only the bg spawn firewall and the
+    net worker loops remain, deliberately), and 4 of the original 6 GL010
+    BaseException-converter sites (the dispatch propagate-to-waiters sites
+    remain deliberate). Shrink it; never grow it without review."""
     findings = engine.lint_paths([os.path.join(REPO, "surrealdb_tpu")])
     baseline = engine.load_baseline()
-    assert len(baseline) <= 12, "baseline grew past the acceptance cap"
-    assert sum(1 for e in baseline.values() if e["rule"] == "GL008") <= 6
+    assert len(baseline) <= 9, "baseline grew past the acceptance cap"
+    assert sum(1 for e in baseline.values() if e["rule"] == "GL008") <= 3
     assert sum(1 for e in baseline.values() if e["rule"] == "GL010") <= 4
     assert sum(1 for e in baseline.values() if e["rule"] not in ("GL008", "GL010")) <= 2
     new, _stale = engine.apply_baseline(findings, baseline)

@@ -123,9 +123,13 @@ def test_order_by_index_with_limit_pushdown(t):
     assert [r["a"] for r in rows] == sorted(i % 5 for i in range(70))[:10]
 
 
-def test_order_desc_not_pushed(t):
+def test_order_desc_not_index_pushed(t):
+    """DESC order can't ride the forward index scan — since ISSUE 13 it
+    lowers onto the columnar pipeline instead (results unchanged)."""
     plan = _explain(t, "SELECT * FROM t ORDER BY a DESC LIMIT 10")
-    assert plan[0]["operation"] == "Iterate Table"
+    assert plan[0]["operation"] != "Iterate Table"
+    d = plan[0]["detail"]["plan"]
+    assert d.get("strategy") == "columnar-pipeline" or d.get("operator") != "order"
     rows = t.execute("SELECT a FROM t ORDER BY a DESC LIMIT 3;")[-1]["result"]
     assert [r["a"] for r in rows] == [4, 4, 4]
 
@@ -137,8 +141,14 @@ def test_order_pushdown_respects_start(t):
 
 # ------------------------------------------------------------------ review regressions
 def test_order_pushdown_not_under_group(t):
+    """Index ORDER pushdown must never truncate under GROUP; the grouped
+    shape now lowers onto the columnar pipeline (which aggregates first
+    and orders the GROUPS) — either way the rows stay exact."""
     plan = _explain(t, "SELECT a, count() FROM t GROUP BY a ORDER BY a LIMIT 2")
-    assert plan[0]["operation"] == "Iterate Table"
+    d = plan[0].get("detail", {}).get("plan", {})
+    assert plan[0]["operation"] == "Iterate Table" or (
+        d.get("strategy") == "columnar-pipeline" and "segment-reduce" in d.get("stages", [])
+    )
     rows = t.execute("SELECT a, count() FROM t GROUP BY a ORDER BY a LIMIT 2;")[-1]["result"]
     assert rows[0] == {"a": 0, "count": 14} and rows[1] == {"a": 1, "count": 14}
 
