@@ -63,7 +63,7 @@ import time
 import numpy as np
 
 SCALE = float(os.environ.get("SURREAL_BENCH_SCALE", "1.0"))
-CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9").split(","))
+CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9,10").split(","))
 ROUND = os.environ.get("SURREAL_BENCH_ROUND", "r10")
 OUT_PATH = os.environ.get(
     "SURREAL_BENCH_OUT",
@@ -108,7 +108,17 @@ PROFILE = "--profile" in sys.argv[1:] or os.environ.get("SURREAL_PROFILE") == "1
 # `agg_pushdown`: the coordinator merged per-shard PARTIAL aggregates
 # (two-phase, like BM25 global stats) instead of shipping rows, proven by
 # the cluster_agg{outcome=pushed} counter and per-shard partial counts.
-SCHEMA = "surrealdb-tpu-bench/10"
+# schema/11 (r15, elastic cluster): new config 10 `elastic_chaos` — a
+# 3-node RF=2 cluster serving reads while one node is KILLED mid-window
+# and a REPLACEMENT node joins (membership epoch bump + background shard
+# migration streamed as LWW bulk ingest), then anti-entropy sweeps run to
+# convergence. Its line carries an `elastic` object (killed/joined node,
+# epoch, wrong_answers — MUST be 0 — lost_acked_writes — MUST be 0 —
+# migration_rows, repaired counts and repair_s, the kill->converged repair
+# time bench_gate ceilings). The bundle engine.cluster section gains
+# epoch/membership/migration/repair and bench_diff --bundles flags a
+# member stuck on an old epoch as peer drift.
+SCHEMA = "surrealdb-tpu-bench/11"
 
 D = 768
 NI = max(int(1_000_000 * SCALE), 1024)  # item corpus (configs 2/4/5)
@@ -1517,6 +1527,234 @@ def bench_chaos(rng):
     return None  # a survival property, not a vs-CPU speedup
 
 
+def bench_elastic(rng):
+    """Config 10: the elastic-chaos window — a 3-node RF=2 cluster serving
+    a read mix while one node is KILLED mid-window and a REPLACEMENT joins
+    (epoch bump + background shard migration over the CBOR channel), then
+    anti-entropy sweeps run to convergence. The contract measured: zero
+    wrong answers, zero lost acked writes, migration actually streamed
+    rows, and repair time (kill -> replacement converged) stays bounded.
+    This is the artifact line that makes 'capacity changes without
+    downtime' a number instead of a claim."""
+    from surrealdb_tpu import cluster as _cluster, cnf as _cnf
+    from surrealdb_tpu import events as _events
+    from surrealdb_tpu import telemetry as _tm
+    from surrealdb_tpu.cluster import membership as _mship, repair as _repair
+    from surrealdb_tpu.dbs.session import Session
+    from surrealdb_tpu.kvs.ds import Datastore
+    from surrealdb_tpu.net.server import serve as _serve
+
+    n = max(min(int(1024 * SCALE), 1024), 128)
+    s = Session.owner("bench", "bench")
+    ref = Datastore("memory")
+    servers = [
+        _serve("memory", port=0, auth_enabled=False).start_background()
+        for _ in range(3)
+    ]
+    nodes = [
+        {"id": f"n{i + 1}", "url": srv.url} for i, srv in enumerate(servers)
+    ]
+    dss = [srv.httpd.RequestHandlerClass.ds for srv in servers]
+    for i, ds_ in enumerate(dss):
+        _cluster.attach(ds_, _cluster.ClusterConfig(nodes, f"n{i + 1}", secret="bench"))
+    rf = max(min(_cnf.CLUSTER_RF, len(nodes)), 1)
+    killed_idx = 1
+    killed = False
+    srv4 = None
+    saved_timeout = _cnf.CLUSTER_RPC_TIMEOUT_SECS
+    _cnf.CLUSTER_RPC_TIMEOUT_SECS = min(saved_timeout, 2.0)
+    try:
+        ddl = "DEFINE TABLE item SCHEMALESS"
+        for target in (ref.execute, dss[0].execute):
+            for r in target(ddl, s):
+                assert r["status"] == "OK", r
+        t_ing = time.perf_counter()
+        for lo in range(0, n, 256):
+            hi = min(lo + 256, n)
+            rows = [{"id": i, "val": float(i % 97)} for i in range(lo, hi)]
+            for target in (ref.execute, dss[0].execute):
+                r = target("INSERT INTO item $rows RETURN NONE", s, {"rows": rows})
+                assert r[0]["status"] == "OK", r
+        ingest_s = time.perf_counter() - t_ing
+
+        scan_sql = "SELECT id FROM item WHERE val < 20"
+        reads = 48
+        expect_scan = ref.execute(scan_sql, s)[0]["result"]
+        dss[0].execute(scan_sql, s)  # warm the path
+
+        mig0 = sum(_tm.counters_matching("cluster_migration_rows").values())
+        rep0 = sum(_tm.counters_matching("cluster_repair_applied_total").values())
+        ae0 = sum(
+            _tm.counters_matching("cluster_antientropy_repaired_total").values()
+        )
+        ev_seq0 = _events.last_seq()
+        dss[0].cluster.executor.reset_profiles()
+        errors = degraded = wrong = 0
+        acked: list = []  # ids of writes acked AFTER the kill
+        t_kill = None
+        change = None
+        joined = False
+        t0 = time.perf_counter()
+        for i in range(reads):
+            if i == reads // 3:
+                log(f"elastic: killing node n{killed_idx + 1} mid-window")
+                servers[killed_idx].shutdown()
+                killed = True
+                t_kill = time.perf_counter()
+            if i == reads // 2:
+                log("elastic: joining replacement n4 mid-window")
+                srv4 = _serve("memory", port=0, auth_enabled=False).start_background()
+                ds4 = srv4.httpd.RequestHandlerClass.ds
+                node4 = {"id": "n4", "url": srv4.url}
+                _cluster.attach(
+                    ds4,
+                    _cluster.ClusterConfig(
+                        [nodes[0], nodes[2], node4], "n4", secret="bench"
+                    ),
+                )
+                # background migration: the window keeps reading while the
+                # moving ranges stream (dual-read covers the handoff)
+                change = _mship.replace(dss[0], "n2", node4, wait=False)
+                joined = True
+            if killed and i % 3 == 0:
+                # an acked write while degraded/migrating: must survive
+                wid = 10_000 + i
+                for target in (ref.execute, dss[0].execute):
+                    r = target(
+                        f"CREATE item:{wid} SET val = 5.0", s
+                    )
+                    assert r[0]["status"] == "OK", r
+                acked.append(wid)
+                expect_scan = ref.execute(scan_sql, s)[0]["result"]
+            r = dss[0].execute(scan_sql, s)[0]
+            if r["status"] != "OK":
+                errors += 1
+                continue
+            if r.get("degraded"):
+                degraded += 1
+            if r["result"] != expect_scan:
+                wrong += 1
+        window_s = time.perf_counter() - t0
+        qps = reads / window_s if window_s else 0.0
+
+        # migration must complete, then anti-entropy sweeps run to a clean
+        # pass — repair_s is kill -> converged
+        assert change is not None
+        change.wait(120)
+        sweeps = 0
+        for _ in range(4):
+            sweeps += 1
+            reports = [
+                _repair.sweep_once(d)
+                for d in (dss[0], dss[2], srv4.httpd.RequestHandlerClass.ds)
+            ]
+            if all(r["repaired"] == 0 and not r["errors"] for r in reports):
+                break
+        repair_s = time.perf_counter() - t_kill if t_kill is not None else None
+
+        # zero lost acked writes: every write acked after the kill reads
+        # back through the post-cutover cluster
+        lost = 0
+        for wid in acked:
+            got = dss[0].execute(f"SELECT VALUE val FROM item:{wid}", s)[0]
+            if got["status"] != "OK" or got["result"] != [5.0]:
+                lost += 1
+        migration_rows = (
+            sum(_tm.counters_matching("cluster_migration_rows").values()) - mig0
+        )
+        repaired = (
+            sum(_tm.counters_matching("cluster_repair_applied_total").values())
+            - rep0
+        )
+        antientropy = (
+            sum(_tm.counters_matching("cluster_antientropy_repaired_total").values())
+            - ae0
+        )
+        epoch = dss[0].cluster.membership.epoch
+
+        window_events = _events.since(ev_seq0)
+        events_acct = {
+            "total": len(window_events),
+            "member_join": sum(
+                1 for e in window_events if e["kind"] == "cluster.member_join"
+            ),
+            "member_leave": sum(
+                1 for e in window_events if e["kind"] == "cluster.member_leave"
+            ),
+            "migration_done": sum(
+                1 for e in window_events if e["kind"] == "cluster.migration_done"
+            ),
+            "breaker": sum(
+                1 for e in window_events if e["kind"] == "cluster.breaker_open"
+            ),
+        }
+        from surrealdb_tpu.cluster.federation import federated_bundle
+
+        live_nodes = ["n1", "n3", "n4"]
+        # the slowest WINDOW profile predates the join (the kill's timeout
+        # read) — re-profile on the post-cutover membership so the embedded
+        # evidence attributes time to every live node incl. the replacement
+        dss[0].cluster.executor.reset_profiles()
+        for _ in range(3):
+            r = dss[0].execute(scan_sql, s)[0]
+            assert r["status"] == "OK", r
+        cluster_obs = {
+            "bundle": federated_bundle(dss[0], trace_limit=10, full_traces=2),
+            "slowest_profile": dss[0].cluster.executor.slowest_profile(),
+            "live_nodes": live_nodes,
+            "in_process": True,  # shared registries; see federation.py caveat
+        }
+        emit(
+            {
+                "metric": f"elastic_reads_3nodes_rf{rf}_{n}",
+                "value": round(qps, 2),
+                "unit": "qps",
+                "vs_baseline": None,
+                "window_s": round(window_s, 2),
+                "ingest_rate_rows_s": round((1 + rf) * n / ingest_s, 1)
+                if ingest_s
+                else None,
+                "elastic": {
+                    "nodes": len(nodes),
+                    "rf": rf,
+                    "killed_node": f"n{killed_idx + 1}",
+                    "joined_node": "n4",
+                    "epoch": epoch,
+                    "reads": reads,
+                    "degraded_responses": degraded,
+                    "errors": errors,
+                    "wrong_answers": wrong,
+                    "acked_writes": len(acked),
+                    "lost_acked_writes": lost,
+                    "migration_rows": int(migration_rows),
+                    "repaired": int(repaired),
+                    "antientropy_repaired": int(antientropy),
+                    "repair_sweeps": sweeps,
+                    "repair_s": round(repair_s, 3) if repair_s is not None else None,
+                },
+                "events": events_acct,
+                "cluster_obs": cluster_obs,
+            }
+        )
+        assert wrong == 0, f"elastic window produced {wrong} wrong answers"
+        assert lost == 0, f"elastic window lost {lost} acked writes"
+        assert migration_rows > 0, "replacement join streamed no rows"
+        assert epoch == 2, f"membership epoch {epoch} != 2 after the replace"
+    finally:
+        _cnf.CLUSTER_RPC_TIMEOUT_SECS = saved_timeout
+        for i, srv in enumerate(servers):
+            if not (killed and i == killed_idx):
+                srv.shutdown()
+        if srv4 is not None:
+            ds4 = srv4.httpd.RequestHandlerClass.ds
+            srv4.shutdown()
+            ds4.close()
+        for ds_ in dss:
+            ds_.close()
+        ref.close()
+    return None  # a survival property, not a vs-CPU speedup
+
+
 def bench_ml_scan(ds, s, rng):
     from surrealdb_tpu.ml.exec import import_model
 
@@ -1667,6 +1905,8 @@ def main() -> None:
         run_cfg("7", lambda: bench_cluster(rng))
     if "8" in CONFIGS:
         run_cfg("8", lambda: bench_chaos(rng))
+    if "10" in CONFIGS:
+        run_cfg("10", lambda: bench_elastic(rng))
     if "5" in CONFIGS:
         run_cfg("5", lambda: bench_ml_scan(ds, s, rng))
     if "6" in CONFIGS:

@@ -438,6 +438,24 @@ def peer_drift(bundle: dict) -> List[str]:
                 f"(e.g. {sorted(peered)[0]})"
             )
 
+    # membership-epoch drift: a member still routing under an old ring
+    # version after a join/leave/replace cutover — its responsibility
+    # filters and replica sets disagree with the fleet's (ISSUE 14)
+    epochs = {
+        nid: ((b.get("engine") or {}).get("cluster") or {}).get("epoch")
+        for nid, b in reachable.items()
+    }
+    known = {nid: e for nid, e in epochs.items() if isinstance(e, int)}
+    if known and len(set(known.values())) > 1:
+        newest = max(known.values())
+        for nid, e in sorted(known.items()):
+            if e < newest:
+                flags.append(
+                    f"node {nid}: membership epoch {e} behind the fleet's "
+                    f"{newest} — it routes under a stale ring version "
+                    "(missed cutover?)"
+                )
+
     # breaker/liveness drift: a member whose view of the cluster disagrees
     # with its peers (open breakers, down marks) while the others are calm
     for nid, b in sorted(reachable.items()):

@@ -64,6 +64,11 @@ FLOOR_SCAN_RATIO = float(os.environ.get("SURREAL_BENCH_GATE_SCAN_RATIO", "5.0"))
 FLOOR_INGEST = float(os.environ.get("SURREAL_BENCH_GATE_INGEST_FLOOR", "5000.0"))
 FLOOR_INGEST_RATIO = float(os.environ.get("SURREAL_BENCH_GATE_INGEST_RATIO", "5.0"))
 CHAOS_MAX_ERRORS = int(os.environ.get("SURREAL_BENCH_GATE_CHAOS_ERRORS", "3"))
+# elastic window (config 10): error ceiling during the kill+join window and
+# the repair-time ceiling — kill -> replacement-converged must stay bounded
+# (zero wrong answers / zero lost acked writes are validator rules already)
+ELASTIC_MAX_ERRORS = int(os.environ.get("SURREAL_BENCH_GATE_ELASTIC_ERRORS", "4"))
+REPAIR_CEILING_S = float(os.environ.get("SURREAL_BENCH_GATE_REPAIR_CEILING", "60.0"))
 # vectorized SELECT pipeline (config 9): ORDER BY+LIMIT and GROUP BY
 # aggregate columnar/row speedup floor (the ISSUE 13 acceptance bar)
 FLOOR_PIPE_RATIO = float(os.environ.get("SURREAL_BENCH_GATE_PIPE_RATIO", "5.0"))
@@ -76,7 +81,7 @@ def main() -> int:
     env.update(
         {
             "SURREAL_BENCH_SCALE": SCALE,
-            "SURREAL_BENCH_CONFIGS": "2,6,8,9",
+            "SURREAL_BENCH_CONFIGS": "2,6,8,9,10",
             "SURREAL_BENCH_ROUND": "gate",
             "SURREAL_BENCH_OUT": out,
         }
@@ -256,6 +261,51 @@ def main() -> int:
                     "read(s) carry no trace_id — unattributable failovers"
                 )
 
+    # ---- config 10: elastic-chaos floors (schema/11) ------------------
+    elastic_summary = None
+    elastic_line = next(
+        (
+            r
+            for r in art["results"]
+            if str(r.get("config")) == "10"
+            and str(r.get("metric", "")).startswith("elastic_")
+        ),
+        None,
+    )
+    if elastic_line is None:
+        failures.append("no config-10 elastic_reads line in artifact")
+    else:
+        el = elastic_line.get("elastic") or {}
+        elastic_summary = el
+        # re-check the validator's hard rules (a weakened validator must
+        # not sneak one through), then the gate-only ceilings
+        if el.get("wrong_answers") != 0:
+            failures.append(
+                f"elastic window wrong_answers {el.get('wrong_answers')} != 0"
+            )
+        if el.get("lost_acked_writes") != 0:
+            failures.append(
+                f"elastic window lost {el.get('lost_acked_writes')} acked write(s)"
+            )
+        if (el.get("errors") or 0) > ELASTIC_MAX_ERRORS:
+            failures.append(
+                f"elastic window errors {el.get('errors')} > ceiling {ELASTIC_MAX_ERRORS}"
+            )
+        if not el.get("migration_rows"):
+            failures.append("elastic window streamed no migration rows")
+        rs = el.get("repair_s")
+        if rs is None or rs > REPAIR_CEILING_S:
+            failures.append(
+                f"elastic repair time {rs}s exceeds ceiling {REPAIR_CEILING_S}s "
+                "(kill -> replacement-converged must stay bounded)"
+            )
+        ev = elastic_line.get("events")
+        if not isinstance(ev, dict) or not ev.get("member_join"):
+            failures.append(
+                "elastic window shows no cluster.member_join event — the "
+                "replacement join left no timeline evidence"
+            )
+
     # ---- config 9: vectorized-pipeline floors (schema/10) -------------
     pipe_summary = None
     pipe_line = next(
@@ -302,6 +352,7 @@ def main() -> int:
         "ingest_rate_rows_s": line.get("ingest_rate_rows_s"),
         "ingest": ingest_summary,
         "chaos": chaos_summary,
+        "elastic": elastic_summary,
         "ordered_agg": pipe_summary,
         "artifact": out,
     }

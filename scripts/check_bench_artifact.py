@@ -23,7 +23,7 @@ import os
 import sys
 from typing import List
 
-SCHEMA = "surrealdb-tpu-bench/10"
+SCHEMA = "surrealdb-tpu-bench/11"
 # earlier rounds' committed artifacts stay validatable under their own rules
 KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/1",
@@ -35,6 +35,7 @@ KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/7",
     "surrealdb-tpu-bench/8",
     "surrealdb-tpu-bench/9",
+    "surrealdb-tpu-bench/10",
     SCHEMA,
 )
 
@@ -82,6 +83,18 @@ ORDERED_AGG_KEYS = ("col_qps", "row_qps", "ratio", "same_results")
 CHAOS_KEYS = (
     "nodes", "rf", "killed_node", "reads", "failover_reads",
     "degraded_responses", "errors", "wrong_answers", "recovery_s",
+)
+# schema/11 (elastic cluster): an elastic_* config line must carry the
+# `elastic` object proving the window killed a node AND joined its
+# replacement (epoch recorded), never answered wrong, never lost an acked
+# write, actually streamed migration rows, and repaired to convergence in
+# bounded time — wrong_answers == 0, lost_acked_writes == 0, repaired > 0
+# and a recorded epoch are VALIDITY rules, not perf floors.
+ELASTIC_KEYS = (
+    "nodes", "rf", "killed_node", "joined_node", "epoch", "reads",
+    "degraded_responses", "errors", "wrong_answers", "acked_writes",
+    "lost_acked_writes", "migration_rows", "repaired", "repair_sweeps",
+    "repair_s",
 )
 BUNDLE_SECTIONS = ("traces", "slow_queries", "errors", "tasks", "compiles", "engine")
 BUNDLE_SECTIONS_V8 = BUNDLE_SECTIONS + ("locks", "faults")
@@ -194,7 +207,8 @@ def validate(path: str) -> List[str]:
     if art.get("schema") not in KNOWN_SCHEMAS:
         problems.append(f"schema is {art.get('schema')!r}, expected one of {KNOWN_SCHEMAS}")
     schema = art.get("schema")
-    v10 = schema == SCHEMA
+    v11 = schema == SCHEMA
+    v10 = v11 or schema == "surrealdb-tpu-bench/10"
     v9 = v10 or schema == "surrealdb-tpu-bench/9"
     v8 = v9 or schema == "surrealdb-tpu-bench/8"
     v7 = v8 or schema == "surrealdb-tpu-bench/7"
@@ -405,7 +419,57 @@ def validate(path: str) -> List[str]:
                     "(the GROUP BY shipped rows instead of merging partial "
                     "aggregates)"
                 )
-        if v9 and (metric.startswith("cluster_") or metric.startswith("chaos_")):
+        if v11 and metric.startswith("elastic_"):
+            el = r.get("elastic")
+            if not isinstance(el, dict):
+                problems.append(f"{where} ({metric}): missing 'elastic' object")
+            else:
+                for key in ELASTIC_KEYS:
+                    if key not in el:
+                        problems.append(f"{where} ({metric}): elastic missing {key!r}")
+                if el.get("wrong_answers") not in (0,):
+                    problems.append(
+                        f"{where} ({metric}): elastic.wrong_answers must be 0 "
+                        "(a read answered wrong during the membership change)"
+                    )
+                if el.get("lost_acked_writes") not in (0,):
+                    problems.append(
+                        f"{where} ({metric}): elastic.lost_acked_writes must "
+                        "be 0 (an acknowledged write vanished across the "
+                        "kill + replace)"
+                    )
+                if not el.get("killed_node") or not el.get("joined_node"):
+                    problems.append(
+                        f"{where} ({metric}): elastic window must name both "
+                        "the killed and the joined node"
+                    )
+                if not isinstance(el.get("epoch"), int) or el["epoch"] < 2:
+                    problems.append(
+                        f"{where} ({metric}): elastic.epoch must record the "
+                        "post-change membership epoch (>= 2)"
+                    )
+                mig = el.get("migration_rows")
+                rep = el.get("repaired")
+                if not isinstance(mig, int) or mig <= 0:
+                    problems.append(
+                        f"{where} ({metric}): elastic.migration_rows must be "
+                        "> 0 (the replacement join streamed nothing)"
+                    )
+                if not isinstance(rep, int) or rep <= 0:
+                    problems.append(
+                        f"{where} ({metric}): elastic.repaired must be > 0 "
+                        "(no rows went through the LWW repair apply path)"
+                    )
+                if not isinstance(el.get("repair_s"), (int, float)):
+                    problems.append(
+                        f"{where} ({metric}): elastic.repair_s must record "
+                        "the kill->converged repair time"
+                    )
+        if v9 and (
+            metric.startswith("cluster_")
+            or metric.startswith("chaos_")
+            or (v11 and metric.startswith("elastic_"))
+        ):
             co = r.get("cluster_obs")
             if not isinstance(co, dict):
                 problems.append(

@@ -50,6 +50,7 @@ KIND_DEADLINES: Dict[str, float] = {
     "shape_warm": 300.0,
     "changefeed_gc": 60.0,
     "index_build": 900.0,
+    "cluster_read_repair": 60.0,
 }
 
 _STATES = ("scheduled", "running", "done", "failed", "stalled")
@@ -280,7 +281,12 @@ def spawn(
             with run(tid):
                 fn(*args)
         except Exception:
-            pass  # best-effort background work; the record carries the error
+            # best-effort background work; run() already resolved the task
+            # record as failed with the error text — count the escape so a
+            # spike of dying spawn bodies is a metric, not a silent pass
+            from surrealdb_tpu import telemetry
+
+            telemetry.inc("bg_spawn_body_errors", kind=kind)
 
     t = threading.Thread(
         target=body,

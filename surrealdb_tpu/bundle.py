@@ -206,11 +206,20 @@ def _cluster_state(ds) -> Optional[Dict[str, Any]]:
     if node is None:
         return None
     from surrealdb_tpu import cnf
+    from surrealdb_tpu.cluster import repair as _repair
 
+    members = node.membership.nodes()
     out: Dict[str, Any] = {
         "node_id": node.node_id,
-        "members": [n["id"] for n in node.config.nodes],
-        "rf": max(min(cnf.CLUSTER_RF, len(node.config.nodes)), 1),
+        "members": [n["id"] for n in members],
+        "rf": max(min(cnf.CLUSTER_RF, len(members)), 1),
+        # elastic-membership plane: which ring version this member serves
+        # under (peer drift when it disagrees with the fleet), plus the
+        # migration/repair progress behind a capacity change
+        "epoch": node.membership.epoch,
+        "membership": node.membership.view(),
+        "migration": node.migration.view(),
+        "repair": _repair.last_sweep(node),
     }
     if node.client is not None:
         out["nodes"] = node.client.probe_state()

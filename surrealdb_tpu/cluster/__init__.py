@@ -32,23 +32,33 @@ __all__ = ["ClusterConfig", "load_config", "HashRing", "attach", "detach"]
 
 
 def attach(ds, config: ClusterConfig):
-    """Wire a Datastore into a cluster: placement ring, RPC client pool
-    (+ health-probe service pumps), and the scatter/gather executor.
-    Returns the ClusterNode handle (also stored as ds.cluster)."""
+    """Wire a Datastore into a cluster: versioned membership (epoch 1 from
+    the config), RPC client pool (+ health-probe service pumps), the
+    scatter/gather executor, and — when CLUSTER_ANTIENTROPY_INTERVAL is
+    set — the supervised anti-entropy sweep service. Returns the
+    ClusterNode handle (also stored as ds.cluster)."""
+    from surrealdb_tpu import telemetry
+
     from .client import ClusterClient
     from .executor import ClusterExecutor
 
     node = ClusterNode(ds, config)
     node.client = ClusterClient(config, owner=id(ds))
+    node.client.epoch_provider = lambda: node.membership.epoch
     node.executor = ClusterExecutor(ds, node)
     ds.cluster = node
     node.client.start_probes()
+    telemetry.gauge_set("cluster_membership_epoch", float(node.membership.epoch))
+    from . import repair as _repair
+
+    _repair.start_service(ds)
     return node
 
 
 def detach(ds) -> None:
     """Tear a node out of its cluster (tests): stop probe pumps, release
-    the scatter pool, restore single-node execution."""
+    the scatter pool, restore single-node execution. The anti-entropy
+    sweep loop notices ds.cluster changed and retires on its next beat."""
     node = getattr(ds, "cluster", None)
     if node is None:
         return
@@ -60,18 +70,33 @@ def detach(ds) -> None:
 
 
 class ClusterNode:
-    """One process's view of the cluster: its identity, the placement ring,
-    the RPC client pool, and the coordinating executor."""
+    """One process's view of the cluster: its identity, the VERSIONED
+    membership (epoch + active/next rings — cluster/membership.py), the
+    RPC client pool, and the coordinating executor."""
 
     def __init__(self, ds, config: ClusterConfig):
+        from .membership import Membership, MigrationState
+
         self.ds = ds
         self.config = config
-        self.ring = HashRing(
-            [n["id"] for n in config.nodes], vnodes=config.vnodes
-        )
+        self.membership = Membership(config.nodes, vnodes=config.vnodes)
+        self.migration = MigrationState()
         self.client = None  # ClusterClient (attach() fills)
         self.executor = None  # ClusterExecutor (attach() fills)
 
     @property
     def node_id(self) -> str:
         return self.config.node_id
+
+    @property
+    def ring(self) -> HashRing:
+        """The ACTIVE placement ring (next ring only serves dual-writes
+        until the cutover — membership.replicas_of_key)."""
+        return self.membership.ring()
+
+    def members(self):
+        """Active ∪ next membership node dicts (the statement fan-out set)."""
+        return self.membership.all_nodes()
+
+    def member_ids(self):
+        return self.membership.member_ids()

@@ -93,6 +93,13 @@ class ClusterClient:
         self.config = config
         self._owner = owner
         self._lock = _locks.Lock("cluster.client")
+        # node_id -> url: seeded from the static config, mutated by elastic
+        # membership changes (add_node/remove_node; guarded by cluster.client)
+        self._urls: Dict[str, str] = {n["id"]: n["url"] for n in config.nodes}
+        # the active membership's epoch, attached to every outbound op so
+        # members can flag a coordinator (or themselves) on a stale ring
+        # version; wired by cluster.attach
+        self.epoch_provider = None
         # node_id -> liveness view maintained by the probe pumps + call
         # outcomes (guarded by cluster.client)
         self._health: Dict[str, Dict[str, Any]] = {
@@ -111,12 +118,58 @@ class ClusterClient:
         self._alive = True
         self._probes_started = False
 
+    # ------------------------------------------------------------ membership
+    def url_of(self, node_id: str) -> str:
+        with self._lock:
+            url = self._urls.get(node_id)
+        if url is None:
+            raise ClusterError(f"unknown cluster node {node_id!r}")
+        return url
+
+    def node_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._urls)
+
+    def add_node(self, node: Dict[str, str]) -> None:
+        """Wire a new member into the transport: url map, health entry,
+        breaker, and (when the pumps are running) its own liveness probe."""
+        nid, url = str(node["id"]), str(node["url"]).rstrip("/")
+        start_probe = False
+        with self._lock:
+            if nid in self._urls:
+                self._urls[nid] = url
+                return
+            self._urls[nid] = url
+            self._health[nid] = {
+                "up": None, "last_seen": 0.0, "error": None,
+                "probe_interval_s": None, "flaps": 0,
+            }
+            start_probe = self._probes_started
+        with self._breaker_lock:
+            self._breakers.setdefault(nid, _Breaker())
+        if start_probe:
+            from surrealdb_tpu import bg
+
+            bg.spawn_service(
+                "cluster_probe", nid, self._probe_loop, nid,
+                owner=self._owner, restart=True,
+            )
+
+    def remove_node(self, node_id: str) -> None:
+        """Drop a departed member: its probe pump exits on the next beat
+        (the loop checks the health map), calls to it fail fast."""
+        with self._lock:
+            self._urls.pop(node_id, None)
+            self._health.pop(node_id, None)
+        with self._breaker_lock:
+            self._breakers.pop(node_id, None)
+
     # ------------------------------------------------------------ transport
     def _request(
         self, node_id: str, path: str, body: bytes, timeout: float,
         headers: Optional[Dict[str, str]] = None,
     ) -> bytes:
-        url = self.config.url_of(node_id)
+        url = self.url_of(node_id)
         u = urlparse(url)
         conn_cls = (
             http.client.HTTPSConnection if u.scheme == "https" else http.client.HTTPConnection
@@ -155,6 +208,11 @@ class ClusterClient:
 
         self._breaker_allow(node_id)
         req = dict(req, op=op)
+        if self.epoch_provider is not None and "epoch" not in req:
+            # the membership epoch this request was placed under — the
+            # receiver counts mismatches (cluster_epoch_mismatch_total) so
+            # a member on a stale ring version is visible, not silent
+            req["epoch"] = self.epoch_provider()
         headers: Dict[str, str] = {}
         ctx = tracing.current()
         if ctx is not None:
@@ -175,7 +233,7 @@ class ClusterClient:
                     # mid-response — node-class failure, NEVER a partial
                     # answer served as complete
                     raise NodeUnavailableError(
-                        node_id, self.config.url_of(node_id),
+                        node_id, self.url_of(node_id),
                         f"corrupt response body: {type(e).__name__}: {e}",
                     ) from e
         except NodeUnavailableError:
@@ -241,7 +299,7 @@ class ClusterClient:
             return
         telemetry.inc("cluster_breaker_fast_fails", node=node_id)
         raise NodeUnavailableError(
-            node_id, self.config.url_of(node_id),
+            node_id, self.url_of(node_id),
             f"circuit breaker {state}", retryable=False,
         )
 
@@ -379,17 +437,23 @@ class ClusterClient:
             if self._probes_started:
                 return
             self._probes_started = True
-        for node_id in self.config.peer_ids():
+        for node_id in (n for n in self.node_ids() if n != self.config.node_id):
             bg.spawn_service(
                 "cluster_probe", node_id, self._probe_loop, node_id,
                 owner=self._owner, restart=True,
             )
 
-    def _probe_loop(self, node_id: str) -> None:
-        url = self.config.url_of(node_id)
-        u = urlparse(url)
+    def _probe_loop(self, node_id: str, trace_id=None) -> None:
+        # trace_id: the arming request's trace (explicit propagation — the
+        # pump's own liveness events are deliberately traceless, but a
+        # caller may pin one for attribution)
         interval = max(cnf.CLUSTER_PROBE_INTERVAL_SECS, 0.05)
         while self._alive:
+            with self._lock:
+                url = self._urls.get(node_id)
+            if url is None:
+                return  # the member left the cluster: the pump retires
+            u = urlparse(url)
             ok = False
             try:
                 conn_cls = (

@@ -1,8 +1,10 @@
-"""Static cluster membership config.
+"""Boot-time cluster membership config (epoch 1).
 
 The reference derives membership from the distributed KV store's node table
-(kvs/node.rs heartbeats); this reproduction keeps a STATIC topology file so
-placement is deterministic and testable without a consensus layer:
+(kvs/node.rs heartbeats); this reproduction boots each node from a topology
+file — deterministic and testable without a consensus layer — and evolves
+membership at runtime through epoch-versioned join/leave/replace
+(cluster/membership.py):
 
     {
       "nodes": [

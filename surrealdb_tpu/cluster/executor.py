@@ -365,7 +365,14 @@ class ClusterExecutor:
         # write-degradation watermark at attach: the pipeline pushdowns
         # stand down once THIS cluster has degraded/diverged a write
         # (telemetry is process-global; the delta scopes it to this
-        # executor's lifetime)
+        # executor's lifetime). A CLEAN anti-entropy sweep re-snapshots it
+        # (reset_degradation) — repair proves convergence, so the
+        # pushdowns resume instead of standing down forever.
+        self._degradation0 = self._write_degradation()
+
+    def reset_degradation(self) -> None:
+        """Re-arm the pipeline pushdowns after repair proved the replicas
+        converged (called by a clean repair.sweep_once pass)."""
         self._degradation0 = self._write_degradation()
 
     def shutdown(self) -> None:
@@ -552,19 +559,30 @@ class ClusterExecutor:
 
     # ------------------------------------------------------------ plumbing
     def _all_nodes(self) -> List[str]:
-        return [n["id"] for n in self.node.config.nodes]
+        """The statement fan-out set: the ACTIVE membership, plus any
+        joining members during a handoff window (dual-read — a record
+        mid-migration answers from wherever a copy lives)."""
+        return self.node.member_ids()
 
     def _rf(self) -> int:
-        """Effective replication factor: the knob clamped to membership."""
-        return max(min(cnf.CLUSTER_RF, len(self.node.config.nodes)), 1)
+        """Effective replication factor: the knob clamped to the ACTIVE
+        membership (the ring requests route under until cutover)."""
+        return max(min(cnf.CLUSTER_RF, len(self.node.membership.nodes())), 1)
 
     def _down_nodes(self) -> set:
         client = self.node.client
         return set(client.down_nodes()) if client is not None else set()
 
     def _replicas(self, tb: str, rid) -> List[str]:
-        """The record's replica set (primary first, ring order)."""
-        return self.node.ring.owners_of(tb, rid, self._rf())
+        """The record's replica set (primary first, ring order). During a
+        membership handoff window this is the UNION of the active-ring and
+        next-ring owners — dual-write, so the record exists on its new
+        homes the moment the cutover lands."""
+        from .placement import placement_key
+
+        return self.node.membership.replicas_of_key(
+            placement_key(tb, rid), self._rf()
+        )
 
     def _call_once(self, node_id: str, op: str, req: Dict[str, Any]) -> Dict[str, Any]:
         """One cluster op; the self node short-circuits in-process (its
@@ -745,20 +763,24 @@ class ClusterExecutor:
 
     def _gather_rows(
         self, per_node: Dict[str, List[dict]], dedup: bool = False,
-        dedup_key: str = "id",
+        dedup_key: str = "id", session=None,
     ) -> List[Any]:
         """Concatenate per-node result rows in node-sorted order. With
         replication (`dedup`) rows that carry a record id appear once per
         holding replica. Identical copies keep the first (node-sorted,
         deterministic). Copies that DIFFER — a replica missed a write and
-        is serving stale data — keep the one from the EARLIEST replica in
-        the record's ring order: that is the write-reporter rule, so an
-        acknowledged write is always served whenever its reporter answered
-        (the ring lookup is paid only on actual divergence, and
-        `cluster_read_divergence` counts it so the stale copy is an
-        operator-visible repair item, not a silent coin flip). Rows
-        without a usable id pass through."""
+        is serving stale data — resolve by LAST-WRITER-WINS: the two
+        holders' HLC stamps are fetched (one small RPC per remote holder,
+        paid only on actual divergence) and the newer write serves; when
+        stamps cannot decide, the EARLIEST replica in the record's ring
+        order serves (the write-reporter rule, the pre-HLC behavior).
+        Either way `cluster_read_divergence` counts it and a background
+        read-repair back-fills the stale copies, so the divergence is
+        self-healing instead of an operator chore. Rows without a usable
+        id pass through."""
         from surrealdb_tpu import telemetry
+
+        from . import repair as _repair
 
         rows: List[Any] = []
         if not dedup:
@@ -791,10 +813,27 @@ class ClusterExecutor:
                     if nid == kept_nid or row == rows[idx]:
                         continue
                     telemetry.inc("cluster_read_divergence")
-                    rank = {
-                        n: i for i, n in enumerate(self._replicas(rid.tb, rid.id))
-                    }
-                    if rank.get(nid, len(rank)) < rank.get(kept_nid, len(rank)):
+                    winner = None
+                    if session is not None:
+                        winner = _repair.divergent_winner(
+                            self.node, session.ns, session.db, rid,
+                            (kept_nid, nid),
+                        )
+                        _repair.schedule_read_repair(
+                            self.node, session.ns, session.db, rid
+                        )
+                    if winner is None:
+                        # stamps could not decide: ring-order fallback
+                        rank = {
+                            n: i
+                            for i, n in enumerate(self._replicas(rid.tb, rid.id))
+                        }
+                        winner = (
+                            nid
+                            if rank.get(nid, len(rank)) < rank.get(kept_nid, len(rank))
+                            else kept_nid
+                        )
+                    if winner == nid:
                         rows[idx] = row
                         by_id[key] = (idx, nid)
         return rows
@@ -878,7 +917,7 @@ class ClusterExecutor:
                 self._all_nodes(), src, session, vars,
                 tolerate_down=rf > 1,
             )
-        rows = self._gather_rows(per_node, dedup=rf > 1)
+        rows = self._gather_rows(per_node, dedup=rf > 1, session=session)
         if rows and all(isinstance(r, dict) and "id" in r for r in rows):
             # FROM-source rank first (a multi-table UPDATE returns table by
             # table on a single node), key order within each source
@@ -1383,7 +1422,9 @@ class ClusterExecutor:
         finally:
             stm.order, stm.limit, stm.start, stm.fields = saved
         t_merge = _time.perf_counter()
-        rows = self._gather_rows(per_node, dedup=dedup, dedup_key=_RID)
+        rows = self._gather_rows(
+            per_node, dedup=dedup, dedup_key=_RID, session=session
+        )
         if rows and all(isinstance(r, dict) and "id" in r for r in rows):
             rows = _merge.sort_rows_scan_order(rows, self._from_tables(stm, session, vars))
         elif dedup and rows and all(isinstance(r, dict) and _RID in r for r in rows):
@@ -1603,7 +1644,7 @@ class ClusterExecutor:
             idempotent=True, tolerate_down=rf > 1,
         )
         t_merge = _time.perf_counter()
-        rows = self._gather_rows(per_node, dedup=rf > 1)
+        rows = self._gather_rows(per_node, dedup=rf > 1, session=session)
         if knn is not None:
             rows = _merge.merge_topk(rows, int(knn.k), _DIST)
         elif matches is not None:
@@ -1832,7 +1873,7 @@ class ClusterExecutor:
             idempotent=True, tolerate_down=rf > 1,
         )
         rows = _merge.sort_rows_scan_order(
-            self._gather_rows(per_node, dedup=rf > 1), [tb]
+            self._gather_rows(per_node, dedup=rf > 1, session=session), [tb]
         )
         return [r["id"] for r in rows if isinstance(r, dict) and isinstance(r.get("id"), Thing)]
 

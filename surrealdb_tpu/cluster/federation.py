@@ -49,7 +49,7 @@ def _gather(
     errors: Dict[str, str] = {}
     futs = {}
     pool = node.executor._pool if node.executor is not None else None
-    for n in node.config.nodes:
+    for n in node.members():
         nid = n["id"]
         if nid == node.node_id or node.client is None:
             continue

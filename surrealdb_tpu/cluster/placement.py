@@ -1,12 +1,14 @@
-"""Consistent-hash record placement over the static membership.
+"""Consistent-hash record placement over one membership VERSION.
 
 Every record id maps to a point on a hash ring; the first node vnode
 clockwise owns it. Hashes are blake2b (process-stable — Python's builtin
 hash() is salted per process and would scatter the same record to different
 owners on different nodes). With `vnodes` virtual nodes per member the load
 skew across nodes concentrates to a few percent, and adding a member moves
-only ~1/N of the keyspace (the property the name promises), though this
-reproduction treats membership as static for a process lifetime.
+only ~1/N of the keyspace (the property the name promises) — exactly the
+slice elastic membership (cluster/membership.py) streams on a join/leave.
+Each HashRing instance is IMMUTABLE; membership changes swap whole rings
+under a new epoch.
 
 Placement is by RECORD, not by table: every node owns a slice of every
 table, so scans/kNN/BM25 scatter to all members while id-addressed writes
@@ -74,6 +76,38 @@ class HashRing:
         out: List[str] = []
         for step in range(len(self._points)):
             p = self._points[(i + step) % len(self._points)]
+            nid = self._owners[p]
+            if nid not in out:
+                out.append(nid)
+                if len(out) == rf:
+                    break
+        return out
+
+    # ------------------------------------------------------------ hash ranges
+    # Anti-entropy + migration address the keyspace by RING RANGE: every
+    # ring point i owns the arc ending at it, so `range index == point
+    # index` is a partition of the hash space both sides of a replica pair
+    # derive identically from the same ring (no Merkle tree to ship — the
+    # per-range digests ARE the tree's leaf level).
+    def n_ranges(self) -> int:
+        return len(self._points)
+
+    def range_of_key(self, key: bytes) -> int:
+        """The ring-range index (== owning point index) of a placement key."""
+        return self.range_of_hash(_h64(key))
+
+    def range_of_hash(self, h: int) -> int:
+        i = bisect.bisect_right(self._points, h)
+        return 0 if i == len(self._points) else i
+
+    def range_owners(self, idx: int, rf: int) -> List[str]:
+        """The replica set of every record hashing into range `idx`: the
+        same rf-distinct-successors walk owners_of_key takes, started at
+        the range's owning point."""
+        rf = max(min(int(rf), len(self.node_ids)), 1)
+        out: List[str] = []
+        for step in range(len(self._points)):
+            p = self._points[(idx + step) % len(self._points)]
             nid = self._owners[p]
             if nid not in out:
                 out.append(nid)

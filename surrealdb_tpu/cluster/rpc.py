@@ -15,6 +15,18 @@ Ops:
     bundle    {trace_limit?, full_traces?}   -> {json: <node debug bundle>}
     metrics   {}                             -> {json: <telemetry export>}
     events    {kind?, limit?}                -> {json: <event timeline>}
+    member_update {phase, epoch, nodes, ...} -> {ok, view}   (elastic membership)
+    membership  {}                           -> {view, migration}
+    migrate_ranges {epoch, live}             -> {rows, targets}
+    repair_digests {idxs, epoch}             -> {digests: {tbkey: {idx: hex}}}
+    repair_keys    {idxs, epoch}             -> {tables: {tbkey: {key: row}}}
+    record_fetch   {ns, db, tb, ids}         -> {records: [[id, doc, hlc, dead]]}
+    record_repair  {ns, db, tb, records, reason} -> {applied}
+
+Every request carries the sender's membership `epoch` (attached by the
+client); handle() counts mismatches (`cluster_epoch_mismatch_total`) and
+every response echoes the local epoch — a member stuck on an old ring
+version is a counter + a peer-drift flag, never a silent wrong answer.
 
 The observability ops (`bundle`/`metrics`/`events` — the federation plane)
 ship their payloads as JSON STRINGS inside the CBOR envelope: bundle
@@ -53,6 +65,17 @@ def handle(ds, req: Dict[str, Any]) -> Dict[str, Any]:
     op = str(req.get("op", ""))
     fn = _OPS.get(op)
     t0 = _time.time()
+    local_epoch = _local_epoch(ds)
+    req_epoch = req.get("epoch")
+    if (
+        local_epoch is not None
+        and isinstance(req_epoch, int)
+        and req_epoch != local_epoch
+        and op not in ("member_update", "membership")
+    ):
+        # one side of this call routed under a different ring version —
+        # counted here, flagged as peer drift by bench_diff --bundles
+        telemetry.inc("cluster_epoch_mismatch_total", op=op)
     try:
         if fn is None:
             raise SurrealError(f"unknown cluster op {op!r}")
@@ -64,6 +87,8 @@ def handle(ds, req: Dict[str, Any]) -> Dict[str, Any]:
     except Exception as e:  # noqa: BLE001 — a bad op must not kill the channel
         out = {"error": f"Internal error: {type(e).__name__}: {e}"}
     out["node"] = str(getattr(getattr(ds, "cluster", None), "node_id", "") or "")
+    if local_epoch is not None:
+        out["epoch"] = _local_epoch(ds)  # post-op: a member_update answers new
     out["spans"] = tracing.export_spans()
     if op == "query":
         _attach_ring_entries(out, t0)
@@ -98,6 +123,13 @@ def _attach_ring_entries(out: Dict[str, Any], t0: float) -> None:
         out["slow"] = _json.loads(_json.dumps(slow, default=str))
     if errs:
         out["errors"] = _json.loads(_json.dumps(errs, default=str))
+
+
+def _local_epoch(ds):
+    node = getattr(ds, "cluster", None)
+    if node is None or getattr(node, "membership", None) is None:
+        return None
+    return node.membership.epoch
 
 
 def _session(req):
@@ -304,6 +336,94 @@ def _op_events(ds, req):
     return {"json": _json.dumps(out, default=str)}
 
 
+def _op_member_update(ds, req):
+    """Elastic membership: prepare / commit / abort one epoch change
+    (cluster/membership.py drives the two-phase flow)."""
+    from . import membership as _membership
+
+    return _membership.handle_update(ds, req)
+
+
+def _op_membership(ds, req):
+    """This node's membership + migration view (tests, observability)."""
+    node = getattr(ds, "cluster", None)
+    if node is None:
+        raise SurrealError("not a cluster node")
+    return {
+        "view": node.membership.view(),
+        "migration": node.migration.view(),
+    }
+
+
+def _op_migrate_ranges(ds, req):
+    """Stream this node's share of a migration window's moving records."""
+    from . import membership as _membership
+
+    return _membership.migrate_ranges(ds, req)
+
+
+def _op_repair_digests(ds, req):
+    """Per-hash-range digests for the anti-entropy sweep (cluster/repair.py)."""
+    from . import repair as _repair
+
+    node = getattr(ds, "cluster", None)
+    if node is None:
+        raise SurrealError("not a cluster node")
+    epoch = req.get("epoch")
+    if isinstance(epoch, int) and epoch != node.membership.epoch:
+        raise SurrealError(
+            f"repair_digests under epoch {epoch} but this node is at "
+            f"{node.membership.epoch} — rings disagree, sweep must re-plan"
+        )
+    idxs = [int(i) for i in (req.get("idxs") or [])]
+    return {"digests": _repair.range_digests(ds, node.membership.ring(), idxs)}
+
+
+def _op_repair_keys(ds, req):
+    """Per-record (id, doc-hash, hlc, dead) listing for mismatched ranges."""
+    from . import repair as _repair
+
+    node = getattr(ds, "cluster", None)
+    if node is None:
+        raise SurrealError("not a cluster node")
+    epoch = req.get("epoch")
+    if isinstance(epoch, int) and epoch != node.membership.epoch:
+        # same guard as repair_digests: a cutover landing MID-SWEEP would
+        # partition this listing under a different ring than the
+        # coordinator's range indices — refuse, the sweep re-plans
+        raise SurrealError(
+            f"repair_keys under epoch {epoch} but this node is at "
+            f"{node.membership.epoch} — rings disagree, sweep must re-plan"
+        )
+    idxs = [int(i) for i in (req.get("idxs") or [])]
+    return {"tables": _repair.range_listing(ds, node.membership.ring(), idxs)}
+
+
+def _op_record_fetch(ds, req):
+    """Docs + stamps for explicit record ids (read-repair / sweep pulls)."""
+    from . import repair as _repair
+
+    return {
+        "records": _repair.fetch_records(
+            ds, str(req.get("ns")), str(req.get("db")), str(req.get("tb")),
+            list(req.get("ids") or []),
+        )
+    }
+
+
+def _op_record_repair(ds, req):
+    """The LWW apply door: migration streams, read-repair back-fills and
+    anti-entropy pushes all land here (cluster/repair.py apply_records)."""
+    from . import repair as _repair
+
+    reason = str(req.get("reason") or "repair")
+    applied = _repair.apply_records(
+        ds, str(req.get("ns")), str(req.get("db")), str(req.get("tb")),
+        list(req.get("records") or []), reason=reason,
+    )
+    return {"applied": applied}
+
+
 _OPS = {
     "ping": _op_ping,
     "query": _op_query,
@@ -313,4 +433,12 @@ _OPS = {
     "bundle": _op_bundle,
     "metrics": _op_metrics,
     "events": _op_events,
+    # elastic membership + convergent repair
+    "member_update": _op_member_update,
+    "membership": _op_membership,
+    "migrate_ranges": _op_migrate_ranges,
+    "repair_digests": _op_repair_digests,
+    "repair_keys": _op_repair_keys,
+    "record_fetch": _op_record_fetch,
+    "record_repair": _op_record_repair,
 }

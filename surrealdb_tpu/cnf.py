@@ -230,6 +230,20 @@ CLUSTER_BREAKER_COOLDOWN_SECS = _env_float("SURREAL_CLUSTER_BREAKER_COOLDOWN", 5
 CLUSTER_MAX_INFLIGHT = _env_int("SURREAL_CLUSTER_MAX_INFLIGHT", 64)
 CLUSTER_ADMIT_QUEUE = _env_int("SURREAL_CLUSTER_ADMIT_QUEUE", 128)
 CLUSTER_ADMIT_WAIT_SECS = _env_float("SURREAL_CLUSTER_ADMIT_WAIT", 2.0)
+# Elastic membership + convergent repair (cluster/membership.py,
+# cluster/repair.py): shard-migration stream batch size (records per
+# record_repair RPC), the anti-entropy sweep interval (0 disables the
+# supervised background sweep service — sweeps still run on demand via
+# repair.sweep_once), and the read-repair in-flight cap (at most this many
+# concurrent divergence back-fills; further divergences stay counted but
+# wait for the next read or sweep).
+CLUSTER_MIGRATE_BATCH = _env_int("SURREAL_CLUSTER_MIGRATE_BATCH", 256)
+CLUSTER_ANTIENTROPY_INTERVAL_SECS = _env_float(
+    "SURREAL_CLUSTER_ANTIENTROPY_INTERVAL", 0.0
+)
+CLUSTER_READ_REPAIR_MAX_INFLIGHT = _env_int(
+    "SURREAL_CLUSTER_READ_REPAIR_MAX_INFLIGHT", 8
+)
 
 # Failpoint fault-injection engine (surrealdb_tpu/faults.py):
 # "site=action[:prob][:count],..." spec string + the seed that makes a

@@ -344,6 +344,16 @@ def _insert_table_batch(ctx, plan: _TablePlan, batch, relation, ignore, out_kind
     d_docs: List[dict] = []
     cf_rids: List[Thing] = []
     cf_batch = plan.cf and cnf.CHANGEFEED_BATCH
+    # cluster mode: bulk rows carry the same per-record HLC stamps as the
+    # per-row path (kvs/tx.py set_record) — migration/anti-entropy treat
+    # bulk-ingested and row-written records identically
+    stamp_hlc = txn.hlc_node is not None
+    meta_pre = None
+    if stamp_hlc:
+        from surrealdb_tpu import faults as _faults
+        from surrealdb_tpu.cluster import hlc as _hlc
+
+        meta_pre = keys.record_meta_prefix(ns, db, tb)
 
     out: List[Any] = []
     for rid, row in batch:
@@ -409,6 +419,12 @@ def _insert_table_batch(ctx, plan: _TablePlan, batch, relation, ignore, out_kind
             d_ids.append(rid.id)
             d_keys.append(ke)
             d_docs.append(current)
+        if stamp_hlc:
+            _faults.fire("cluster.hlc.stamp")
+            txn.set(
+                meta_pre + ke,
+                pack({"hlc": _hlc.encode(_hlc.now(txn.hlc_node))}),
+            )
         out.append(current if out_kind == "after" else _SKIPPED)
 
     if cf_rids:

@@ -50,6 +50,13 @@ KINDS: Dict[str, str] = {
     "cluster.degraded_read": "a scatter read failed over onto replicas",
     "cluster.degraded_write": "a routed write tolerated a down replica",
     "cluster.admission_shed": "admission control shed a statement",
+    # elastic membership + convergent repair
+    "cluster.member_join": "a node joined the membership (epoch bumped)",
+    "cluster.member_leave": "a node left the membership (epoch bumped)",
+    "cluster.migration_start": "background shard migration began for an epoch",
+    "cluster.migration_done": "shard migration finished (or failed) for an epoch",
+    "cluster.read_repair": "a divergent read back-filled a stale replica",
+    "cluster.antientropy_repair": "an anti-entropy sweep repaired stale copies",
     # failpoints / chaos
     "fault.trip": "an armed failpoint site fired",
     # background machinery

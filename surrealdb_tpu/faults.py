@@ -42,6 +42,10 @@ Site catalog (the layers with recovery stories; `bg.<kind>` is a family):
 ``cluster.rpc.send``    client request, before the socket write
 ``cluster.rpc.recv``    client response body (corrupt = truncated CBOR)
 ``cluster.rpc.handle``  server-side op execution
+``cluster.hlc.stamp``   the write-path HLC stamp mint (pre-commit failure)
+``cluster.migrate.stream``  one shard-migration batch, before its RPC
+``cluster.migrate.cutover`` a member's ring cutover (epoch commit)
+``cluster.repair.sweep``    one anti-entropy peer leg, before the digests
 ``bg.<kind>``           any background task body (bg.run lifecycle)
 ``cf.gc``               the changefeed GC sweep
 ====================== ====================================================

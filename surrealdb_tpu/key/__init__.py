@@ -24,6 +24,7 @@ Layout (own design, same roles as reference core/src/key/mod.rs:1-77):
     /*{ns}*{db}*{tb}!ft{ft}             foreign (view) table link
     /*{ns}*{db}*{tb}!lq{uuid}           live query registration
     /*{ns}*{db}*{tb}*{id}               record
+    /*{ns}*{db}*{tb}^{id}               record replication meta (HLC stamp / tombstone)
     /*{ns}*{db}*{tb}~{id}{dir}{ft}{fk}  graph edge pointer (dir: '<' in, '>' out)
     /*{ns}*{db}*{tb}+{ix}*{vals}{id}    index entry (non-unique)
     /*{ns}*{db}*{tb}+{ix}=,{vals}       unique index entry (value = record id)
@@ -293,6 +294,25 @@ def thing_prefix(ns: str, db: str, tb: str) -> bytes:
 
 def decode_thing_id(key: bytes, ns: str, db: str, tb: str) -> Any:
     pre = thing_prefix(ns, db, tb)
+    v, _ = dec_value_key(key, len(pre))
+    return v
+
+
+# ------------------------------------------------------------------- record meta
+# /*{ns}*{db}*{tb}^{id}: per-record replication metadata — the HLC
+# last-writer-wins stamp minted on every cluster write, and DELETE
+# tombstones ({"dead": true}) so anti-entropy can tell "deleted" from
+# "never written". Separate keyspace: record scans must never see it.
+def record_meta(ns: str, db: str, tb: str, id_: Any) -> bytes:
+    return _tb(ns, db, tb) + b"^" + enc_value_key(id_)
+
+
+def record_meta_prefix(ns: str, db: str, tb: str) -> bytes:
+    return _tb(ns, db, tb) + b"^"
+
+
+def decode_record_meta_id(key: bytes, ns: str, db: str, tb: str) -> Any:
+    pre = record_meta_prefix(ns, db, tb)
     v, _ = dec_value_key(key, len(pre))
     return v
 

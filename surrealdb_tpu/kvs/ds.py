@@ -375,6 +375,11 @@ class Datastore:
         txn._column_mirrors = self.column_mirrors
         txn._commit_lock = self.commit_lock
         txn._group = self.group_commit
+        cluster = self.cluster
+        if cluster is not None:
+            # cluster mode: every record write mints an HLC stamp under
+            # this node's identity (cluster/hlc.py LWW convergence)
+            txn.hlc_node = cluster.node_id
         return txn
 
     # ------------------------------------------------------------ notifications

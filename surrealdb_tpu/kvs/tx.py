@@ -57,6 +57,10 @@ class Transaction:
         # rebuild resurrect state the uncommitted delete was about to erase)
         self._on_commit: List = []
         self._commit_lock = None  # set by Datastore.transaction
+        # HLC last-writer-wins stamping (cluster/hlc.py): the node id to
+        # mint per-record write stamps under, or None (single-node mode —
+        # the stamp keyspace stays empty, zero overhead)
+        self.hlc_node: Optional[str] = None
         self.write = backend.write
 
     # ------------------------------------------------------------ lifecycle
@@ -745,11 +749,47 @@ class Transaction:
         self.touched_tables.add((ns, db, tb))
         self.touched_row_tables.add((ns, db, tb))
         self.tr.set(keys.thing(ns, db, tb, id_), pack(doc))
+        if self.hlc_node is not None:
+            self.mint_stamp(ns, db, tb, id_)
 
     def del_record(self, ns: str, db: str, tb: str, id_: Any) -> None:
         self.touched_tables.add((ns, db, tb))
         self.touched_row_tables.add((ns, db, tb))
         self.tr.delete(keys.thing(ns, db, tb, id_))
+        if self.hlc_node is not None:
+            # tombstone: anti-entropy must tell "deleted" from "never
+            # written", or a stale replica's copy would resurrect the record
+            self.mint_stamp(ns, db, tb, id_, dead=True)
+
+    # ------------------------------------------------------------ HLC stamps
+    def mint_stamp(self, ns: str, db: str, tb: str, id_: Any, dead: bool = False) -> None:
+        """Mint + write this record's LWW stamp under THIS node's identity
+        (the cluster write path; no-op shape — callers gate on hlc_node)."""
+        from surrealdb_tpu import faults
+        from surrealdb_tpu.cluster import hlc
+
+        # chaos hook BEFORE the mint: an injected failure here fails the
+        # statement pre-commit — the write provably did not land half-stamped
+        faults.fire("cluster.hlc.stamp")
+        self.put_stamp(ns, db, tb, id_, hlc.now(self.hlc_node), dead=dead)
+
+    def put_stamp(
+        self, ns: str, db: str, tb: str, id_: Any, stamp, dead: bool = False
+    ) -> None:
+        """Write an EXPLICIT stamp (repair/migration apply: the origin
+        replica's stamp must ride along, not be re-minted)."""
+        from surrealdb_tpu.cluster import hlc
+
+        meta: Dict[str, Any] = {"hlc": hlc.encode(stamp)}
+        if dead:
+            meta["dead"] = True
+        self.tr.set(keys.record_meta(ns, db, tb, id_), pack(meta))
+
+    def get_record_meta(self, ns: str, db: str, tb: str, id_: Any) -> Optional[dict]:
+        """The record's replication meta ({"hlc": [...], "dead"?: true}),
+        or None when never stamped (pre-cluster data)."""
+        raw = self.tr.get(keys.record_meta(ns, db, tb, id_))
+        return None if raw is None else unpack(raw)
 
     def record_exists(self, ns: str, db: str, tb: str, id_: Any) -> bool:
         return self.tr.exists(keys.thing(ns, db, tb, id_))

@@ -1034,7 +1034,11 @@ class Server:
         try:
             ds.bootstrap()
         except Exception:  # noqa: BLE001 — single-node boot must not die
-            pass
+            from surrealdb_tpu import telemetry
+
+            # counted, not silent: a boot that skipped node registration
+            # serves fine single-node but is a membership-protocol gap
+            telemetry.inc("bootstrap_errors")
         # periodic maintenance (heartbeat + membership + changefeed GC —
         # reference engine/tasks.rs)
         self._tick_stop = threading.Event()

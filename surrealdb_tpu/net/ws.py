@@ -185,7 +185,9 @@ class DaemonPool:
                 # context (tracing.py) survives the thread hand-off
                 cvctx.run(fn, *args)
             except Exception:  # noqa: BLE001 — tasks report their own errors
-                pass
+                # through their response frames; count the escape so a
+                # crashing pool task is visible on /metrics regardless
+                telemetry.inc("ws_pool_task_errors")
             finally:
                 done.set()
                 telemetry.gauge_add("ws_inflight", -1)

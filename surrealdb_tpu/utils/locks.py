@@ -73,16 +73,26 @@ HIERARCHY: Dict[str, int] = {
     "iam.jwks": 56,            # JWKS fetch cache
     "notification.hub": 58,    # live-query channel map
     "sdk.ws_client": 60,       # SDK WS pending/notification maps
+    "cluster.membership": 61,  # membership epoch + ring versions (snapshot-
+                               # and-release: held for pure reads/installs,
+                               # never across an RPC or another lock)
     "net.ws_send": 62,         # per-socket write framing
     "cluster.breaker": 63,     # per-node circuit-breaker state (never nests
                                # with cluster.client; both only precede
                                # the observability leaves)
     "cluster.client": 64,      # cluster node-health map (leaf-ish: only
                                # telemetry may nest inside it)
+    "cluster.migration": 65,   # shard-migration stream progress (leaf-style:
+                               # counters mutated and released, no calls out)
+    "cluster.repair": 66,      # anti-entropy sweep state + read-repair
+                               # in-flight set (leaf-style, no calls out)
     # storage leaves
     "kvs.version_store": 70,   # MVCC version chains
     "kvs.file": 72,            # file-backend WAL
     "kvs.mem": 74,             # in-memory backend (RLock)
+    "cluster.hlc": 76,         # hybrid-logical-clock state (write-path
+                               # stamp mint + remote-stamp observe: a pure
+                               # tuple update under any commit/write lock)
     # observability leaves (any layer may record into these; must be last)
     "faults": 78,              # failpoint engine (fires under any engine
                                # lock — commit, dispatch, rpc)

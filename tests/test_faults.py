@@ -517,11 +517,16 @@ def test_admission_control_sheds_fast_with_retryable_error(cluster3):
         ) = saved
 
 
-def test_diverged_replicas_serve_the_ring_first_copy(cluster3):
-    """Review fix: when replica copies of a record DIFFER (a missed write,
-    a stale rejoin), reads serve the EARLIEST replica in ring order — the
-    write-reporter rule — and count cluster_read_divergence, instead of
-    whichever node id happens to sort first."""
+def test_diverged_replicas_serve_the_lww_winner(cluster3):
+    """ISSUE 14: when replica copies of a record DIFFER, reads serve the
+    LAST WRITER by HLC stamp — regardless of ring position — count
+    cluster_read_divergence, and a background read-repair converges the
+    stale copy. With stamps stripped (pre-HLC data) the ring-order
+    write-reporter rule remains the fallback."""
+    import time as _t
+
+    from surrealdb_tpu import key as _keys
+
     c = cluster3
     c.both("DEFINE TABLE dv SCHEMALESS")
     r = c.coord.execute("CREATE dv:1 SET v = 'orig'", c.s)[0]
@@ -529,16 +534,40 @@ def test_diverged_replicas_serve_the_ring_first_copy(cluster3):
     ring = c.coord.cluster.ring
     replicas = ring.owners_of("dv", 1, 2)
     by_id = {f"n{i + 1}": ds for i, ds in enumerate(c.datastores)}
-    # diverge the SECOND replica's copy behind the cluster's back
+    # diverge the SECOND replica's copy behind the cluster's back: its
+    # write is the LAST one, so LWW must serve it (the old ring-first rule
+    # would have hidden it forever)
     ok(by_id[replicas[1]].execute_local("UPDATE dv:1 SET v = 'stale'", c.s)[0])
     d0 = counter_sum("cluster_read_divergence")
     got = ok(c.coord.execute("SELECT VALUE v FROM dv", c.s)[0])
-    assert got == ["orig"], (got, replicas)
+    assert got == ["stale"], (got, replicas)
     assert counter_sum("cluster_read_divergence") > d0
-    # now diverge the FIRST replica instead: its copy is canon
-    ok(by_id[replicas[0]].execute_local("UPDATE dv:1 SET v = 'newer'", c.s)[0])
+    # ...and the read armed a back-fill: every replica converges to the
+    # winner without the record being rewritten
+    deadline = _t.time() + 10
+    while _t.time() < deadline:
+        vals = [
+            by_id[n].execute_local("SELECT VALUE v FROM dv", c.s)[0]["result"]
+            for n in replicas
+        ]
+        if all(v == ["stale"] for v in vals):
+            break
+        _t.sleep(0.05)
+    assert all(v == ["stale"] for v in vals), vals
+    assert counter_sum("cluster_read_repair_total") >= 1
+
+    # fallback: strip BOTH stamps (pre-HLC data) and diverge again — the
+    # earliest replica in ring order is canon, exactly the r12 rule
+    ok(by_id[replicas[0]].execute_local("UPDATE dv:1 SET v = 'first'", c.s)[0])
+    ok(by_id[replicas[1]].execute_local("UPDATE dv:1 SET v = 'second'", c.s)[0])
+    for n in replicas:
+        ds = by_id[n]
+        txn = ds.transaction(True)
+        txn.tr.delete(_keys.record_meta("t", "t", "dv", 1))
+        txn.commit()
     got = ok(c.coord.execute("SELECT VALUE v FROM dv", c.s)[0])
-    assert got == ["newer"], (got, replicas)
+    # ring order, not node-id order: replicas[0] is the record's primary
+    assert got == ["first"], (got, replicas)
 
 
 def test_breaker_half_open_trial_released_on_engine_class_fault(cluster3):
