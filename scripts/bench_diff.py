@@ -35,8 +35,17 @@ new bundle — one node's compile cache missing shapes its peers compiled,
 a breaker open toward a member the rest consider alive, a column mirror
 stale on one node but fresh on the others (the one-node-p99 signatures).
 
+`--statements` compares the two runs' per-statement-FINGERPRINT stats
+(schema /12 `statements.top` embeds, stats.py): per-shape qps and p99
+regressions beyond the threshold, and PLAN-MIX FLIPS — the dominant scan
+decision changing between runs (columnar-pipeline -> row after a mirror
+decline or a degraded-write stand-down), the regression EXPLAIN can't
+show because nobody re-ran EXPLAIN. Each flagged fingerprint prints its
+normalized SQL, both mix vectors, and the in-window flip log.
+
 Also importable: `diff(old_art, new_art, threshold) -> list[dict]`,
 `diff_bundles(old_bundle, new_bundle) -> dict`,
+`diff_statements(old_art, new_art, threshold) -> list[dict]`,
 `diff_federated(old, new) -> dict` and `peer_drift(bundle) -> list[str]`.
 """
 
@@ -516,6 +525,119 @@ def diff_federated(old: dict, new: dict) -> dict:
     return out
 
 
+# ------------------------------------------------------------------ statements
+def _statements_by_fp(art: dict) -> Dict[str, dict]:
+    """Every statement-fingerprint entry embedded in an artifact's config
+    lines (schema /12 `statements.top`), keyed by fingerprint. An entry
+    appearing in several config windows keeps the one with more calls
+    (bench resets the store per window, so windows never double-count)."""
+    out: Dict[str, dict] = {}
+    for r in art.get("results") or []:
+        st = r.get("statements")
+        if not isinstance(st, dict):
+            continue
+        for ent in st.get("top") or []:
+            if not isinstance(ent, dict) or not ent.get("fingerprint"):
+                continue
+            fp = str(ent["fingerprint"])
+            cur = out.get(fp)
+            if cur is None or (ent.get("calls") or 0) > (cur.get("calls") or 0):
+                out[fp] = dict(ent, config=r.get("config"))
+    return out
+
+
+def _dominant_mix(ent: dict) -> Optional[str]:
+    mix = ent.get("plan_mix") or {}
+    scan = {
+        k: v
+        for k, v in mix.items()
+        if isinstance(v, (int, float))
+        and (str(k).startswith(("columnar", "knn-")) or k in ("row", "index"))
+    }
+    if not scan:
+        return None
+    return max(sorted(scan), key=lambda k: scan[k])
+
+
+def diff_statements(
+    old: dict, new: dict, threshold: float = 0.25
+) -> List[dict]:
+    """Per-fingerprint comparison of two artifacts' statement stats: the
+    culprit list the re-measure checklist reads. Flags
+    - qps regressions (calls/total_s throughput down beyond threshold),
+    - p99 latency regressions beyond threshold,
+    - PLAN-MIX FLIPS: the dominant scan decision changed between the two
+      runs (columnar-pipeline -> row is the silent regression EXPLAIN
+      can't show), or the entry's own flip counter went up."""
+    o_by, n_by = _statements_by_fp(old), _statements_by_fp(new)
+    rows: List[dict] = []
+    for fp in sorted(set(o_by) & set(n_by)):
+        oe, ne = o_by[fp], n_by[fp]
+        flags: List[str] = []
+        o_qps = (oe.get("calls") or 0) / (oe.get("total_s") or 1e-9)
+        n_qps = (ne.get("calls") or 0) / (ne.get("total_s") or 1e-9)
+        d_qps = _rel(o_qps, n_qps)
+        if d_qps is not None and d_qps < -threshold:
+            flags.append(f"qps {o_qps:.1f} -> {n_qps:.1f} ({d_qps * 100:+.0f}%)")
+        d_p99 = _rel(oe.get("p99_ms"), ne.get("p99_ms"))
+        if d_p99 is not None and d_p99 > threshold:
+            flags.append(
+                f"p99 {oe.get('p99_ms')}ms -> {ne.get('p99_ms')}ms "
+                f"({d_p99 * 100:+.0f}%)"
+            )
+        o_dom, n_dom = _dominant_mix(oe), _dominant_mix(ne)
+        if o_dom is not None and n_dom is not None and o_dom != n_dom:
+            flags.append(f"plan-mix flip: {o_dom} -> {n_dom}")
+        if (ne.get("plan_flips") or 0) > (oe.get("plan_flips") or 0):
+            flags.append(
+                f"in-window plan flips: {oe.get('plan_flips') or 0} -> "
+                f"{ne.get('plan_flips') or 0} (flip_log: "
+                f"{json.dumps(ne.get('flip_log') or [])})"
+            )
+        rows.append(
+            {
+                "fingerprint": fp,
+                "sql": ne.get("sql"),
+                "config": ne.get("config"),
+                "old": {"qps": round(o_qps, 2), "p99_ms": oe.get("p99_ms"),
+                        "mix": oe.get("plan_mix"), "dominant": o_dom},
+                "new": {"qps": round(n_qps, 2), "p99_ms": ne.get("p99_ms"),
+                        "mix": ne.get("plan_mix"), "dominant": n_dom},
+                "flags": flags,
+            }
+        )
+    return rows
+
+
+def _main_statements(old: dict, new: dict, threshold: float) -> int:
+    rows = diff_statements(old, new, threshold)
+    if not rows:
+        print(
+            "no shared statement fingerprints between the two artifacts "
+            "(schema /12 embeds required)",
+            file=sys.stderr,
+        )
+        return 2
+    flagged = 0
+    for r in rows:
+        head = (
+            f"{r['fingerprint']} (config {r['config']}): "
+            f"{r['old']['qps']} -> {r['new']['qps']} qps, "
+            f"p99 {r['old']['p99_ms']} -> {r['new']['p99_ms']} ms"
+        )
+        print(("FLAG  " if r["flags"] else "ok    ") + head)
+        if r["flags"]:
+            print(f"      sql: {str(r['sql'])[:120]}")
+        for fl in r["flags"]:
+            print(f"      - {fl}")
+        flagged += bool(r["flags"])
+    print(
+        f"{flagged}/{len(rows)} fingerprint(s) flagged "
+        f"(threshold {threshold * 100:.0f}%)"
+    )
+    return 1 if flagged else 0
+
+
 def _main_bundles(old_doc: dict, new_doc: dict) -> int:
     ob, nb = _as_bundle(old_doc), _as_bundle(new_doc)
     if ob is None or nb is None:
@@ -573,6 +695,11 @@ def main(argv: List[str]) -> int:
         help="diff the two runs' debug bundles (mirror staleness, "
         "compile-cache drift) instead of the metric lines",
     )
+    ap.add_argument(
+        "--statements", action="store_true",
+        help="diff the two runs' per-statement-fingerprint stats (schema "
+        "/12): qps/p99 regressions and plan-mix flips, named per shape",
+    )
     try:
         ns = ap.parse_args(argv)
     except SystemExit:
@@ -588,6 +715,8 @@ def main(argv: List[str]) -> int:
         return 2
     if ns.bundles:
         return _main_bundles(old, new)
+    if ns.statements:
+        return _main_statements(old, new, threshold)
     rows = diff(old, new, threshold)
     if not rows:
         print("no comparable configs between the two artifacts", file=sys.stderr)

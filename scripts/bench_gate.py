@@ -40,6 +40,9 @@ Env knobs:
     SURREAL_BENCH_GATE_CHAOS_ERRORS  config-8 chaos-window error ceiling
                                    (default 3; zero wrong answers is a
                                    hard rule regardless — the ISSUE 9 bar)
+    SURREAL_BENCH_GATE_PROFILER_OVERHEAD  sampling-profiler overhead ceiling
+                                   in percent on the config-2 engine path
+                                   (default 3.0 — the always-on contract)
     SURREAL_BENCH_GATE_TIMEOUT     whole-run timeout seconds (default 1200)
 
 Exit code 0 = gate passed; 1 = gate failed (reasons on stderr).
@@ -72,6 +75,13 @@ REPAIR_CEILING_S = float(os.environ.get("SURREAL_BENCH_GATE_REPAIR_CEILING", "60
 # vectorized SELECT pipeline (config 9): ORDER BY+LIMIT and GROUP BY
 # aggregate columnar/row speedup floor (the ISSUE 13 acceptance bar)
 FLOOR_PIPE_RATIO = float(os.environ.get("SURREAL_BENCH_GATE_PIPE_RATIO", "5.0"))
+# workload statistics plane (schema/12): the always-on sampling profiler's
+# measured overhead on the config-2 engine path must stay under this
+# ceiling (percent; the ISSUE 15 <=3% contract — bench.py reports the
+# noise-cancelling paired minimum, see _profiler_overhead)
+PROFILER_OVERHEAD_CEILING = float(
+    os.environ.get("SURREAL_BENCH_GATE_PROFILER_OVERHEAD", "3.0")
+)
 TIMEOUT = int(os.environ.get("SURREAL_BENCH_GATE_TIMEOUT", "1200"))
 
 
@@ -140,6 +150,20 @@ def main() -> int:
     recall = line.get("recall_at_10")
     if recall is not None and recall < FLOOR_RECALL:
         failures.append(f"recall@10 {recall} < floor {FLOOR_RECALL}")
+    po = line.get("profiler_overhead") or {}
+    overhead = po.get("overhead_pct")
+    if overhead is None:
+        failures.append("config 2 carries no profiler_overhead measurement")
+    elif overhead > PROFILER_OVERHEAD_CEILING:
+        failures.append(
+            f"sampling-profiler overhead {overhead}% > ceiling "
+            f"{PROFILER_OVERHEAD_CEILING}% (the always-on contract)"
+        )
+    # the statistics plane must have SEEN the window: a /12 artifact whose
+    # config-2 line recorded no fingerprints means recording is broken
+    st = line.get("statements") or {}
+    if not st.get("top"):
+        failures.append("config 2 statements.top is empty — stats plane blind")
     if line.get("slow_over_5s"):
         # warning only: on accelerator-less CI containers the jax-CPU
         # compiles land mid-window and trip this without any engine defect
@@ -342,6 +366,7 @@ def main() -> int:
 
     summary = {
         "qps": qps,
+        "profiler_overhead_pct": overhead,
         "recall_at_10": recall,
         "latency_ms": line.get("latency_ms"),
         "errors": errs,

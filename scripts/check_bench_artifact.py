@@ -23,7 +23,7 @@ import os
 import sys
 from typing import List
 
-SCHEMA = "surrealdb-tpu-bench/11"
+SCHEMA = "surrealdb-tpu-bench/12"
 # earlier rounds' committed artifacts stay validatable under their own rules
 KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/1",
@@ -36,6 +36,7 @@ KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/8",
     "surrealdb-tpu-bench/9",
     "surrealdb-tpu-bench/10",
+    "surrealdb-tpu-bench/11",
     SCHEMA,
 )
 
@@ -113,6 +114,17 @@ KERNEL_AUDIT_KEYS = ("schema", "kernels", "summary")
 # nothing to analyze must make the artifact INVALID, not vacuously green.
 FLOW_AUDIT_STATS = ("nodes", "edges", "lock_sites")
 CLUSTER_OBS_KEYS = ("bundle", "slowest_profile", "live_nodes")
+# schema/12 (workload statistics plane): every config line embeds its
+# window's top statement fingerprints + profiler summary; on the
+# columnar-pipeline configs (6 filtered_scan, 9 ordered_agg) at least one
+# fingerprint must carry a NON-EMPTY plan-mix vector — a statistics plane
+# that watched a pipeline config and recorded no plan decision is
+# invalid, not vacuously green. The config-2 line must carry the
+# profiler-overhead A/B (bench_gate ceilings it); /12 bundles (bundle/6)
+# must carry the `statements` + `profiler` sections.
+STATEMENTS_TOP_KEYS = ("fingerprint", "sql", "calls", "plan_mix")
+PROFILER_OVERHEAD_KEYS = ("rounds", "on_s", "off_s", "overhead_pct")
+PLAN_MIX_CONFIGS = ("6", "9")
 COMPILES_KEYS = ("on_demand", "prewarm", "events")
 BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
 BATCH_KEYS_V3 = BATCH_KEYS + ("width_dist", "pipeline_wait_s")
@@ -207,7 +219,8 @@ def validate(path: str) -> List[str]:
     if art.get("schema") not in KNOWN_SCHEMAS:
         problems.append(f"schema is {art.get('schema')!r}, expected one of {KNOWN_SCHEMAS}")
     schema = art.get("schema")
-    v11 = schema == SCHEMA
+    v12 = schema == SCHEMA
+    v11 = v12 or schema == "surrealdb-tpu-bench/11"
     v10 = v11 or schema == "surrealdb-tpu-bench/10"
     v9 = v10 or schema == "surrealdb-tpu-bench/9"
     v8 = v9 or schema == "surrealdb-tpu-bench/8"
@@ -233,9 +246,13 @@ def validate(path: str) -> List[str]:
             problems.append("schema/5 artifact missing the embedded debug bundle")
         else:
             sections = (
-                BUNDLE_SECTIONS_V9
-                if v9
-                else (BUNDLE_SECTIONS_V8 if v8 else BUNDLE_SECTIONS)
+                BUNDLE_SECTIONS_V9 + ("statements", "profiler")
+                if v12
+                else (
+                    BUNDLE_SECTIONS_V9
+                    if v9
+                    else (BUNDLE_SECTIONS_V8 if v8 else BUNDLE_SECTIONS)
+                )
             )
             for sec in sections:
                 if sec not in bundle:
@@ -510,6 +527,61 @@ def validate(path: str) -> List[str]:
                         problems.append(
                             f"{where} ({metric}): slowest_profile shard "
                             f"timings missing live node(s) {missing_nodes}"
+                        )
+        if v12:
+            st_obj = r.get("statements")
+            if not isinstance(st_obj, dict):
+                problems.append(
+                    f"{where} ({metric}): schema/12 config lines must carry "
+                    "the 'statements' object (top fingerprints + profiler "
+                    "window summary)"
+                )
+            else:
+                top = st_obj.get("top")
+                if not isinstance(top, list):
+                    problems.append(
+                        f"{where} ({metric}): statements.top must be a list"
+                    )
+                else:
+                    for j, ent in enumerate(top):
+                        for key in STATEMENTS_TOP_KEYS:
+                            if not isinstance(ent, dict) or key not in ent:
+                                problems.append(
+                                    f"{where} ({metric}): statements.top[{j}] "
+                                    f"missing {key!r}"
+                                )
+                                break
+                    if str(r.get("config")) in PLAN_MIX_CONFIGS and not any(
+                        isinstance(ent, dict)
+                        and any(
+                            str(k).startswith("columnar")
+                            for k in (ent.get("plan_mix") or {})
+                        )
+                        for ent in top
+                    ):
+                        problems.append(
+                            f"{where} ({metric}): a pipeline config's "
+                            "statements.top shows no columnar plan-mix "
+                            "decision — the statistics plane never saw the "
+                            "pipeline engage"
+                        )
+                if not isinstance(st_obj.get("profiler"), dict):
+                    problems.append(
+                        f"{where} ({metric}): statements.profiler must be an "
+                        "object (the sampler's window summary)"
+                    )
+        if v12 and str(r.get("config")) == "2" and metric.startswith("knn_qps"):
+            po = r.get("profiler_overhead")
+            if not isinstance(po, dict):
+                problems.append(
+                    f"{where} ({metric}): schema/12 config-2 must carry the "
+                    "'profiler_overhead' A/B object"
+                )
+            else:
+                for key in PROFILER_OVERHEAD_KEYS:
+                    if key not in po:
+                        problems.append(
+                            f"{where} ({metric}): profiler_overhead missing {key!r}"
                         )
         if v4 and metric.startswith("filtered_scan"):
             for key in FILTERED_SCAN_KEYS:

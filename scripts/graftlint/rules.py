@@ -819,3 +819,85 @@ def gl008(modules: List[Module]) -> List[Finding]:
                         )
                     )
     return out
+
+
+# ------------------------------------------------------------------ GL012
+# The statement-statistics store (surrealdb_tpu/stats.py) has ONE write
+# door: stats.record(). It owns the lock discipline (mutate under
+# stats.store, emit events/counters only after release) and the plan-flip
+# detection; an ad-hoc writer reaching into the private store, the
+# activation table, or the entry class would bypass both. Outside
+# stats.py, touching any private member of the stats module is a finding.
+GL012_ALLOWED_FILES = frozenset({"surrealdb_tpu/stats.py"})
+GL012_STATS_MODULE = "surrealdb_tpu.stats"
+GL012_PRIVATE = frozenset(
+    {"_store", "_lock", "_active_by_thread", "_Entry", "_evicted",
+     "_note_evictions"}
+)
+
+
+def _gl012_stats_aliases(m: Module) -> Set[str]:
+    """Every local NAME the stats module is bound to in this file
+    (`from surrealdb_tpu import stats [as _stats]`,
+    `import surrealdb_tpu.stats as x`). A plain
+    `import surrealdb_tpu.stats` binds only `surrealdb_tpu` — that access
+    path is matched as the dotted chain in gl012(), not as an alias."""
+    out: Set[str] = set()
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == GL012_STATS_MODULE and a.asname:
+                    out.add(a.asname)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                if (
+                    f"{node.module}.{a.name}" == GL012_STATS_MODULE
+                    or (a.name == "stats" and node.module == "surrealdb_tpu")
+                ):
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _gl012_dotted(node) -> Optional[str]:
+    """`a.b.c` rendered as a dotted name, None when the chain's root is
+    not a plain Name (a call/subscript can't be the module)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@_rule("GL012", "ad-hoc access to the statement-stats store outside stats.record()")
+def gl012(modules: List[Module]) -> List[Finding]:
+    out: List[Finding] = []
+    for m in modules:
+        if m.rel in GL012_ALLOWED_FILES:
+            continue
+        aliases = _gl012_stats_aliases(m)
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if node.attr not in GL012_PRIVATE:
+                continue
+            via_alias = (
+                isinstance(node.value, ast.Name) and node.value.id in aliases
+            )
+            # the dotted form a plain `import surrealdb_tpu.stats` enables
+            via_dotted = _gl012_dotted(node.value) == GL012_STATS_MODULE
+            if not (via_alias or via_dotted):
+                continue
+            out.append(
+                Finding(
+                    "GL012", m.rel, node.lineno, node.col_offset,
+                    f"stats.{node.attr} accessed outside stats.py — "
+                    "statement-stats recording must go through "
+                    "stats.record() (the one door that keeps the lock "
+                    "discipline and the plan-flip detection honest)",
+                    f"GL012:{m.rel}:{m.enclosing_def(node)}:{node.attr}",
+                )
+            )
+    return out

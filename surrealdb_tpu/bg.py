@@ -51,6 +51,7 @@ KIND_DEADLINES: Dict[str, float] = {
     "changefeed_gc": 60.0,
     "index_build": 900.0,
     "cluster_read_repair": 60.0,
+    "cluster_tombstone_gc": 120.0,
 }
 
 _STATES = ("scheduled", "running", "done", "failed", "stalled")

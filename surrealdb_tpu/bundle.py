@@ -43,6 +43,15 @@ versioned document — the artifact you attach to any perf report:
                      check_bench_artifact rejects a /5 bundle whose
                      call-graph stats are empty: a silently-degraded
                      analyzer must be INVALID, not vacuously green.
+12. `statements`   — the workload statistics plane (stats.py): per-
+                     statement-fingerprint cumulative stats — calls,
+                     errors, latency quantiles, rows in/out, the
+                     plan-mix vector and plan-flip log — plus store
+                     size and eviction count (new in bundle/6);
+13. `profiler`     — the always-on sampling profiler's report
+                     (profiler.py): per-thread (`bg:<kind>`-named) and
+                     per-fingerprint sample counts and the hottest
+                     folded stacks (new in bundle/6).
 
 Served by `GET /debug/bundle` (system-user-gated) and embedded via
 `INFO FOR ROOT` (`system.bundle`); bench.py embeds one per artifact so a
@@ -62,19 +71,22 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-BUNDLE_SCHEMA = "surrealdb-tpu-bundle/5"
+BUNDLE_SCHEMA = "surrealdb-tpu-bundle/6"
 
 # the sections every consumer may rely on
 SECTIONS = (
     "traces", "slow_queries", "errors", "tasks", "compiles", "engine",
     "locks", "faults", "events", "kernel_audit", "flow_audit",
+    "statements", "profiler",
 )
 
 
 def debug_bundle(
     ds=None, trace_limit: int = 50, full_traces: int = 10
 ) -> Dict[str, Any]:
-    from surrealdb_tpu import bg, compile_log, events, faults, telemetry, tracing
+    from surrealdb_tpu import (
+        bg, compile_log, events, faults, profiler, stats, telemetry, tracing,
+    )
     from surrealdb_tpu.utils import locks
 
     ids = tracing.trace_ids()
@@ -102,6 +114,8 @@ def debug_bundle(
         "events": events.snapshot(),
         "kernel_audit": _kernel_audit_state(),
         "flow_audit": _flow_audit_state(),
+        "statements": stats.snapshot(),
+        "profiler": profiler.report(),
     }
     return out
 

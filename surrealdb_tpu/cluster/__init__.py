@@ -52,6 +52,7 @@ def attach(ds, config: ClusterConfig):
     from . import repair as _repair
 
     _repair.start_service(ds)
+    _repair.start_tombstone_gc(ds)
     return node
 
 

@@ -410,6 +410,15 @@ class ClusterExecutor:
                 ctx = _StmtCtx(cnf.CLUSTER_RETRY_BUDGET)
                 token = _STMT.set(ctx)
                 admitted = False
+                # workload statistics plane: the coordinated statement's
+                # fingerprint — shard-local executions of the SAME text
+                # (the scattered sub-queries) accumulate onto the same
+                # fingerprint through each shard's own executor
+                from surrealdb_tpu import stats as _stats
+
+                fp, _norm = _stats.fingerprint(src)
+                tracing.annotate(fingerprint=fp)
+                fp_tok = _stats.activate(fp)
                 try:
                     self.admission.acquire()
                     admitted = True
@@ -421,6 +430,7 @@ class ClusterExecutor:
                 except Exception as e:  # noqa: BLE001 — mirror Executor's guard
                     resp = _err(f"Internal error: {type(e).__name__}: {e}")
                 finally:
+                    _stats.deactivate(fp_tok)
                     _STMT.reset(token)
                     if admitted:
                         self.admission.release()
@@ -445,7 +455,7 @@ class ClusterExecutor:
         own ring entries joined in (today a slow remote shard is only
         visible on the remote node; after this it shows up once, here,
         with the per-node breakdown)."""
-        from surrealdb_tpu import telemetry, tracing
+        from surrealdb_tpu import stats, telemetry, tracing
 
         if not ctx.shards:
             # not a scattered statement: the local execution path already
@@ -461,6 +471,25 @@ class ClusterExecutor:
             "auth": getattr(session.auth, "level", None) or "anon",
         }
         errored = resp.get("status") == "ERR"
+        slow = dt >= cnf.SLOW_QUERY_THRESHOLD_SECS
+        notes = telemetry.drain_plan_notes()
+        result = resp.get("result")
+        # the coordinator's record carries the scatter-level decisions;
+        # primary=None — the SCAN decision happened on the shards, whose
+        # own executors record it under the same fingerprint (a scatter
+        # record must not ping-pong the flip detector against them)
+        fp, norm = stats.fingerprint(src)
+        extra_mix = {"scatter": 1}
+        if ctx.degraded:
+            extra_mix["degraded"] = 1
+        if getattr(ctx, "pushdown", None):
+            extra_mix["agg-pushdown"] = 1
+        stats.record(
+            fp, norm, kind, dt,
+            error=errored, slow=slow,
+            rows_out=len(result) if isinstance(result, list) else (0 if errored else 1),
+            plan=None, extra_mix=extra_mix, primary=None,
+        )
         if errored:
             telemetry.inc("statement_errors", kind=kind)
             tracing.force_keep()
@@ -470,6 +499,7 @@ class ClusterExecutor:
                     "kind": kind,
                     "error": str(resp.get("result"))[:300],
                     "trace_id": tracing.current_trace_id(),
+                    "fingerprint": fp,
                     "session": session_info,
                     "cluster": {
                         "shards": profile["shards"],
@@ -477,7 +507,7 @@ class ClusterExecutor:
                     },
                 }
             )
-        if dt >= cnf.SLOW_QUERY_THRESHOLD_SECS:
+        if slow:
             telemetry.inc("slow_queries", kind=kind)
             tracing.force_keep()  # /slow -> /trace/:id must stay one hop
             telemetry.record_slow_query(
@@ -486,8 +516,9 @@ class ClusterExecutor:
                     "sql": src[:500],
                     "kind": kind,
                     "duration_s": round(dt, 6),
-                    "plan": telemetry.drain_plan_notes(),
+                    "plan": notes,
                     "trace_id": tracing.current_trace_id(),
+                    "fingerprint": fp,
                     "session": session_info,
                     "error": str(resp.get("result"))[:500] if errored else None,
                     "cluster": {

@@ -126,6 +126,33 @@ def federated_bundle(
     }
 
 
+def federated_statements(
+    ds, limit: int = 50, fingerprint: Optional[str] = None,
+    sort: str = "total_s",
+) -> list:
+    """`GET /statements?cluster=1`: every member's statement-fingerprint
+    stats merged into one list, each entry tagged `node=<id>` (the /events
+    merge shape), ordered by cumulative time (or the same `sort` keys the
+    single-node view takes) — the cluster-wide answer to "which query
+    shapes are eating the cluster". Dead members are simply absent;
+    per-member entries stay separate (merging two nodes' latency
+    histograms would fabricate a cluster-wide quantile nobody measured)."""
+    key = sort if sort in ("total_s", "calls", "errors", "max_ms") else "total_s"
+    req: Dict[str, Any] = {"limit": limit, "sort": key}
+    if fingerprint:
+        req["fingerprint"] = fingerprint
+    gathered, _ = _gather(ds, "statements", req)
+    merged = []
+    for nid, entries in gathered.items():
+        if not isinstance(entries, list):
+            continue
+        for e in entries:
+            if isinstance(e, dict):
+                merged.append(dict(e, node=nid))
+    merged.sort(key=lambda e: (-(e.get(key) or 0), str(e.get("node"))))
+    return merged[: max(int(limit), 1)]
+
+
 def federated_events(
     ds, kind_prefix: Optional[str] = None, limit: Optional[int] = None
 ) -> list:

@@ -31,6 +31,15 @@ the HLC stamps the write path now mints (cluster/hlc.py):
   resets the executor's write-degradation watermark, so the pipeline
   pushdowns that stood down after a degraded write RESUME once repair has
   proven the replicas converged.
+
+- **tombstone GC** (`tombstone_gc_once` / the supervised
+  `bg:cluster_tombstone_gc` service): DELETE tombstones in the HLC
+  sidecar keyspace are harmless under LWW but accumulate forever; a
+  bounded sweep deletes those older than CLUSTER_TOMBSTONE_TTL_SECS —
+  only after a CLEAN anti-entropy pass has covered their range, so a GC'd
+  tombstone can never let a stale replica resurrect the record.
+  `cluster_tombstones_gced_total` counts deletions; `cluster.tombstone_gc`
+  events mark non-empty passes.
 """
 
 from __future__ import annotations
@@ -41,7 +50,7 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from surrealdb_tpu import cnf
 from surrealdb_tpu import key as keys
-from surrealdb_tpu.err import SurrealError
+from surrealdb_tpu.err import SurrealError, TxConditionNotMetError
 from surrealdb_tpu.key.encode import dec_value_key, prefix_end
 from surrealdb_tpu.utils import locks as _locks
 from surrealdb_tpu.utils.ser import pack, unpack
@@ -457,6 +466,12 @@ def sweep_once(ds, trace_id=None) -> dict:
                 peers_ranges.setdefault(peer, []).append(idx)
     report = {
         "ts": _time.time(),
+        # the sweep's position on the HLC timeline: tombstone-GC coverage
+        # is decided against THIS anchor, never wall clock — the HLC may
+        # legitimately run ahead of wall time (observed skewed members),
+        # and every stamp the dataset held when the pass started is
+        # strictly below a freshly-minted stamp
+        "hlc": hlc.encode(hlc.now(self_id)),
         "epoch": epoch,
         "peers": 0,
         "ranges": 0,
@@ -598,6 +613,135 @@ def last_sweep(cl) -> Optional[dict]:
     with _lock:
         rep = _last_sweep.get(id(cl))
         return dict(rep) if rep is not None else None
+
+
+# ------------------------------------------------------------------ tombstone GC
+def tombstone_gc_once(ds, trace_id=None) -> dict:
+    """One bounded tombstone-GC pass over THIS node's HLC sidecar keyspace
+    (the `^` record-meta keys): delete tombstones (dead=True metas whose
+    doc is gone) older than CLUSTER_TOMBSTONE_TTL_SECS — but ONLY those a
+    clean anti-entropy sweep has covered since they were minted. Under LWW
+    a stale tombstone is harmless but accumulates forever; GC'ing one
+    BEFORE its delete provably propagated could let a stale replica
+    resurrect the record, so the eligibility rule is:
+
+      - the node's last sweep finished with NO per-peer errors (every
+        shared range was actually compared and reconciled), and
+      - the tombstone's stamp predates that sweep's HLC anchor (the
+        delete existed when the pass ran, so the pass propagated it) —
+        compared on the HLC timeline, not wall clock: the HLC may run
+        ahead of wall time after observing a skewed member, and the
+        anchor stamp minted at sweep start is strictly above every stamp
+        the dataset held then (repair/migration applies observe() remote
+        stamps into the local clock first), and
+      - the TTL has elapsed since the tombstone's stamp, measured
+        against the CURRENT clock position on the same timeline.
+
+    Unstamped dead metas (no HLC — a pre-cluster artifact) are left
+    alone: with no mint time neither the TTL nor the coverage rule can be
+    proven for them. Returns the pass report; `cluster_tombstones_gced_total`
+    counts deletions and a `cluster.tombstone_gc` event marks a non-empty
+    pass."""
+    from surrealdb_tpu import events, telemetry
+
+    cl = getattr(ds, "cluster", None)
+    if cl is None:
+        raise RepairError("not a cluster node")
+    report = {"ts": _time.time(), "scanned": 0, "eligible": 0, "swept": 0,
+              "skipped_no_clean_sweep": False}
+    sweep = last_sweep(cl)
+    if sweep is None or sweep.get("errors"):
+        # no clean pass to anchor coverage on: sweep nothing, say why
+        report["skipped_no_clean_sweep"] = True
+        return report
+    anchor = hlc.decode(sweep.get("hlc"))
+    if anchor is None:
+        # a pre-anchor report (older node mid-rolling-upgrade): wall-clock
+        # fallback, strictly more conservative under an ahead-running HLC
+        anchor = (float(sweep.get("ts") or 0.0) * 1000.0, -1, "")
+    ttl_ms = max(cnf.CLUSTER_TOMBSTONE_TTL_SECS, 0.0) * 1000.0
+    now_ms = hlc.now(cl.node_id)[0]
+    doomed: List[Tuple[bytes, bytes]] = []  # (meta key, scanned raw value)
+    for ns, db, tb in all_tables(ds):
+        txn = ds.transaction(False)
+        try:
+            tpre = keys.thing_prefix(ns, db, tb)
+            mpre = keys.record_meta_prefix(ns, db, tb)
+            docs = {k[len(tpre):] for k, _ in txn.scan(tpre, prefix_end(tpre))}
+            metas = list(txn.scan(mpre, prefix_end(mpre)))
+        finally:
+            txn.cancel()
+        for mk, raw in metas:
+            ek = mk[len(mpre):]
+            m = unpack(raw)
+            if not m.get("dead") or ek in docs:
+                continue  # live record, or meta shadowed by a real doc
+            report["scanned"] += 1
+            stamp = hlc.decode(m.get("hlc"))
+            if stamp is None:
+                continue  # unprovable age: keep (see docstring)
+            if (stamp[0], stamp[1]) >= (anchor[0], anchor[1]):
+                continue  # minted AT/AFTER the clean pass: not covered yet
+            if now_ms - stamp[0] < ttl_ms:
+                continue  # covered but younger than the TTL
+            report["eligible"] += 1
+            doomed.append((mk, raw))
+    swept = 0
+    for mk, raw in doomed:
+        # conditional delete against the SCANNED raw value (one small txn
+        # per tombstone): a record re-created between the read scan and
+        # this delete overwrote the meta with a live stamp — deleting it
+        # unconditionally would strip the live record's stamp, and a stale
+        # replica's old tombstone would then win LWW over the unstamped
+        # doc (the resurrection the eligibility rules exist to prevent).
+        # A changed meta simply stays for the next pass to re-judge.
+        txn = ds.transaction(True)
+        try:
+            txn.tr.delc(mk, raw)
+            txn.commit()
+            swept += 1
+        except TxConditionNotMetError:
+            txn.cancel()
+        except BaseException:
+            txn.cancel()
+            raise
+    if swept:
+        report["swept"] = swept
+        telemetry.inc("cluster_tombstones_gced_total", by=float(swept))
+        events.emit(
+            "cluster.tombstone_gc", trace_id=trace_id,
+            swept=swept, epoch=cl.membership.epoch,
+        )
+    return report
+
+
+def start_tombstone_gc(ds) -> None:
+    """The supervised background tombstone sweep: one
+    `bg:cluster_tombstone_gc` service per node, pacing at
+    CLUSTER_TOMBSTONE_GC_INTERVAL_SECS (0 = disabled; tombstone_gc_once
+    stays callable on demand)."""
+    from surrealdb_tpu import bg, tracing
+
+    interval = cnf.CLUSTER_TOMBSTONE_GC_INTERVAL_SECS
+    if interval <= 0:
+        return
+    cl = ds.cluster
+    bg.spawn_service(
+        "cluster_tombstone_gc", cl.node_id,
+        _tombstone_gc_loop, ds, cl, tracing.current_trace_id(),
+        owner=id(ds), restart=True,
+    )
+
+
+def _tombstone_gc_loop(ds, cl, trace_id) -> None:
+    import random as _random
+
+    interval = max(cnf.CLUSTER_TOMBSTONE_GC_INTERVAL_SECS, 0.05)
+    while getattr(ds, "cluster", None) is cl:
+        tombstone_gc_once(ds, trace_id=trace_id)
+        # jittered beat, like the anti-entropy sweep: N nodes' GC passes
+        # de-correlate instead of all scanning at once
+        _time.sleep(interval * (0.75 + 0.5 * _random.random()))
 
 
 def start_service(ds) -> None:

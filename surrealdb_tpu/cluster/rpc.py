@@ -15,6 +15,7 @@ Ops:
     bundle    {trace_limit?, full_traces?}   -> {json: <node debug bundle>}
     metrics   {}                             -> {json: <telemetry export>}
     events    {kind?, limit?}                -> {json: <event timeline>}
+    statements {limit?, fingerprint?, sort?} -> {json: <statement stats>}
     member_update {phase, epoch, nodes, ...} -> {ok, view}   (elastic membership)
     membership  {}                           -> {view, migration}
     migrate_ranges {epoch, live}             -> {rows, targets}
@@ -336,6 +337,23 @@ def _op_events(ds, req):
     return {"json": _json.dumps(out, default=str)}
 
 
+def _op_statements(ds, req):
+    """This node's statement-fingerprint stats for the federated
+    `/statements?cluster=1` merge (workload statistics plane, stats.py):
+    entries ride node-UNtagged — the coordinator tags each with its
+    serving member id, like the /events merge."""
+    from surrealdb_tpu import stats
+
+    limit = req.get("limit")
+    fp = req.get("fingerprint")
+    out = stats.statements(
+        limit=int(limit) if limit is not None else 100,
+        fingerprint=str(fp) if fp else None,
+        sort=str(req.get("sort") or "total_s"),
+    )
+    return {"json": _json.dumps(out, default=str)}
+
+
 def _op_member_update(ds, req):
     """Elastic membership: prepare / commit / abort one epoch change
     (cluster/membership.py drives the two-phase flow)."""
@@ -433,6 +451,7 @@ _OPS = {
     "bundle": _op_bundle,
     "metrics": _op_metrics,
     "events": _op_events,
+    "statements": _op_statements,
     # elastic membership + convergent repair
     "member_update": _op_member_update,
     "membership": _op_membership,

@@ -244,6 +244,16 @@ CLUSTER_ANTIENTROPY_INTERVAL_SECS = _env_float(
 CLUSTER_READ_REPAIR_MAX_INFLIGHT = _env_int(
     "SURREAL_CLUSTER_READ_REPAIR_MAX_INFLIGHT", 8
 )
+# Tombstone GC (cluster/repair.py): DELETE tombstones in the HLC sidecar
+# keyspace older than the TTL are swept ONLY after a clean anti-entropy
+# pass has covered their range (the delete provably propagated — GC'ing
+# earlier could resurrect the record from a stale replica). The interval
+# paces the supervised bg:cluster_tombstone_gc service; 0 disables it
+# (tombstone_gc_once stays callable on demand).
+CLUSTER_TOMBSTONE_TTL_SECS = _env_float("SURREAL_CLUSTER_TOMBSTONE_TTL", 3600.0)
+CLUSTER_TOMBSTONE_GC_INTERVAL_SECS = _env_float(
+    "SURREAL_CLUSTER_TOMBSTONE_GC_INTERVAL", 0.0
+)
 
 # Failpoint fault-injection engine (surrealdb_tpu/faults.py):
 # "site=action[:prob][:count],..." spec string + the seed that makes a
@@ -292,6 +302,19 @@ TRACE_ENABLED = _env_bool("SURREAL_TRACE_ENABLED", True)
 TRACE_SAMPLE = _env_float("SURREAL_TRACE_SAMPLE", 0.02)
 TRACE_STORE_SIZE = _env_int("SURREAL_TRACE_STORE_SIZE", 512)
 TRACE_MAX_SPANS = _env_int("SURREAL_TRACE_MAX_SPANS", 512)
+
+# Workload statistics plane (stats.py + profiler.py). The statement-
+# fingerprint store is a bounded LRU: one entry per normalized statement
+# shape, oldest-by-use evicted past the cap (evictions counted). The
+# always-on sampling profiler wakes PROFILE_HZ times a second and folds
+# one sys._current_frames() snapshot per tick; 0 disables the service
+# entirely. The default rate is deliberately low — the measured overhead
+# on bench config 2 must stay <=3% (scripts/bench_gate.py enforces it).
+# PROFILE_MAX_STACKS bounds the distinct folded-stack series (overflow
+# folds into a per-thread <overflow> bucket).
+STATEMENTS_STORE_SIZE = _env_int("SURREAL_STATEMENTS_STORE_SIZE", 512)
+PROFILE_HZ = _env_float("SURREAL_PROFILE_HZ", 7.0)
+PROFILE_MAX_STACKS = _env_int("SURREAL_PROFILE_MAX_STACKS", 512)
 
 # Flight recorder (bg.py + compile_log.py): background-task registry with
 # a watchdog that flips tasks to `stalled` past a per-kind deadline, and a

@@ -102,7 +102,13 @@ class Executor:
         for name, value in self.vars.items():
             ctx.set_param(name, value)
 
-        for stm in query.statements:
+        # per-statement source spans (syn/parser.py) feed the workload
+        # statistics plane; reprs stand in for programmatic ASTs (a length
+        # mismatch must never drop a statement from the zip)
+        sources = query.sources
+        if sources is None or len(sources) != len(query.statements):
+            sources = [repr(s) for s in query.statements]
+        for stm, src in zip(query.statements, sources):
             t0 = time.perf_counter()
 
             if isinstance(stm, BeginStatement):
@@ -151,7 +157,7 @@ class Executor:
                 self._push(out, {"status": "ERR", "result": _FAILED_TX, "time": _fmt_time(0)})
                 continue
 
-            resp = self._run_statement(ctx, stm)
+            resp = self._run_statement(ctx, stm, src)
             resp["time"] = _fmt_time(time.perf_counter() - t0)
             self._push(out, resp)
 
@@ -173,7 +179,7 @@ class Executor:
         else:
             out.append(resp)
 
-    def _run_statement(self, ctx: Context, stm) -> dict:
+    def _run_statement(self, ctx: Context, stm, src: Optional[str] = None) -> dict:
         # session-state statements need no transaction
         if isinstance(stm, (UseStatement, OptionStatement)):
             try:
@@ -182,15 +188,44 @@ class Executor:
             except SurrealError as e:
                 return {"status": "ERR", "result": str(e)}
 
-        from surrealdb_tpu import telemetry, tracing
+        from surrealdb_tpu import stats, telemetry, tracing
 
-        tracing.annotate(**self._session_info())
+        # workload statistics plane: the literal-erased statement shape.
+        # The fingerprint rides the trace meta (kept traces join their
+        # stats row) and the per-thread activation table (the sampling
+        # profiler attributes wall-clock samples to it).
+        fp, norm = stats.fingerprint(src if src else repr(stm))
+        tracing.annotate(**self._session_info(), fingerprint=fp)
         t0 = time.perf_counter()
         dstats0 = self.ds.dispatch.stats()
+        # rows_in: bulk-ingest rows landed over this statement's window
+        # (process-global counter delta, like the dispatch delta below)
+        bulk0 = telemetry.get_counter("bulk_insert_rows")
         telemetry.drain_plan_notes()  # clear notes left by a prior statement
-        resp = self._execute_statement(ctx, stm)
+        tok = stats.activate(fp)
+        try:
+            resp = self._execute_statement(ctx, stm)
+        finally:
+            stats.deactivate(tok)
         dt = time.perf_counter() - t0
-        if resp.get("status") == "ERR":
+        # drained ONCE per statement: the stats record and the slow-query
+        # ring read the same plan-note list
+        notes = telemetry.drain_plan_notes()
+        d1 = self.ds.dispatch.stats()
+        dispatch_delta = {k: round(d1[k] - dstats0[k], 4) for k in d1}
+        errored = resp.get("status") == "ERR"
+        slow = dt >= cnf.SLOW_QUERY_THRESHOLD_SECS
+        result = resp.get("result")
+        rows_out = (
+            len(result) if isinstance(result, list) else (0 if errored else 1)
+        )
+        stats.record(
+            fp, norm, type(stm).__name__, dt,
+            error=errored, slow=slow, rows_out=rows_out,
+            rows_in=int(telemetry.get_counter("bulk_insert_rows") - bulk0),
+            plan=notes, dispatch=dispatch_delta,
+        )
+        if errored:
             telemetry.inc("statement_errors", kind=type(stm).__name__)
             # joinable side of the counter: cite the request's trace (and
             # pin it — the citation must stay resolvable via /trace/:id)
@@ -201,10 +236,11 @@ class Executor:
                     "kind": type(stm).__name__,
                     "error": str(resp["result"])[:300],
                     "trace_id": tracing.current_trace_id(),
+                    "fingerprint": fp,
                     "session": self._session_info(),
                 }
             )
-        if dt >= cnf.SLOW_QUERY_THRESHOLD_SECS:
+        if slow:
             # structured slow-query record (reference: query duration
             # warnings in telemetry/metrics) — ring-buffered with the plan
             # decisions plus the dispatch-queue delta over this statement's
@@ -213,16 +249,16 @@ class Executor:
             kind = type(stm).__name__
             telemetry.inc("slow_queries", kind=kind)
             tracing.force_keep()  # /slow -> /trace/:id must be one hop
-            d1 = self.ds.dispatch.stats()
             telemetry.record_slow_query(
                 {
                     "ts": time.time(),
                     "sql": repr(stm)[:500],
                     "kind": kind,
                     "duration_s": round(dt, 6),
-                    "plan": telemetry.drain_plan_notes(),
-                    "dispatch": {k: round(d1[k] - dstats0[k], 4) for k in d1},
+                    "plan": notes,
+                    "dispatch": dispatch_delta,
                     "trace_id": tracing.current_trace_id(),
+                    "fingerprint": fp,
                     "session": self._session_info(),
                     "error": str(resp["result"])[:500]
                     if resp.get("status") == "ERR"

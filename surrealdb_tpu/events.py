@@ -57,6 +57,9 @@ KINDS: Dict[str, str] = {
     "cluster.migration_done": "shard migration finished (or failed) for an epoch",
     "cluster.read_repair": "a divergent read back-filled a stale replica",
     "cluster.antientropy_repair": "an anti-entropy sweep repaired stale copies",
+    "cluster.tombstone_gc": "expired tombstones swept after a clean repair pass",
+    # workload statistics plane
+    "stats.plan_flip": "a statement fingerprint's primary plan decision flipped",
     # failpoints / chaos
     "fault.trip": "an armed failpoint site fired",
     # background machinery

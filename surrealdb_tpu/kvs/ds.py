@@ -349,6 +349,12 @@ class Datastore:
         from surrealdb_tpu.dbs.capabilities import Capabilities
 
         self.capabilities = Capabilities.default()
+        # always-on sampling profiler (profiler.py): one process-global
+        # supervised service, started with the first engine instance
+        # (SURREAL_PROFILE_HZ=0 keeps it off); every later call is a no-op
+        from surrealdb_tpu import profiler as _profiler
+
+        _profiler.ensure_started()
         # cluster mode (surrealdb_tpu/cluster/): when attach()ed, execute()
         # routes through the distributed scatter/gather executor; the
         # internal /cluster channel and the executor's own sub-queries run

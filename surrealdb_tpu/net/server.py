@@ -412,6 +412,38 @@ class SurrealHandler(BaseHTTPRequestHandler):
             return self._send(
                 200, _events.snapshot(kind_prefix=kind, limit=limit)
             )
+        if path == "/statements":
+            # workload statistics plane (stats.py): cumulative per-
+            # statement-shape stats + plan-mix vectors. Normalized SQL
+            # shapes are statement text (literals erased, but identifiers
+            # and structure intact), so system-gated like /slow and /traces.
+            if not self._route_allowed("statements"):
+                return
+            if self._system_gate() is None:
+                return
+            from urllib.parse import parse_qs
+
+            from surrealdb_tpu import stats as _stats
+
+            q = parse_qs(urlparse(self.path).query)
+            fp = q.get("fingerprint", [None])[0]
+            try:
+                limit = int(q.get("limit", [None])[0]) if q.get("limit") else 50
+            except (TypeError, ValueError):
+                limit = 50
+            sort = q.get("sort", ["total_s"])[0]
+            if self._cluster_query():
+                from surrealdb_tpu.cluster.federation import federated_statements
+
+                return self._send(
+                    200,
+                    federated_statements(
+                        self.ds, limit=limit, fingerprint=fp, sort=sort
+                    ),
+                )
+            return self._send(
+                200, _stats.statements(limit=limit, fingerprint=fp, sort=sort)
+            )
         if path == "/slow":
             # structured slow-query log (ring buffer; dbs/executor.py) — the
             # /metrics-adjacent debug endpoint. Entries carry raw statement

@@ -377,6 +377,14 @@ def collect_node_metrics(ds=None) -> None:
         pass
     if ds is not None and getattr(ds, "notifications", None) is not None:
         gauge_set("live_queries", ds.notifications.live_count())
+    # workload statistics plane: how many statement shapes the bounded
+    # LRU currently tracks (evictions are the counter next to it)
+    try:
+        from surrealdb_tpu import stats
+
+        gauge_set("statement_fingerprints", stats.size())
+    except Exception:  # noqa: BLE001 — metrics must never fail a scrape
+        inc("scrape_section_errors", section="stats")
     # flight recorder: live background-task gauges + per-subsystem memory
     # watermarks for the engine's device-bound mirrors
     try:

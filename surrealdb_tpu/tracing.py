@@ -482,7 +482,7 @@ def list_traces(limit: int = 100) -> List[dict]:
                 k: d.get(k)
                 for k in (
                     "trace_id", "name", "ts", "duration_ms", "error",
-                    "sampled", "ns", "db", "auth",
+                    "sampled", "ns", "db", "auth", "fingerprint",
                 )
             }
             | {"spans": len(d["spans"])}

@@ -100,6 +100,15 @@ HIERARCHY: Dict[str, int] = {
     "compile_log": 82,         # compile-event log
     "events": 83,              # structured event timeline (events.py)
     "tracing.store": 84,       # bounded trace store
+    "stats.store": 85,         # statement-fingerprint store (stats.py):
+                               # leaf-style — record() mutates and
+                               # releases; flip events/counters emit
+                               # AFTER release (events/telemetry are
+                               # LOWER levels and must never nest inside)
+    "profiler.state": 85,      # sampling-profiler aggregates (profiler.py):
+                               # pure fold-and-release; never nests with
+                               # stats.store (the attribution table it
+                               # reads is a lock-free dict)
     "telemetry.registry": 86,  # metrics registry (the hottest leaf)
 }
 

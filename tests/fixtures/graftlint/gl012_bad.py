@@ -1,0 +1,33 @@
+"""GL012 fixture: ad-hoc access to the statement-stats store."""
+
+import time
+
+import surrealdb_tpu.stats
+import surrealdb_tpu.stats as st
+from surrealdb_tpu import stats
+
+
+def sneak_entry(fp: str, text: str):
+    # reaching into the private store bypasses record()'s lock discipline
+    # and the plan-flip detection
+    with stats._lock:
+        e = stats._store.get(fp)
+        if e is None:
+            e = stats._store[fp] = stats._Entry(fp, text, "Fixture")
+        e.calls += 1
+
+
+def sneak_activation(fp: str):
+    # the profiler's attribution table has activate()/deactivate() doors
+    st._active_by_thread[12345] = fp
+
+
+def sneak_eviction_count():
+    st._evicted += 1
+    st._note_evictions(1)
+    return time.time()
+
+
+def sneak_dotted():
+    # the plain-import dotted path must not dodge the rule either
+    return surrealdb_tpu.stats._store

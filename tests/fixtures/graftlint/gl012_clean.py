@@ -1,0 +1,18 @@
+"""GL012 clean twin: stats recording through the public doors only."""
+
+from surrealdb_tpu import stats
+
+
+def record_execution(sql: str, duration_s: float, notes):
+    fp, norm = stats.fingerprint(sql)
+    tok = stats.activate(fp)
+    try:
+        pass  # the statement would execute here
+    finally:
+        stats.deactivate(tok)
+    stats.record(fp, norm, "SelectStatement", duration_s, plan=notes)
+
+
+def read_views(fp: str):
+    # read surfaces are public API, not store pokes
+    return stats.statements(limit=5), stats.get(fp), stats.size()

@@ -1,0 +1,272 @@
+"""Tombstone GC for the HLC sidecar keyspace (ISSUE 15 satellite, carried
+from PR 14): DELETE tombstones are harmless under LWW but accumulate
+forever; a bounded sweep deletes those older than the TTL — ONLY after a
+clean anti-entropy pass covered their range, so a GC'd tombstone can never
+let a stale replica resurrect the record.
+
+The contracts under test:
+
+- no clean sweep on record -> the GC refuses (skipped_no_clean_sweep),
+  the tombstone survives;
+- clean sweep + elapsed TTL -> the tombstone is swept from the sidecar
+  keyspace, `cluster_tombstones_gced_total` counts it and a
+  `cluster.tombstone_gc` event marks the pass;
+- a tombstone YOUNGER than the TTL survives a clean sweep;
+- a tombstone minted AFTER the last clean sweep survives (its delete has
+  not provably propagated yet);
+- an errored sweep (peer down) does not count as coverage;
+- the supervised `bg:cluster_tombstone_gc` service spawns behind the
+  interval knob and sweeps on its own beat.
+"""
+
+import time
+
+import pytest
+
+import jax.numpy  # noqa: F401 — concurrent lazy first-import races otherwise
+
+from surrealdb_tpu import cnf, events, telemetry
+from surrealdb_tpu import key as skeys
+from surrealdb_tpu.cluster import ClusterConfig, attach, detach, repair
+from surrealdb_tpu.dbs.session import Session
+from surrealdb_tpu.net.server import serve
+
+
+def ok(resp):
+    assert resp["status"] == "OK", resp
+    return resp["result"]
+
+
+def counter_sum(name):
+    return sum(telemetry.counters_matching(name).values())
+
+
+class Cluster2:
+    def __init__(self):
+        self.servers = [
+            serve("memory", port=0, auth_enabled=False).start_background()
+            for _ in range(2)
+        ]
+        self.nodes = [
+            {"id": f"n{i + 1}", "url": srv.url}
+            for i, srv in enumerate(self.servers)
+        ]
+        self.datastores = [s.httpd.RequestHandlerClass.ds for s in self.servers]
+        for i, ds in enumerate(self.datastores):
+            attach(ds, ClusterConfig(self.nodes, f"n{i + 1}", secret="tgc-secret"))
+        self.by_id = dict(zip(("n1", "n2"), self.datastores))
+        self.s = Session.owner("t", "t")
+
+    @property
+    def coord(self):
+        return self.datastores[0]
+
+    def close(self):
+        for ds in self.datastores:
+            detach(ds)
+        for srv in self.servers:
+            srv.shutdown()
+        for ds in self.datastores:
+            ds.close()
+
+
+@pytest.fixture()
+def cluster2(monkeypatch):
+    monkeypatch.setattr(cnf, "CLUSTER_RPC_TIMEOUT_SECS", 3.0)
+    c = Cluster2()
+    yield c
+    c.close()
+
+
+def tombstones_on(ds, tb="tmb"):
+    """The dead metas in one node's HLC sidecar keyspace for `tb`."""
+    from surrealdb_tpu.key.encode import prefix_end
+    from surrealdb_tpu.utils.ser import unpack
+
+    pre = skeys.record_meta_prefix("t", "t", tb)
+    txn = ds.transaction(False)
+    try:
+        metas = list(txn.scan(pre, prefix_end(pre)))
+    finally:
+        txn.cancel()
+    return [mk for mk, raw in metas if unpack(raw).get("dead")]
+
+
+def seed_tombstone(c, rid=1):
+    ok(c.coord.execute("DEFINE TABLE tmb SCHEMALESS", c.s)[0])
+    ok(c.coord.execute(f"CREATE tmb:{rid} SET v = 1", c.s)[0])
+    ok(c.coord.execute(f"DELETE tmb:{rid}", c.s)[0])
+
+
+def clean_sweep_all(c):
+    for ds in c.datastores:
+        rep = repair.sweep_once(ds)
+        assert not rep["errors"], rep
+
+
+def test_gc_refuses_without_a_clean_sweep(cluster2, monkeypatch):
+    monkeypatch.setattr(cnf, "CLUSTER_TOMBSTONE_TTL_SECS", 0.0)
+    c = cluster2
+    seed_tombstone(c)
+    holders = [ds for ds in c.datastores if tombstones_on(ds)]
+    assert holders  # RF=2 on two nodes: the tombstone exists somewhere
+    for ds in holders:
+        rep = repair.tombstone_gc_once(ds)
+        assert rep["skipped_no_clean_sweep"] is True and rep["swept"] == 0
+    assert all(tombstones_on(ds) for ds in holders)  # nothing deleted
+
+
+def test_gc_sweeps_after_clean_pass_and_elapsed_ttl(cluster2, monkeypatch):
+    monkeypatch.setattr(cnf, "CLUSTER_TOMBSTONE_TTL_SECS", 0.0)
+    c = cluster2
+    seed_tombstone(c)
+    holders = [ds for ds in c.datastores if tombstones_on(ds)]
+    assert holders
+    before = counter_sum("cluster_tombstones_gced_total")
+    time.sleep(0.01)  # the sweep must START after the tombstone's stamp
+    clean_sweep_all(c)
+    swept = 0
+    for ds in holders:
+        rep = repair.tombstone_gc_once(ds)
+        assert rep["skipped_no_clean_sweep"] is False
+        assert rep["eligible"] == rep["swept"]
+        swept += rep["swept"]
+    assert swept >= len(holders)
+    assert all(not tombstones_on(ds) for ds in holders)
+    assert counter_sum("cluster_tombstones_gced_total") == before + swept
+    evs = events.snapshot(kind_prefix="cluster.tombstone_gc")
+    assert evs and evs[-1]["swept"] >= 1
+    # idempotent: a second pass finds nothing
+    for ds in holders:
+        assert repair.tombstone_gc_once(ds)["swept"] == 0
+
+
+def test_young_tombstone_survives_the_ttl(cluster2, monkeypatch):
+    monkeypatch.setattr(cnf, "CLUSTER_TOMBSTONE_TTL_SECS", 3600.0)
+    c = cluster2
+    seed_tombstone(c)
+    holders = [ds for ds in c.datastores if tombstones_on(ds)]
+    time.sleep(0.01)
+    clean_sweep_all(c)
+    for ds in holders:
+        rep = repair.tombstone_gc_once(ds)
+        assert rep["scanned"] >= 1 and rep["eligible"] == 0 and rep["swept"] == 0
+    assert all(tombstones_on(ds) for ds in holders)
+
+
+def test_tombstone_minted_after_sweep_survives(cluster2, monkeypatch):
+    monkeypatch.setattr(cnf, "CLUSTER_TOMBSTONE_TTL_SECS", 0.0)
+    c = cluster2
+    ok(c.coord.execute("DEFINE TABLE tmb SCHEMALESS", c.s)[0])
+    ok(c.coord.execute("CREATE tmb:9 SET v = 1", c.s)[0])
+    clean_sweep_all(c)  # coverage anchor BEFORE the delete exists
+    time.sleep(0.01)
+    ok(c.coord.execute("DELETE tmb:9", c.s)[0])
+    holders = [ds for ds in c.datastores if tombstones_on(ds)]
+    assert holders
+    for ds in holders:
+        rep = repair.tombstone_gc_once(ds)
+        # the delete postdates the pass: not provably propagated, kept
+        assert rep["swept"] == 0, rep
+    assert all(tombstones_on(ds) for ds in holders)
+    # the NEXT clean pass covers it
+    time.sleep(0.01)
+    clean_sweep_all(c)
+    assert sum(repair.tombstone_gc_once(ds)["swept"] for ds in holders) >= 1
+
+
+def test_gc_never_strips_a_recreated_records_meta(cluster2, monkeypatch):
+    """The scan-then-delete race: a record re-CREATEd between the GC's
+    read scan and its delete must keep its live stamp — an unconditional
+    meta delete would leave the record unstamped, and a stale replica's
+    old tombstone would then win LWW over it (a lost acked write)."""
+    monkeypatch.setattr(cnf, "CLUSTER_TOMBSTONE_TTL_SECS", 0.0)
+    c = cluster2
+    seed_tombstone(c)
+    holders = [ds for ds in c.datastores if tombstones_on(ds)]
+    assert holders
+    ds = holders[0]
+    time.sleep(0.01)
+    clean_sweep_all(c)
+    real_txn = ds.transaction
+    state = {"raced": False}
+
+    def racing_txn(write=False):
+        if write and not state["raced"]:
+            # the race, deterministically: the record comes back between
+            # the GC's read scan and its first delete transaction
+            state["raced"] = True
+            ok(c.coord.execute("CREATE tmb:1 SET v = 2", c.s)[0])
+        return real_txn(write)
+
+    monkeypatch.setattr(ds, "transaction", racing_txn)
+    rep = repair.tombstone_gc_once(ds)
+    monkeypatch.undo()
+    assert rep["eligible"] >= 1 and rep["swept"] == 0, rep
+    # the re-created record kept its doc AND its live stamp
+    txn = real_txn(False)
+    try:
+        meta = txn.get_record_meta("t", "t", "tmb", 1)
+        doc = txn.get_record("t", "t", "tmb", 1)
+    finally:
+        txn.cancel()
+    assert doc is not None
+    assert meta is not None and not meta.get("dead"), meta
+
+
+def test_errored_sweep_is_not_coverage(cluster2, monkeypatch):
+    monkeypatch.setattr(cnf, "CLUSTER_TOMBSTONE_TTL_SECS", 0.0)
+    c = cluster2
+    seed_tombstone(c)
+    holders = [ds for ds in c.datastores if tombstones_on(ds)]
+    assert holders
+    ds = holders[0]
+    # an errored sweep leg: the peer RPC dies mid-pass
+    cl = ds.cluster
+    orig_call = cl.client.call
+
+    def dying_call(peer, op, req, **kw):
+        if op == "repair_digests":
+            raise RuntimeError("peer mid-crash")
+        return orig_call(peer, op, req, **kw)
+
+    monkeypatch.setattr(cl.client, "call", dying_call)
+    rep = repair.sweep_once(ds)
+    assert rep["errors"]
+    gc_rep = repair.tombstone_gc_once(ds)
+    assert gc_rep["skipped_no_clean_sweep"] is True and gc_rep["swept"] == 0
+    assert tombstones_on(ds)
+
+
+def test_bg_service_spawns_and_sweeps(cluster2, monkeypatch):
+    from surrealdb_tpu import bg
+
+    monkeypatch.setattr(cnf, "CLUSTER_TOMBSTONE_TTL_SECS", 0.0)
+    monkeypatch.setattr(cnf, "CLUSTER_TOMBSTONE_GC_INTERVAL_SECS", 0.05)
+    c = cluster2
+    seed_tombstone(c)
+    holders = [ds for ds in c.datastores if tombstones_on(ds)]
+    time.sleep(0.01)
+    clean_sweep_all(c)
+    for ds in holders:
+        repair.start_tombstone_gc(ds)
+    deadline = time.time() + 10.0
+    while time.time() < deadline and any(tombstones_on(ds) for ds in holders):
+        time.sleep(0.05)
+    assert all(not tombstones_on(ds) for ds in holders)
+    kinds = {t["kind"] for t in bg.snapshot()["live"]}
+    assert "cluster_tombstone_gc" in kinds
+
+
+def test_interval_zero_spawns_no_service(cluster2, monkeypatch):
+    from surrealdb_tpu import bg
+
+    monkeypatch.setattr(cnf, "CLUSTER_TOMBSTONE_GC_INTERVAL_SECS", 0.0)
+    before = [
+        t for t in bg.snapshot()["live"] if t["kind"] == "cluster_tombstone_gc"
+    ]
+    repair.start_tombstone_gc(cluster2.coord)
+    after = [
+        t for t in bg.snapshot()["live"] if t["kind"] == "cluster_tombstone_gc"
+    ]
+    assert len(after) == len(before)
