@@ -63,7 +63,7 @@ import time
 import numpy as np
 
 SCALE = float(os.environ.get("SURREAL_BENCH_SCALE", "1.0"))
-CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9,10").split(","))
+CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9,10,11").split(","))
 ROUND = os.environ.get("SURREAL_BENCH_ROUND", "r10")
 OUT_PATH = os.environ.get(
     "SURREAL_BENCH_OUT",
@@ -128,7 +128,21 @@ PROFILE = "--profile" in sys.argv[1:] or os.environ.get("SURREAL_PROFILE") == "1
 # embedded bundle is surrealdb-tpu-bundle/6 (sections 12 `statements` +
 # 13 `profiler`), and `bench_diff --statements` names per-fingerprint
 # qps/p99 regressions and plan-mix flips between two artifacts.
-SCHEMA = "surrealdb-tpu-bench/12"
+# schema/13 (r17, tenant cost-attribution plane): every config line
+# carries a `tenants` object — the window's per-(ns, db) resource meters
+# (accounting.py: cpu/exec/dispatch seconds, rows, bytes, bg + scatter
+# cost, reset per accounting window like the stats store). New config 11
+# `multi_tenant`: a 2-node cluster serving THREE namespaces (one abusive,
+# two well-behaved) whose line proves CONSERVATION (per-tenant sums vs
+# the global counters, <=1% — validator-enforced), attributes >=90% of
+# the excess to the abusive tenant, carries the budget-breach event
+# (trace-linked to the offending statement) and the federated node-tagged
+# `GET /tenants?cluster=1` view. The config-2 line adds
+# `accounting_overhead`: the paired accounting-on/off A/B whose <=3%
+# ceiling bench_gate enforces. The embedded bundle is
+# surrealdb-tpu-bundle/7 (section 14 `tenants`), and `bench_diff
+# --tenants` names per-tenant share shifts between two artifacts.
+SCHEMA = "surrealdb-tpu-bench/13"
 
 D = 768
 NI = max(int(1_000_000 * SCALE), 1024)  # item corpus (configs 2/4/5)
@@ -238,6 +252,11 @@ def _acct_begin(ds) -> dict:
     # per-config facts (bench owns the process)
     stats.reset()
     profiler.reset()
+    # and for the tenant cost-attribution plane: per-window meters mean
+    # the conservation check compares like with like
+    from surrealdb_tpu import accounting
+
+    accounting.reset()
     return {
         "t0": time.time(),
         "stats": ds.dispatch.stats(),
@@ -326,6 +345,9 @@ def _acct_delta(ds, before: dict) -> dict:
             "top": stats.statements(limit=8),
             "profiler": profiler.summary(),
         },
+        # tenant cost-attribution plane (schema/13): this window's
+        # per-(ns, db) meters + the conservation totals they must sum to
+        "tenants": _tenants_embed(),
         "bg_tasks": {
             "kinds": kinds,
             "tasks": [
@@ -852,6 +874,8 @@ def bench_knn(ds, s, corpus, rng):
 
     log("knn: profiler overhead A/B (sampler live vs paused)")
     prof_overhead = _profiler_overhead(ds, s, queries[:8])
+    log("knn: accounting overhead A/B (tenant meters on vs off)")
+    acct_overhead = _accounting_overhead(ds, s, queries[:8])
 
     vsb = conc_qps / cpu_ann_conc_qps if cpu_ann_conc_qps else None
     emit(
@@ -877,6 +901,7 @@ def bench_knn(ds, s, corpus, rng):
             "cpu_ann_recall_at_10": round(cpu_ann_recall, 4),
             "cpu_exact_qps": round(cpu_exact_qps, 3),
             "profiler_overhead": prof_overhead,
+            "accounting_overhead": acct_overhead,
         }
     )
     return vsb, conc_qps, recall
@@ -915,6 +940,58 @@ def _profiler_overhead(ds, s, queries, rounds=3):
         "on_s": round(last_on, 4) if last_on is not None else None,
         "off_s": round(last_off, 4) if last_off is not None else None,
         "overhead_pct": round(max(best - 1.0, 0.0) * 100.0, 2),
+    }
+
+
+def _accounting_overhead(ds, s, queries, rounds=3):
+    """Measured cost of the tenant cost-attribution plane on the engine
+    path (schema/13; the <=3% contract scripts/bench_gate.py enforces):
+    the SAME query battery timed with accounting.charge() live vs gated
+    off (cnf.TENANT_ACCOUNTING), in alternating paired rounds with the
+    same paired-minimum estimator as _profiler_overhead."""
+    from surrealdb_tpu import cnf as _cnf
+
+    saved = _cnf.TENANT_ACCOUNTING
+    ratios = []
+    last_on = last_off = None
+    try:
+        for _ in range(max(rounds, 1)):
+            _cnf.TENANT_ACCOUNTING = True
+            t0 = time.perf_counter()
+            for sql, v in queries:
+                run(ds, s, sql, v)
+            last_on = time.perf_counter() - t0
+            _cnf.TENANT_ACCOUNTING = False
+            t0 = time.perf_counter()
+            for sql, v in queries:
+                run(ds, s, sql, v)
+            last_off = time.perf_counter() - t0
+            if last_off > 0:
+                ratios.append(last_on / last_off)
+    finally:
+        _cnf.TENANT_ACCOUNTING = saved
+    best = min(ratios) if ratios else 1.0
+    return {
+        "rounds": len(ratios),
+        "queries_per_round": len(queries),
+        "on_s": round(last_on, 4) if last_on is not None else None,
+        "off_s": round(last_off, 4) if last_off is not None else None,
+        "overhead_pct": round(max(best - 1.0, 0.0) * 100.0, 2),
+    }
+
+
+def _tenants_embed() -> dict:
+    """The window's tenant cost-attribution snapshot for a config line
+    (schema/13): per-(ns, db) meters plus the global conservation totals
+    they must sum to (accounting resets per window in _acct_begin)."""
+    from surrealdb_tpu import accounting
+
+    snap = accounting.snapshot(limit=8)
+    return {
+        "per_tenant": snap["top"],
+        "global": snap["global"],
+        "count": snap["tenants"],
+        "evicted": snap["evicted"],
     }
 
 
@@ -1408,6 +1485,188 @@ def bench_cluster(rng):
         ds2.close()
         ref.close()
     return None  # scale-out ratio, not a vs-CPU speedup: keep out of the geomean
+
+
+def bench_multi_tenant(rng):
+    """Config 11: the tenant cost-attribution window — a 2-node cluster
+    serving THREE namespaces (one deliberately abusive, two well-behaved)
+    through one coordinator. The contracts measured: CONSERVATION (the
+    per-tenant meter sums equal the independent global telemetry counters
+    and dispatch-queue timers, <=1% — the validator enforces it),
+    ATTRIBUTION (>=90% of the scan volume lands on the abusive namespace),
+    the observe-only budget plane (the abusive tenant crosses its
+    rows-scanned soft budget mid-window -> `tenant.budget_exceeded` event
+    trace-linked to the offending statement) and the federated node-tagged
+    `GET /tenants?cluster=1` view. Self-contained: own nodes, own corpus,
+    never touches the main ds."""
+    import json as _json
+    from urllib.request import urlopen
+
+    from surrealdb_tpu import accounting, cluster as _cluster, cnf as _cnf
+    from surrealdb_tpu import events as _events, telemetry as _tm
+    from surrealdb_tpu.dbs.session import Session
+    from surrealdb_tpu.kvs.ds import Datastore  # noqa: F401 — session twin
+    from surrealdb_tpu.net.server import serve as _serve
+
+    n = max(min(int(2048 * SCALE * 10), 2048), 256)
+    d = min(D, 32)  # attribution mechanics, not corpus scale
+    srv1 = _serve("memory", port=0, auth_enabled=False).start_background()
+    srv2 = _serve("memory", port=0, auth_enabled=False).start_background()
+    nodes = [{"id": "n1", "url": srv1.url}, {"id": "n2", "url": srv2.url}]
+    ds1 = srv1.httpd.RequestHandlerClass.ds
+    ds2 = srv2.httpd.RequestHandlerClass.ds
+    _cluster.attach(ds1, _cluster.ClusterConfig(nodes, "n1", secret="bench"))
+    _cluster.attach(ds2, _cluster.ClusterConfig(nodes, "n2", secret="bench"))
+    tenants = ["acme", "globex", "abusive"]
+    sessions = {ns: Session.owner(ns, "app") for ns in tenants}
+    # soft budget (observe-only): the abusive tenant's scan loop must
+    # cross it mid-window, so the breach event fires trace-linked
+    budget_rows = float(2 * n)
+    saved_budget = getattr(_cnf, "TENANT_BUDGET_ROWS", "")
+    _cnf.TENANT_BUDGET_ROWS = f"abusive:{budget_rows}"
+    try:
+        corpus = rng.standard_normal((n, d)).astype(np.float32)
+        vals = rng.random(n)
+        for ns in tenants:
+            s = sessions[ns]
+            for r in ds1.execute("DEFINE TABLE item SCHEMALESS", s):
+                assert r["status"] == "OK", r
+            for lo in range(0, n, 512):
+                hi = min(lo + 512, n)
+                rows = [
+                    {"id": i, "emb": corpus[i].tolist(), "val": float(vals[i])}
+                    for i in range(lo, hi)
+                ]
+                r = ds1.execute("INSERT INTO item $rows", s, {"rows": rows})
+                assert r[0]["status"] == "OK", r
+
+        # ---- the measured window: meters + counters from a common zero
+        accounting.reset()
+        cpu0 = _tm.get_counter("statement_cpu_seconds")
+        scan0 = _tm.get_counter("statement_rows_scanned")
+        bg0 = _tm.get_counter("bg_task_seconds")
+        disp0 = {
+            nid: nds.dispatch.stats() for nid, nds in (("n1", ds1), ("n2", ds2))
+        }
+        scan_sql = "SELECT id FROM item WHERE val < 0.9"
+        point_sqls = [f"SELECT * FROM item:{i}" for i in (17, 42)]
+        stmts = 0
+        t0 = time.perf_counter()
+        for rnd in range(6):
+            # the abusive tenant full-scans every round; the others stay
+            # on record-access point reads (no table scan — an un-indexed
+            # kNN here would brute-force the whole table and drown the
+            # attribution signal in honest-but-identical scan volume)
+            r = ds1.execute(scan_sql, sessions["abusive"])
+            assert r[0]["status"] == "OK", r
+            stmts += 1
+            for ns in ("acme", "globex"):
+                for sql in point_sqls:
+                    r = ds1.execute(sql, sessions[ns])
+                    assert r[0]["status"] == "OK", r
+                    stmts += 1
+        mix_s = time.perf_counter() - t0
+        qps = stmts / mix_s if mix_s else None
+
+        # ---- conservation: per-tenant sums vs the INDEPENDENT mirrors
+        per_tenant = accounting.top(limit=100, fp_limit=4)
+        sums = {
+            m: sum((e.get(m) or 0.0) for e in per_tenant)
+            for m in ("cpu_s", "rows_scanned", "dispatch_s", "bg_s")
+        }
+        d_cpu = _tm.get_counter("statement_cpu_seconds") - cpu0
+        d_scan = _tm.get_counter("statement_rows_scanned") - scan0
+        d_bg = _tm.get_counter("bg_task_seconds") - bg0
+        d_disp = 0.0
+        for nid, nds in (("n1", ds1), ("n2", ds2)):
+            st1 = nds.dispatch.stats()
+            d_disp += (st1["launch_s"] - disp0[nid]["launch_s"]) + (
+                st1["collect_s"] - disp0[nid]["collect_s"]
+            )
+
+        def _dev_pct(tenant_sum, counter_delta):
+            if counter_delta <= 1e-9 and tenant_sum <= 1e-9:
+                return 0.0
+            return round(
+                abs(tenant_sum - counter_delta)
+                / max(counter_delta, 1e-9) * 100.0,
+                3,
+            )
+
+        conservation = {
+            "cpu_pct": _dev_pct(sums["cpu_s"], d_cpu),
+            "rows_scanned_pct": _dev_pct(sums["rows_scanned"], d_scan),
+            "dispatch_pct": _dev_pct(sums["dispatch_s"], d_disp),
+            "bg_pct": _dev_pct(sums["bg_s"], d_bg),
+            "evicted_during_window": accounting.snapshot(limit=1)["evicted"],
+        }
+
+        # ---- attribution: the abusive tenant owns the scan volume
+        bench_rows = {
+            e["ns"]: (e.get("rows_scanned") or 0.0)
+            for e in per_tenant if e["ns"] in tenants
+        }
+        total_rows = sum(bench_rows.values())
+        abusive_share = (
+            bench_rows.get("abusive", 0.0) / total_rows if total_rows else 0.0
+        )
+
+        # ---- the budget plane's evidence: breach event, trace-linked
+        breaches = _events.snapshot(kind_prefix="tenant.budget_exceeded")
+        breach = breaches[-1] if breaches else None
+
+        # ---- federated node-tagged view through the coordinator's HTTP
+        with urlopen(
+            f"{srv1.url}/tenants?cluster=1&sort=rows_scanned&limit=20"
+        ) as resp:
+            fed = _json.loads(resp.read().decode())
+
+        emit(
+            {
+                "metric": f"multi_tenant_mix_2nodes_{n}x{d}",
+                "value": round(qps, 2) if qps else None,
+                "unit": "qps",
+                "vs_baseline": None,
+                "tenant_plane": {
+                    # one interpreter, shared registries: the conservation
+                    # check is exactly what this regime CAN prove
+                    # (cluster/federation.py in-process caveat)
+                    "in_process": True,
+                    "tenants": tenants,
+                    "per_tenant": [
+                        e for e in per_tenant if e["ns"] in tenants
+                    ],
+                    "conservation": conservation,
+                    "abusive": {
+                        "ns": "abusive",
+                        "rows_share": round(abusive_share, 4),
+                        "rows_scanned": bench_rows.get("abusive", 0.0),
+                    },
+                    "budget": {
+                        "spec": {"TENANT_BUDGET_ROWS": _cnf.TENANT_BUDGET_ROWS},
+                        "breach_count": len(breaches),
+                        "breach": breach,
+                        "breach_trace_id": (breach or {}).get("trace_id"),
+                    },
+                    "federated": fed[:20],
+                },
+            }
+        )
+        assert conservation["cpu_pct"] <= 1.0, conservation
+        assert conservation["rows_scanned_pct"] <= 1.0, conservation
+        assert conservation["dispatch_pct"] <= 1.0, conservation
+        assert abusive_share >= 0.9, f"attribution too weak: {bench_rows}"
+        assert breach is not None and breach.get("trace_id"), (
+            f"no trace-linked budget breach: {breaches}"
+        )
+        assert fed and all(e.get("node") for e in fed), fed[:3]
+    finally:
+        _cnf.TENANT_BUDGET_ROWS = saved_budget
+        srv1.shutdown()
+        srv2.shutdown()
+        ds1.close()
+        ds2.close()
+    return None  # attribution evidence, not a vs-CPU speedup: keep out of the geomean
 
 
 def bench_chaos(rng):
@@ -1971,6 +2230,8 @@ def main() -> None:
         run_cfg("8", lambda: bench_chaos(rng))
     if "10" in CONFIGS:
         run_cfg("10", lambda: bench_elastic(rng))
+    if "11" in CONFIGS:
+        run_cfg("11", lambda: bench_multi_tenant(rng))
     if "5" in CONFIGS:
         run_cfg("5", lambda: bench_ml_scan(ds, s, rng))
     if "6" in CONFIGS:

@@ -638,6 +638,109 @@ def _main_statements(old: dict, new: dict, threshold: float) -> int:
     return 1 if flagged else 0
 
 
+# ------------------------------------------------------------------ tenants
+def _tenants_by_key(art: dict) -> Dict[str, dict]:
+    """Every per-tenant meter entry embedded in an artifact's config lines
+    (schema /13 `tenants.per_tenant`), keyed `ns/db`. An entry appearing
+    in several config windows keeps the one with more statements (bench
+    resets the accounting store per window, so windows never
+    double-count)."""
+    out: Dict[str, dict] = {}
+    for r in art.get("results") or []:
+        tn = r.get("tenants")
+        if not isinstance(tn, dict):
+            continue
+        for ent in tn.get("per_tenant") or []:
+            if not isinstance(ent, dict) or not ent.get("ns"):
+                continue
+            key = f"{ent['ns']}/{ent.get('db') or ''}"
+            cur = out.get(key)
+            if cur is None or (ent.get("statements") or 0) > (
+                cur.get("statements") or 0
+            ):
+                out[key] = dict(ent, config=r.get("config"))
+    return out
+
+
+def diff_tenants(old: dict, new: dict, threshold: float = 0.25) -> List[dict]:
+    """Per-tenant comparison of two artifacts' cost-attribution embeds:
+    which (ns, db) got more expensive between two runs, and on which
+    meter. Flags
+    - cost-share shifts: a tenant's share of the window's total exec time
+      moved beyond threshold (the noisy-neighbour drift signal — absolute
+      times move with the machine, shares shouldn't),
+    - per-meter regressions (cpu_s, dispatch_s, rows_scanned per
+      statement) beyond threshold,
+    - budget breaches appearing in the new run that the old didn't have."""
+    o_by, n_by = _tenants_by_key(old), _tenants_by_key(new)
+    o_total = sum((e.get("exec_s") or 0) for e in o_by.values()) or 1e-9
+    n_total = sum((e.get("exec_s") or 0) for e in n_by.values()) or 1e-9
+    rows: List[dict] = []
+    for key in sorted(set(o_by) & set(n_by)):
+        oe, ne = o_by[key], n_by[key]
+        flags: List[str] = []
+        o_share = (oe.get("exec_s") or 0) / o_total
+        n_share = (ne.get("exec_s") or 0) / n_total
+        if abs(n_share - o_share) > threshold:
+            flags.append(
+                f"exec-time share {o_share * 100:.0f}% -> {n_share * 100:.0f}%"
+            )
+        o_calls = max(oe.get("statements") or 0, 1)
+        n_calls = max(ne.get("statements") or 0, 1)
+        for meter in ("cpu_s", "dispatch_s", "rows_scanned"):
+            d = _rel(
+                (oe.get(meter) or 0) / o_calls, (ne.get(meter) or 0) / n_calls
+            )
+            if d is not None and d > threshold:
+                flags.append(f"{meter}/stmt ({d * 100:+.0f}%)")
+        o_breach = sum((oe.get("breaches") or {}).values())
+        n_breach = sum((ne.get("breaches") or {}).values())
+        if n_breach > o_breach:
+            flags.append(f"budget breaches: {o_breach} -> {n_breach}")
+        rows.append(
+            {
+                "tenant": key,
+                "config": ne.get("config"),
+                "old": {"share": round(o_share, 4),
+                        "exec_s": oe.get("exec_s"), "cpu_s": oe.get("cpu_s"),
+                        "statements": oe.get("statements")},
+                "new": {"share": round(n_share, 4),
+                        "exec_s": ne.get("exec_s"), "cpu_s": ne.get("cpu_s"),
+                        "statements": ne.get("statements")},
+                "flags": flags,
+            }
+        )
+    return rows
+
+
+def _main_tenants(old: dict, new: dict, threshold: float) -> int:
+    rows = diff_tenants(old, new, threshold)
+    if not rows:
+        print(
+            "no shared tenants between the two artifacts "
+            "(schema /13 embeds required)",
+            file=sys.stderr,
+        )
+        return 2
+    flagged = 0
+    for r in rows:
+        head = (
+            f"{r['tenant']} (config {r['config']}): "
+            f"share {r['old']['share'] * 100:.0f}% -> "
+            f"{r['new']['share'] * 100:.0f}%, "
+            f"exec {r['old']['exec_s']} -> {r['new']['exec_s']} s"
+        )
+        print(("FLAG  " if r["flags"] else "ok    ") + head)
+        for fl in r["flags"]:
+            print(f"      - {fl}")
+        flagged += bool(r["flags"])
+    print(
+        f"{flagged}/{len(rows)} tenant(s) flagged "
+        f"(threshold {threshold * 100:.0f}%)"
+    )
+    return 1 if flagged else 0
+
+
 def _main_bundles(old_doc: dict, new_doc: dict) -> int:
     ob, nb = _as_bundle(old_doc), _as_bundle(new_doc)
     if ob is None or nb is None:
@@ -700,6 +803,12 @@ def main(argv: List[str]) -> int:
         help="diff the two runs' per-statement-fingerprint stats (schema "
         "/12): qps/p99 regressions and plan-mix flips, named per shape",
     )
+    ap.add_argument(
+        "--tenants", action="store_true",
+        help="diff the two runs' per-tenant cost-attribution embeds "
+        "(schema /13): exec-share shifts, per-meter regressions and new "
+        "budget breaches, named per (ns, db)",
+    )
     try:
         ns = ap.parse_args(argv)
     except SystemExit:
@@ -717,6 +826,8 @@ def main(argv: List[str]) -> int:
         return _main_bundles(old, new)
     if ns.statements:
         return _main_statements(old, new, threshold)
+    if ns.tenants:
+        return _main_tenants(old, new, threshold)
     rows = diff(old, new, threshold)
     if not rows:
         print("no comparable configs between the two artifacts", file=sys.stderr)

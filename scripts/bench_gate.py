@@ -82,6 +82,13 @@ FLOOR_PIPE_RATIO = float(os.environ.get("SURREAL_BENCH_GATE_PIPE_RATIO", "5.0"))
 PROFILER_OVERHEAD_CEILING = float(
     os.environ.get("SURREAL_BENCH_GATE_PROFILER_OVERHEAD", "3.0")
 )
+# tenant cost-attribution plane (schema/13): the per-statement metering's
+# measured overhead on the config-2 engine path must stay under this
+# ceiling (percent; the ISSUE 16 <=3% contract — same paired-minimum
+# estimator, see bench.py _accounting_overhead)
+ACCOUNTING_OVERHEAD_CEILING = float(
+    os.environ.get("SURREAL_BENCH_GATE_ACCOUNTING_OVERHEAD", "3.0")
+)
 TIMEOUT = int(os.environ.get("SURREAL_BENCH_GATE_TIMEOUT", "1200"))
 
 
@@ -158,6 +165,15 @@ def main() -> int:
         failures.append(
             f"sampling-profiler overhead {overhead}% > ceiling "
             f"{PROFILER_OVERHEAD_CEILING}% (the always-on contract)"
+        )
+    ao = line.get("accounting_overhead") or {}
+    acct_overhead = ao.get("overhead_pct")
+    if acct_overhead is None:
+        failures.append("config 2 carries no accounting_overhead measurement")
+    elif acct_overhead > ACCOUNTING_OVERHEAD_CEILING:
+        failures.append(
+            f"tenant-accounting overhead {acct_overhead}% > ceiling "
+            f"{ACCOUNTING_OVERHEAD_CEILING}% (the always-on contract)"
         )
     # the statistics plane must have SEEN the window: a /12 artifact whose
     # config-2 line recorded no fingerprints means recording is broken

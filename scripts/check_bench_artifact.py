@@ -23,7 +23,7 @@ import os
 import sys
 from typing import List
 
-SCHEMA = "surrealdb-tpu-bench/12"
+SCHEMA = "surrealdb-tpu-bench/13"
 # earlier rounds' committed artifacts stay validatable under their own rules
 KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/1",
@@ -37,6 +37,7 @@ KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/9",
     "surrealdb-tpu-bench/10",
     "surrealdb-tpu-bench/11",
+    "surrealdb-tpu-bench/12",
     SCHEMA,
 )
 
@@ -125,6 +126,14 @@ CLUSTER_OBS_KEYS = ("bundle", "slowest_profile", "live_nodes")
 STATEMENTS_TOP_KEYS = ("fingerprint", "sql", "calls", "plan_mix")
 PROFILER_OVERHEAD_KEYS = ("rounds", "on_s", "off_s", "overhead_pct")
 PLAN_MIX_CONFIGS = ("6", "9")
+# schema/13: the per-config tenant embed + the config-11 attribution line
+TENANTS_EMBED_KEYS = ("per_tenant", "global", "count", "evicted")
+TENANT_PLANE_KEYS = (
+    "per_tenant", "conservation", "abusive", "budget", "federated",
+)
+# conservation deviations the config-11 line must stay under (percent)
+TENANT_CONSERVATION_PCT = 1.0
+TENANT_ABUSIVE_SHARE = 0.9
 COMPILES_KEYS = ("on_demand", "prewarm", "events")
 BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
 BATCH_KEYS_V3 = BATCH_KEYS + ("width_dist", "pipeline_wait_s")
@@ -206,6 +215,80 @@ def _check_flow_audit(bundle: dict) -> List[str]:
     return problems
 
 
+def _check_tenant_plane(where: str, metric: str, r: dict) -> List[str]:
+    """The config-11 attribution contract (schema/13): conservation within
+    TENANT_CONSERVATION_PCT, the abusive tenant owning >= 90% of the scan
+    volume, a trace-linked budget-breach event, and a non-empty federated
+    node-tagged view. A multi_tenant line that cannot prove these is
+    INVALID — the whole point of the config is the proof."""
+    problems: List[str] = []
+    tp = r.get("tenant_plane")
+    if not isinstance(tp, dict):
+        return [
+            f"{where} ({metric}): config-11 must carry the 'tenant_plane' "
+            "object (conservation + attribution + budget evidence)"
+        ]
+    for key in TENANT_PLANE_KEYS:
+        if key not in tp:
+            problems.append(f"{where} ({metric}): tenant_plane missing {key!r}")
+    per = tp.get("per_tenant")
+    if not isinstance(per, list) or len(per) < 3:
+        problems.append(
+            f"{where} ({metric}): tenant_plane.per_tenant must name all "
+            "three bench namespaces (non-empty breakdown)"
+        )
+    cons = tp.get("conservation")
+    if not isinstance(cons, dict):
+        problems.append(
+            f"{where} ({metric}): tenant_plane.conservation must be an object"
+        )
+    else:
+        for key in ("cpu_pct", "rows_scanned_pct", "dispatch_pct"):
+            pct = cons.get(key)
+            if not isinstance(pct, (int, float)) or pct > TENANT_CONSERVATION_PCT:
+                problems.append(
+                    f"{where} ({metric}): conservation.{key} must be "
+                    f"<= {TENANT_CONSERVATION_PCT}% (got {pct!r}) — the "
+                    "per-tenant sums diverged from the global counters"
+                )
+        if cons.get("evicted_during_window"):
+            problems.append(
+                f"{where} ({metric}): tenant entries were evicted mid-window "
+                "— the conservation sums are no longer complete"
+            )
+    ab = tp.get("abusive")
+    share = ab.get("rows_share") if isinstance(ab, dict) else None
+    if not isinstance(share, (int, float)) or share < TENANT_ABUSIVE_SHARE:
+        problems.append(
+            f"{where} ({metric}): abusive.rows_share must be >= "
+            f"{TENANT_ABUSIVE_SHARE} (got {share!r}) — attribution failed "
+            "to pin the scan volume on the abusive namespace"
+        )
+    budget = tp.get("budget")
+    if not isinstance(budget, dict) or not isinstance(budget.get("breach"), dict):
+        problems.append(
+            f"{where} ({metric}): tenant_plane.budget.breach must carry the "
+            "tenant.budget_exceeded event"
+        )
+    elif not budget.get("breach_trace_id"):
+        problems.append(
+            f"{where} ({metric}): the budget breach carries no trace_id — "
+            "breach -> /trace/:id is the budget plane's one-hop contract"
+        )
+    fed = tp.get("federated")
+    if not isinstance(fed, list) or not fed:
+        problems.append(
+            f"{where} ({metric}): tenant_plane.federated must be the "
+            "non-empty node-tagged /tenants?cluster=1 merge"
+        )
+    elif not all(isinstance(e, dict) and e.get("node") for e in fed):
+        problems.append(
+            f"{where} ({metric}): every federated tenant entry must be "
+            "node-tagged"
+        )
+    return problems
+
+
 def validate(path: str) -> List[str]:
     problems: List[str] = []
     try:
@@ -219,7 +302,8 @@ def validate(path: str) -> List[str]:
     if art.get("schema") not in KNOWN_SCHEMAS:
         problems.append(f"schema is {art.get('schema')!r}, expected one of {KNOWN_SCHEMAS}")
     schema = art.get("schema")
-    v12 = schema == SCHEMA
+    v13 = schema == SCHEMA
+    v12 = v13 or schema == "surrealdb-tpu-bench/12"
     v11 = v12 or schema == "surrealdb-tpu-bench/11"
     v10 = v11 or schema == "surrealdb-tpu-bench/10"
     v9 = v10 or schema == "surrealdb-tpu-bench/9"
@@ -246,7 +330,9 @@ def validate(path: str) -> List[str]:
             problems.append("schema/5 artifact missing the embedded debug bundle")
         else:
             sections = (
-                BUNDLE_SECTIONS_V9 + ("statements", "profiler")
+                BUNDLE_SECTIONS_V9 + ("statements", "profiler", "tenants")
+                if v13
+                else BUNDLE_SECTIONS_V9 + ("statements", "profiler")
                 if v12
                 else (
                     BUNDLE_SECTIONS_V9
@@ -583,6 +669,35 @@ def validate(path: str) -> List[str]:
                         problems.append(
                             f"{where} ({metric}): profiler_overhead missing {key!r}"
                         )
+        if v13:
+            tn = r.get("tenants")
+            if not isinstance(tn, dict):
+                problems.append(
+                    f"{where} ({metric}): schema/13 config lines must carry "
+                    "the 'tenants' object (per-(ns,db) meters + global "
+                    "conservation totals)"
+                )
+            else:
+                for key in TENANTS_EMBED_KEYS:
+                    if key not in tn:
+                        problems.append(
+                            f"{where} ({metric}): tenants missing {key!r}"
+                        )
+        if v13 and str(r.get("config")) == "2" and metric.startswith("knn_qps"):
+            ao = r.get("accounting_overhead")
+            if not isinstance(ao, dict):
+                problems.append(
+                    f"{where} ({metric}): schema/13 config-2 must carry the "
+                    "'accounting_overhead' A/B object"
+                )
+            else:
+                for key in PROFILER_OVERHEAD_KEYS:
+                    if key not in ao:
+                        problems.append(
+                            f"{where} ({metric}): accounting_overhead missing {key!r}"
+                        )
+        if v13 and str(r.get("config")) == "11" and metric.startswith("multi_tenant"):
+            problems.extend(_check_tenant_plane(where, metric, r))
         if v4 and metric.startswith("filtered_scan"):
             for key in FILTERED_SCAN_KEYS:
                 if key not in r:

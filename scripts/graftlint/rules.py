@@ -901,3 +901,72 @@ def gl012(modules: List[Module]) -> List[Finding]:
                 )
             )
     return out
+
+
+# ------------------------------------------------------------------ GL013
+# The tenant cost-attribution store (surrealdb_tpu/accounting.py) has ONE
+# write door: accounting.charge(). It owns the lock discipline (mutate
+# under accounting.store, emit breach events/counters only after release),
+# the budget crossing detection and the store/fp-cap eviction; an ad-hoc
+# writer reaching into the private store, the activation/tally tables, or
+# the entry class would bypass all three — and break the conservation
+# property the bench validator enforces. Outside accounting.py, touching
+# any private member of the accounting module is a finding.
+GL013_ALLOWED_FILES = frozenset({"surrealdb_tpu/accounting.py"})
+GL013_ACCT_MODULE = "surrealdb_tpu.accounting"
+GL013_PRIVATE = frozenset(
+    {"_store", "_lock", "_global", "_evicted", "_Entry",
+     "_active_by_thread", "_tally_by_thread", "_tenant_ctx",
+     "_budget_cache"}
+)
+
+
+def _gl013_acct_aliases(m: Module) -> Set[str]:
+    """Every local NAME the accounting module is bound to in this file
+    (mirrors _gl012_stats_aliases; a plain `import surrealdb_tpu.accounting`
+    is matched as the dotted chain in gl013())."""
+    out: Set[str] = set()
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == GL013_ACCT_MODULE and a.asname:
+                    out.add(a.asname)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                if (
+                    f"{node.module}.{a.name}" == GL013_ACCT_MODULE
+                    or (a.name == "accounting" and node.module == "surrealdb_tpu")
+                ):
+                    out.add(a.asname or a.name)
+    return out
+
+
+@_rule("GL013", "ad-hoc access to the tenant-accounting store outside accounting.charge()")
+def gl013(modules: List[Module]) -> List[Finding]:
+    out: List[Finding] = []
+    for m in modules:
+        if m.rel in GL013_ALLOWED_FILES:
+            continue
+        aliases = _gl013_acct_aliases(m)
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if node.attr not in GL013_PRIVATE:
+                continue
+            via_alias = (
+                isinstance(node.value, ast.Name) and node.value.id in aliases
+            )
+            via_dotted = _gl012_dotted(node.value) == GL013_ACCT_MODULE
+            if not (via_alias or via_dotted):
+                continue
+            out.append(
+                Finding(
+                    "GL013", m.rel, node.lineno, node.col_offset,
+                    f"accounting.{node.attr} accessed outside accounting.py "
+                    "— tenant-meter mutation must go through "
+                    "accounting.charge() (the one door that keeps the lock "
+                    "discipline, budget detection and conservation honest)",
+                    f"GL013:{m.rel}:{m.enclosing_def(node)}:{node.attr}",
+                )
+            )
+    return out

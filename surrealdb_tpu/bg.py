@@ -63,7 +63,7 @@ class Task:
     __slots__ = (
         "id", "kind", "target", "state", "owner", "trace_id", "deadline_s",
         "scheduled_ts", "start_ts", "end_ts", "duration_s", "error",
-        "retries", "stalled", "thread", "service", "stack",
+        "retries", "stalled", "thread", "service", "stack", "tenant",
     )
 
     def __init__(self, tid, kind, target, owner, trace_id, deadline_s):
@@ -87,6 +87,9 @@ class Task:
         self.service = False
         # stack sample captured by the watchdog when it flagged the stall
         self.stack: Optional[List[str]] = None
+        # the (ns, db) whose statement ARMED this task — the same parent
+        # link trace_id rides; the task's run time is charged to it
+        self.tenant: Optional[tuple] = None
 
     def to_dict(self) -> dict:
         return {
@@ -107,6 +110,7 @@ class Task:
             "service": self.service,
             "stack": self.stack,
             "thread": self.thread.name if self.thread is not None else None,
+            "tenant": list(self.tenant) if self.tenant is not None else None,
         }
 
 
@@ -152,12 +156,19 @@ def register(
         from surrealdb_tpu import tracing
 
         trace_id = tracing.current_trace_id()
+    # the ARMING statement's tenant (registration happens on its thread /
+    # context, exactly like the trace link above) — run() charges the
+    # task's duration to it, however much later the body executes
+    from surrealdb_tpu import accounting
+
+    tenant = accounting.current_tenant()
     if deadline is None:
         deadline = KIND_DEADLINES.get(kind, cnf.BG_WATCHDOG_DEADLINE_SECS)
     with _lock:
         _next_id += 1
         tid = _next_id
-        _tasks[tid] = Task(tid, kind, target, owner, trace_id, deadline)
+        t = _tasks[tid] = Task(tid, kind, target, owner, trace_id, deadline)
+        t.tenant = tenant
         _trim_locked()
     _ensure_watchdog()
     return tid
@@ -263,6 +274,18 @@ def run(task_id: int, rename_thread: bool = True):
             )
             if t.duration_s is not None:
                 telemetry.observe("bg_task", t.duration_s, kind=kind)
+                # tenant accounting (AFTER _lock release — the store lock
+                # must never nest inside bg.registry): the task's run time
+                # lands on whoever armed it, mirrored into the global
+                # counter the conservation check reads
+                from surrealdb_tpu import accounting
+
+                tenant = t.tenant or (None, None)
+                telemetry.inc("bg_task_seconds", by=t.duration_s)
+                accounting.charge(
+                    tenant[0], tenant[1], bg_kind=kind,
+                    bg_s=t.duration_s, bg_tasks=1,
+                )
 
 
 def spawn(

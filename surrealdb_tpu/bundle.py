@@ -52,6 +52,11 @@ versioned document — the artifact you attach to any perf report:
                      (profiler.py): per-thread (`bg:<kind>`-named) and
                      per-fingerprint sample counts and the hottest
                      folded stacks (new in bundle/6).
+14. `tenants`      — the tenant cost-attribution plane (accounting.py):
+                     per-(ns, db) resource meters — cpu/exec/dispatch
+                     time, rows and bytes, bg-task and scatter cost —
+                     with global conservation totals, store size and
+                     eviction count (new in bundle/7).
 
 Served by `GET /debug/bundle` (system-user-gated) and embedded via
 `INFO FOR ROOT` (`system.bundle`); bench.py embeds one per artifact so a
@@ -71,13 +76,13 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-BUNDLE_SCHEMA = "surrealdb-tpu-bundle/6"
+BUNDLE_SCHEMA = "surrealdb-tpu-bundle/7"
 
 # the sections every consumer may rely on
 SECTIONS = (
     "traces", "slow_queries", "errors", "tasks", "compiles", "engine",
     "locks", "faults", "events", "kernel_audit", "flow_audit",
-    "statements", "profiler",
+    "statements", "profiler", "tenants",
 )
 
 
@@ -85,7 +90,8 @@ def debug_bundle(
     ds=None, trace_limit: int = 50, full_traces: int = 10
 ) -> Dict[str, Any]:
     from surrealdb_tpu import (
-        bg, compile_log, events, faults, profiler, stats, telemetry, tracing,
+        accounting, bg, compile_log, events, faults, profiler, stats,
+        telemetry, tracing,
     )
     from surrealdb_tpu.utils import locks
 
@@ -116,6 +122,7 @@ def debug_bundle(
         "flow_audit": _flow_audit_state(),
         "statements": stats.snapshot(),
         "profiler": profiler.report(),
+        "tenants": accounting.snapshot(),
     }
     return out
 

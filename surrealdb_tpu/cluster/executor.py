@@ -150,6 +150,7 @@ class _StmtCtx:
         "degraded", "failed_nodes", "_budget", "_lock",
         "scatter_kind", "admission_wait_s", "merge_s", "rows_gathered",
         "retries", "shards", "remote_slow", "remote_errors", "pushdown",
+        "executed_local", "fp", "tenant",
     )
 
     def __init__(self, budget: int):
@@ -162,6 +163,16 @@ class _StmtCtx:
         self.merge_s = 0.0
         self.rows_gathered: Optional[int] = None
         self.retries = 0
+        # True once the statement ran through ds.execute_local (which does
+        # its own ring + tenant accounting) — _account_statement must not
+        # double-record, but a statement that neither scattered nor ran
+        # locally (routing refusals, sheds) must not VANISH either
+        self.executed_local = False
+        # the coordinating statement's fingerprint + tenant: scatter-pool
+        # threads activate these in the per-thread attribution tables so
+        # profiler samples land on the statement, not an unattributed bucket
+        self.fp: Optional[str] = None
+        self.tenant: Optional[tuple] = None
         # node -> {"calls", "rpc_s", "max_rpc_s", "rows", "retries",
         #          "failovers", "errors", "partials"} (seconds internally;
         #          the profile renders milliseconds)
@@ -414,11 +425,14 @@ class ClusterExecutor:
                 # fingerprint — shard-local executions of the SAME text
                 # (the scattered sub-queries) accumulate onto the same
                 # fingerprint through each shard's own executor
-                from surrealdb_tpu import stats as _stats
+                from surrealdb_tpu import accounting, stats as _stats
 
                 fp, _norm = _stats.fingerprint(src)
                 tracing.annotate(fingerprint=fp)
                 fp_tok = _stats.activate(fp)
+                ctx.fp = fp
+                ctx.tenant = (session.ns, session.db)
+                a_tok = accounting.activate(session.ns, session.db)
                 try:
                     self.admission.acquire()
                     admitted = True
@@ -430,6 +444,7 @@ class ClusterExecutor:
                 except Exception as e:  # noqa: BLE001 — mirror Executor's guard
                     resp = _err(f"Internal error: {type(e).__name__}: {e}")
                 finally:
+                    accounting.deactivate(a_tok)
                     _stats.deactivate(fp_tok)
                     _STMT.reset(token)
                     if admitted:
@@ -455,11 +470,18 @@ class ClusterExecutor:
         own ring entries joined in (today a slow remote shard is only
         visible on the remote node; after this it shows up once, here,
         with the per-node breakdown)."""
-        from surrealdb_tpu import stats, telemetry, tracing
+        from surrealdb_tpu import accounting, stats, telemetry, tracing
 
         if not ctx.shards:
-            # not a scattered statement: the local execution path already
-            # did its own slow/error accounting (dbs/executor.py)
+            if ctx.executed_local:
+                # the local execution path already did its own slow/error
+                # + tenant accounting (dbs/executor.py)
+                return
+            # coordinator-level outcome with NO shard and NO local run
+            # (routing refusals, admission sheds, LET binds): without this
+            # the statement — and its session{ns,db} — vanished from every
+            # ring; record it here, session-tagged, and charge the tenant
+            self._account_coordinator_only(stm, src, session, resp, dt)
             return
         kind = type(stm).__name__
         profile = ctx.profile(src, kind, dt)
@@ -527,6 +549,85 @@ class ClusterExecutor:
                         # scattered statements), node-tagged
                         "remote_slow": list(ctx.remote_slow),
                     },
+                }
+            )
+        # tenant accounting: the coordinator's OWN cost of this statement —
+        # per-shard scatter RPC time (node breakdown) plus admission wait.
+        # Shard-local executions charge their cpu/rows under the same
+        # (ns, db) through their own executors; charging exec time here
+        # too would double-count the tenant.
+        with ctx._lock:
+            shard_raw = {
+                n: (sh["rpc_s"], sh["calls"]) for n, sh in ctx.shards.items()
+            }
+        total_rpc = 0.0
+        for nid, (rpc_s, calls) in sorted(shard_raw.items()):
+            total_rpc += rpc_s
+            accounting.charge(
+                session.ns, session.db, fingerprint=fp, node=nid,
+                scatter_rpc_s=rpc_s, scatter_calls=calls,
+            )
+        telemetry.inc("scatter_rpc_seconds", by=total_rpc)
+        if ctx.admission_wait_s:
+            accounting.charge(
+                session.ns, session.db, fingerprint=fp,
+                admission_wait_s=ctx.admission_wait_s,
+            )
+
+    def _account_coordinator_only(
+        self, stm, src: str, session, resp: dict, dt: float
+    ) -> None:
+        """Ring + tenant accounting for a statement that resolved entirely
+        at the coordinator (no scatter, no local execution): routing
+        refusals, admission sheds, LET binds. Errors/slow statements here
+        used to skip every ring — and always dropped session{ns,db}."""
+        from surrealdb_tpu import accounting, stats, telemetry, tracing
+
+        kind = type(stm).__name__
+        errored = resp.get("status") == "ERR"
+        slow = dt >= cnf.SLOW_QUERY_THRESHOLD_SECS
+        fp, norm = stats.fingerprint(src)
+        session_info = {
+            "ns": session.ns,
+            "db": session.db,
+            "auth": getattr(session.auth, "level", None) or "anon",
+        }
+        stats.record(
+            fp, norm, kind, dt, error=errored, slow=slow,
+            rows_out=0, plan=None, extra_mix={"coordinator": 1}, primary=None,
+        )
+        accounting.charge(
+            session.ns, session.db, fingerprint=fp,
+            statements=1, errors=1 if errored else 0,
+            slow=1 if slow else 0, exec_s=dt,
+        )
+        if errored:
+            telemetry.inc("statement_errors", kind=kind)
+            tracing.force_keep()
+            telemetry.record_error(
+                {
+                    "ts": _time.time(),
+                    "kind": kind,
+                    "error": str(resp.get("result"))[:300],
+                    "trace_id": tracing.current_trace_id(),
+                    "fingerprint": fp,
+                    "session": session_info,
+                }
+            )
+        if slow:
+            telemetry.inc("slow_queries", kind=kind)
+            tracing.force_keep()
+            telemetry.record_slow_query(
+                {
+                    "ts": _time.time(),
+                    "sql": src[:500],
+                    "kind": kind,
+                    "duration_s": round(dt, 6),
+                    "plan": None,
+                    "trace_id": tracing.current_trace_id(),
+                    "fingerprint": fp,
+                    "session": session_info,
+                    "error": str(resp.get("result"))[:500] if errored else None,
                 }
             )
 
@@ -687,6 +788,33 @@ class ClusterExecutor:
                     ctx.harvest_remote(node_id, resp)
                 return resp
 
+    def _pooled_call(
+        self, node_id: str, op: str, req: Dict[str, Any], idempotent: bool = False
+    ) -> Dict[str, Any]:
+        """`_call` wrapped for scatter-POOL threads: contextvars copied by
+        `_fan_out` carry the trace and tenant CONTEXT, but the sampling
+        profiler attributes cross-thread through the GIL-atomic
+        thread-ident tables (stats.activate / accounting.activate) — so a
+        pool worker must mark its statement's fingerprint and tenant
+        active for ITS ident, or its samples land in the unattributed
+        bucket while the coordinating thread sits idle in fut.result()."""
+        from surrealdb_tpu import accounting, stats as _stats
+
+        ctx = _STMT.get(None)
+        fp_tok = _stats.activate(ctx.fp) if ctx is not None and ctx.fp else None
+        a_tok = (
+            accounting.activate(*ctx.tenant)
+            if ctx is not None and ctx.tenant is not None
+            else None
+        )
+        try:
+            return self._call(node_id, op, req, idempotent=idempotent)
+        finally:
+            if a_tok is not None:
+                accounting.deactivate(a_tok)
+            if fp_tok is not None:
+                _stats.deactivate(fp_tok)
+
     def _fan_out(
         self,
         node_ids: List[str],
@@ -722,7 +850,7 @@ class ClusterExecutor:
         futs = {
             nid: self._pool.submit(
                 contextvars.copy_context().run,
-                self._call, nid, op, req, idempotent,
+                self._pooled_call, nid, op, req, idempotent,
             )
             for nid in node_ids
         }
@@ -870,6 +998,12 @@ class ClusterExecutor:
         return rows
 
     def _local_stm(self, src: str, session, vars) -> dict:
+        ctx = _STMT.get(None)
+        if ctx is not None:
+            # execute_local runs the single-node executor, which does its
+            # own ring + tenant accounting — _account_statement must not
+            # account this statement a second time
+            ctx.executed_local = True
         out = self.ds.execute_local(src, session, vars)
         if not out:
             return _ok(NONE)

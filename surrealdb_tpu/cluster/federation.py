@@ -153,6 +153,29 @@ def federated_statements(
     return merged[: max(int(limit), 1)]
 
 
+def federated_tenants(ds, limit: int = 50, sort: str = "exec_s") -> list:
+    """`GET /tenants?cluster=1`: every member's per-(ns, db) resource
+    meters merged into one list, each entry tagged `node=<id>` — the
+    cluster-wide answer to "which tenant is eating the cluster, and on
+    which nodes". Per-member entries stay separate rather than summed:
+    a tenant hot on one node and idle elsewhere is the exact signal a
+    merged total would erase (skewed placement vs genuinely heavy load).
+    Dead members are simply absent, like every federation surface."""
+    from surrealdb_tpu import accounting
+
+    key = sort if sort in accounting.METERS else "exec_s"
+    gathered, _ = _gather(ds, "tenants", {"limit": limit, "sort": key})
+    merged = []
+    for nid, entries in gathered.items():
+        if not isinstance(entries, list):
+            continue
+        for e in entries:
+            if isinstance(e, dict):
+                merged.append(dict(e, node=nid))
+    merged.sort(key=lambda e: (-(e.get(key) or 0), str(e.get("node"))))
+    return merged[: max(int(limit), 1)]
+
+
 def federated_events(
     ds, kind_prefix: Optional[str] = None, limit: Optional[int] = None
 ) -> list:

@@ -16,6 +16,7 @@ Ops:
     metrics   {}                             -> {json: <telemetry export>}
     events    {kind?, limit?}                -> {json: <event timeline>}
     statements {limit?, fingerprint?, sort?} -> {json: <statement stats>}
+    tenants   {limit?, sort?}                -> {json: <per-(ns,db) meters>}
     member_update {phase, epoch, nodes, ...} -> {ok, view}   (elastic membership)
     membership  {}                           -> {view, migration}
     migrate_ranges {epoch, live}             -> {rows, targets}
@@ -354,6 +355,21 @@ def _op_statements(ds, req):
     return {"json": _json.dumps(out, default=str)}
 
 
+def _op_tenants(ds, req):
+    """This node's per-(ns, db) resource meters for the federated
+    `/tenants?cluster=1` merge (tenant cost-attribution plane,
+    accounting.py): entries ride node-UNtagged — the coordinator tags
+    each with its serving member id, like the /statements merge."""
+    from surrealdb_tpu import accounting
+
+    limit = req.get("limit")
+    out = accounting.top(
+        limit=int(limit) if limit is not None else 100,
+        sort=str(req.get("sort") or "exec_s"),
+    )
+    return {"json": _json.dumps(out, default=str)}
+
+
 def _op_member_update(ds, req):
     """Elastic membership: prepare / commit / abort one epoch change
     (cluster/membership.py drives the two-phase flow)."""
@@ -452,6 +468,7 @@ _OPS = {
     "metrics": _op_metrics,
     "events": _op_events,
     "statements": _op_statements,
+    "tenants": _op_tenants,
     # elastic membership + convergent repair
     "member_update": _op_member_update,
     "membership": _op_membership,

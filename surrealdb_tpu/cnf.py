@@ -316,6 +316,22 @@ STATEMENTS_STORE_SIZE = _env_int("SURREAL_STATEMENTS_STORE_SIZE", 512)
 PROFILE_HZ = _env_float("SURREAL_PROFILE_HZ", 7.0)
 PROFILE_MAX_STACKS = _env_int("SURREAL_PROFILE_MAX_STACKS", 512)
 
+# Tenant cost-attribution plane (accounting.py). The per-(ns, db) meter
+# store is a bounded LRU (TENANT_STORE_SIZE tenants, TENANT_FP_CAP
+# fingerprint drill-down entries per tenant). Budgets are OBSERVE-ONLY
+# soft limits: a plain float applies to every tenant, "ns:limit[,...]"
+# per namespace; a crossing emits tenant.budget_exceeded + bumps
+# tenant_budget_breaches{ns} — proposals, never enforcement. Measured
+# accounting overhead on bench config 2 must stay <=3%
+# (scripts/bench_gate.py enforces it, same gate as the profiler).
+TENANT_ACCOUNTING = _env_bool("SURREAL_TENANT_ACCOUNTING", True)
+TENANT_STORE_SIZE = _env_int("SURREAL_TENANT_STORE_SIZE", 256)
+TENANT_FP_CAP = _env_int("SURREAL_TENANT_FP_CAP", 32)
+TENANT_BUDGET_CPU_S = os.environ.get("SURREAL_TENANT_BUDGET_CPU_S", "")
+TENANT_BUDGET_DISPATCH_S = os.environ.get("SURREAL_TENANT_BUDGET_DISPATCH_S", "")
+TENANT_BUDGET_ROWS = os.environ.get("SURREAL_TENANT_BUDGET_ROWS", "")
+TENANT_BUDGET_BYTES = os.environ.get("SURREAL_TENANT_BUDGET_BYTES", "")
+
 # Flight recorder (bg.py + compile_log.py): background-task registry with
 # a watchdog that flips tasks to `stalled` past a per-kind deadline, and a
 # bounded XLA compile-event log (prewarm vs on-demand attribution).

@@ -102,7 +102,7 @@ def _retry_cause(e: BaseException) -> str:
 class _Req:
     __slots__ = (
         "payload", "runner", "event", "result", "error", "promoted", "done",
-        "t_submit", "trace_ctx",
+        "t_submit", "trace_ctx", "tenant",
     )
 
     def __init__(self, payload, runner):
@@ -116,9 +116,12 @@ class _Req:
         self.t_submit = _time.perf_counter()  # queue-wait accounting
         # the submitting request's trace position: whoever LEADS the batch
         # re-parents the kernel spans onto every rider here (tracing.py)
-        from surrealdb_tpu import tracing
+        from surrealdb_tpu import accounting, tracing
 
         self.trace_ctx = tracing.current()
+        # the submitting statement's tenant: every rider of a coalesced
+        # batch is charged its own share of the batch's device time
+        self.tenant = accounting.current_tenant()
 
 
 class _Bucket:
@@ -252,6 +255,21 @@ class DispatchQueue:
         finally:
             b.sem.release()
 
+    def _charge_batch(self, batch: List[_Req], elapsed: float, meter: str) -> None:
+        """Tenant accounting: split one batch phase's elapsed time EQUALLY
+        across its riders — the shares sum exactly to the launch_s /
+        collect_s increment the same phase added, so per-tenant dispatch
+        meters conserve against stats() by construction. Runs with no
+        dispatch lock held (accounting.store must never nest inside)."""
+        from surrealdb_tpu import accounting
+
+        if not batch:
+            return
+        share = elapsed / len(batch)
+        for r in batch:
+            ns, db = r.tenant if r.tenant is not None else (None, None)
+            accounting.charge(ns, db, **{meter: share})
+
     def _trace_batch(
         self, batch: List[_Req], name: str, start: float, dur: float,
         error=None, **extra,
@@ -293,11 +311,18 @@ class DispatchQueue:
                 batch, "dispatch_pipeline_wait", t0 - pipeline_wait,
                 pipeline_wait, depth=b.depth,
             )
+        from surrealdb_tpu import accounting
+
         for r in batch:
             telemetry.observe("dispatch_queue_wait", t0 - r.t_submit)
             tracing.record_span_into(
                 r.trace_ctx, "dispatch_queue_wait", {"batch": len(batch)},
                 r.t_submit, t0 - r.t_submit,
+            )
+            ns, db = r.tenant if r.tenant is not None else (None, None)
+            accounting.charge(
+                ns, db,
+                dispatch_wait_s=t0 - r.t_submit, dispatch_batches=1,
             )
         from surrealdb_tpu import compile_log
 
@@ -334,9 +359,13 @@ class DispatchQueue:
             self._fail(batch, e, t0)
             return None
         finally:
+            elapsed = _time.perf_counter() - t0
             with self._lock:
                 _locks.assert_held(self._lock, "dispatch.counters")
-                self.launch_s += _time.perf_counter() - t0
+                self.launch_s += elapsed
+            # charge riders the SAME elapsed launch_s just accumulated
+            # (success and failure paths both) — conservation holds exactly
+            self._charge_batch(batch, elapsed, "dispatch_s")
         self._trace_batch(batch, "dispatch_launch", t0, _time.perf_counter() - t0)
         if not callable(res):
             self._distribute(batch, res)
@@ -362,9 +391,11 @@ class DispatchQueue:
                 self._fail(batch, e, t1)
                 return
             finally:
+                elapsed = _time.perf_counter() - t1
                 with self._lock:
                     _locks.assert_held(self._lock, "dispatch.counters")
-                    self.collect_s += _time.perf_counter() - t1
+                    self.collect_s += elapsed
+                self._charge_batch(batch, elapsed, "dispatch_s")
             self._trace_batch(batch, "dispatch_collect", t1, _time.perf_counter() - t1)
             self._distribute(batch, results)
 
@@ -372,13 +403,22 @@ class DispatchQueue:
 
     # ------------------------------------------------------------ retry
     def _run_whole(self, sub: List[_Req]) -> Sequence[Any]:
-        """One full re-execution (launch + collect) of a sub-batch."""
+        """One full re-execution (launch + collect) of a sub-batch. The
+        re-run's time is charged to the riders as dispatch_retry_s —
+        deliberately NOT dispatch_s, which conserves against launch_s +
+        collect_s (re-executions are extra device time outside both)."""
         from surrealdb_tpu import compile_log, tracing
 
         payloads = [r.payload for r in sub]
-        with tracing.detached(), compile_log.attribution(sub[0].trace_ctx):
-            res = sub[0].runner(payloads)
-            return res() if callable(res) else res
+        t0 = _time.perf_counter()
+        try:
+            with tracing.detached(), compile_log.attribution(sub[0].trace_ctx):
+                res = sub[0].runner(payloads)
+                return res() if callable(res) else res
+        finally:
+            self._charge_batch(
+                sub, _time.perf_counter() - t0, "dispatch_retry_s"
+            )
 
     def _split_retry(self, batch: List[_Req], cause: BaseException) -> None:
         """Memory-aware recovery from a transient batch failure: bisect

@@ -188,7 +188,7 @@ class Executor:
             except SurrealError as e:
                 return {"status": "ERR", "result": str(e)}
 
-        from surrealdb_tpu import stats, telemetry, tracing
+        from surrealdb_tpu import accounting, stats, telemetry, tracing
 
         # workload statistics plane: the literal-erased statement shape.
         # The fingerprint rides the trace meta (kept traces join their
@@ -197,17 +197,27 @@ class Executor:
         fp, norm = stats.fingerprint(src if src else repr(stm))
         tracing.annotate(**self._session_info(), fingerprint=fp)
         t0 = time.perf_counter()
+        cpu0 = time.thread_time()
         dstats0 = self.ds.dispatch.stats()
         # rows_in: bulk-ingest rows landed over this statement's window
         # (process-global counter delta, like the dispatch delta below)
         bulk0 = telemetry.get_counter("bulk_insert_rows")
         telemetry.drain_plan_notes()  # clear notes left by a prior statement
         tok = stats.activate(fp)
+        # tenant accounting: the statement executes FOR session (ns, db) —
+        # the activation is what dispatch riders, bg registrations and the
+        # profiler's cross-thread reads attribute through; the tally is
+        # the iterator's rows-scanned scratch, flushed below
+        atok = accounting.activate(self.session.ns, self.session.db)
+        tally0 = accounting.tally_begin()
         try:
             resp = self._execute_statement(ctx, stm)
         finally:
+            scanned = accounting.tally_end(tally0)
+            accounting.deactivate(atok)
             stats.deactivate(tok)
         dt = time.perf_counter() - t0
+        cpu_s = time.thread_time() - cpu0
         # drained ONCE per statement: the stats record and the slow-query
         # ring read the same plan-note list
         notes = telemetry.drain_plan_notes()
@@ -219,11 +229,25 @@ class Executor:
         rows_out = (
             len(result) if isinstance(result, list) else (0 if errored else 1)
         )
+        rows_in = int(telemetry.get_counter("bulk_insert_rows") - bulk0)
         stats.record(
             fp, norm, type(stm).__name__, dt,
             error=errored, slow=slow, rows_out=rows_out,
-            rows_in=int(telemetry.get_counter("bulk_insert_rows") - bulk0),
+            rows_in=rows_in,
             plan=notes, dispatch=dispatch_delta,
+        )
+        # tenant accounting flush: ONE charge per statement, mirrored into
+        # the global conservation counters with the SAME values so
+        # per-tenant sums reconcile against independent telemetry totals
+        rows_scanned = scanned.get("rows_scanned", 0.0)
+        telemetry.inc("statement_cpu_seconds", by=cpu_s)
+        telemetry.inc("statement_rows_scanned", by=rows_scanned)
+        telemetry.inc("statement_rows_returned", by=float(rows_out))
+        accounting.charge(
+            self.session.ns, self.session.db, fingerprint=fp,
+            statements=1, errors=1 if errored else 0, slow=1 if slow else 0,
+            exec_s=dt, cpu_s=cpu_s, rows_scanned=rows_scanned,
+            rows_returned=rows_out, rows_written=rows_in,
         )
         if errored:
             telemetry.inc("statement_errors", kind=type(stm).__name__)

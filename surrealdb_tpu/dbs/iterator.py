@@ -174,6 +174,8 @@ def _classify_value(ctx, v, verb: str, out: List[Any]) -> None:
 
 # ------------------------------------------------------------------ record streams
 def scan_table(ctx, tb: str) -> PyIterable[Tuple[Thing, dict]]:
+    from surrealdb_tpu import accounting
+
     ns, db = ctx.ns_db()
     txn = ctx.txn()
     pre = keys.thing_prefix(ns, db, tb)
@@ -182,6 +184,9 @@ def scan_table(ctx, tb: str) -> PyIterable[Tuple[Thing, dict]]:
     interval = max(cnf.SCAN_DEADLINE_INTERVAL, 1)
     n = 0
     for chunk in txn.batch(pre, prefix_end(pre), cnf.NORMAL_FETCH_SIZE):
+        # rows-scanned tally per CHUNK, not per row: the statement-local
+        # scratch the executor flushes into its one accounting.charge()
+        accounting.tally(rows_scanned=len(chunk))
         for k, raw in chunk:
             if n % interval == 0:
                 ctx.check_deadline()
@@ -205,9 +210,12 @@ def scan_range(ctx, tb: str, rng: Range) -> PyIterable[Tuple[Thing, dict]]:
         end = keys.thing(ns, db, tb, rng.end)
         if rng.end_incl:
             end += b"\x00"
+    from surrealdb_tpu import accounting
+
     interval = max(cnf.SCAN_DEADLINE_INTERVAL, 1)
     n = 0
     for chunk in txn.batch(beg, end, cnf.NORMAL_FETCH_SIZE):
+        accounting.tally(rows_scanned=len(chunk))
         for k, raw in chunk:
             if n % interval == 0:
                 ctx.check_deadline()

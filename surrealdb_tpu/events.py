@@ -60,6 +60,8 @@ KINDS: Dict[str, str] = {
     "cluster.tombstone_gc": "expired tombstones swept after a clean repair pass",
     # workload statistics plane
     "stats.plan_flip": "a statement fingerprint's primary plan decision flipped",
+    # tenant accounting plane
+    "tenant.budget_exceeded": "a tenant crossed a soft budget limit (observe-only)",
     # failpoints / chaos
     "fault.trip": "an armed failpoint site fired",
     # background machinery

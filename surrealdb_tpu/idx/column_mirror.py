@@ -846,6 +846,11 @@ class ColumnScanPlan:
             return
         mask, needs_row, mirror = res
         telemetry.inc("scan_strategy", strategy="columnar")
+        # the mask evaluation examined every mirrored row — tally the same
+        # rows_scanned the row path's chunked scan_table would have
+        from surrealdb_tpu import accounting
+
+        accounting.tally(rows_scanned=float(mask.size))
         n_fb = int(needs_row.sum())
         if n_fb:
             telemetry.observe_hist(
@@ -992,6 +997,11 @@ def try_columnar_count(ctx, stm, sources) -> Optional[list]:
         return None
     mask, needs_row, mirror = res
     telemetry.inc("scan_strategy", strategy="columnar_count")
+    # mask popcount still examined every mirrored row (tenant meter parity
+    # with the iterator path's per-chunk rows_scanned tally)
+    from surrealdb_tpu import accounting
+
+    accounting.tally(rows_scanned=float(mask.size))
     total = int((mask & ~needs_row).sum())
     fb = np.nonzero(needs_row)[0]
     if fb.size:

@@ -404,6 +404,11 @@ def try_columnar_ml_scan(ctx, stm, sources):
         live = np.nonzero(mask[: full.shape[0]])[0]
         out = full[live]
         rids_live = [rids[int(i)] for i in live]
+    # the whole-table forward examined every mirrored row (tenant meter
+    # parity with the iterator path's per-chunk rows_scanned tally)
+    from surrealdb_tpu import accounting
+
+    accounting.tally(rows_scanned=float(len(rids_live)))
     # table key order (the row path's order): sort by encoded record id
     order = sorted(
         range(len(rids_live)), key=lambda i: enc_value_key(rids_live[i].id)

@@ -201,7 +201,11 @@ class SurrealHandler(BaseHTTPRequestHandler):
             self._cached_body = self.rfile.read(n) if n else b""
         return self._cached_body
 
-    def _send(self, code: int, payload: Any, content_type: str = "application/json") -> None:
+    def _send(
+        self, code: int, payload: Any, content_type: str = "application/json"
+    ) -> int:
+        # returns the response body size so data routes (/sql) can charge
+        # bytes_out to the session's tenant
         # drain any unread request body first, or the next keep-alive request
         # parses mid-stream
         self._body()
@@ -235,6 +239,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 self.send_header("traceparent", tracing.format_traceparent(tid, 1))
         self.end_headers()
         self.wfile.write(body)
+        return len(body)
 
     def _session(self) -> Session:
         """Per-request session from headers (HTTP is stateless)."""
@@ -444,6 +449,32 @@ class SurrealHandler(BaseHTTPRequestHandler):
             return self._send(
                 200, _stats.statements(limit=limit, fingerprint=fp, sort=sort)
             )
+        if path == "/tenants":
+            # tenant cost-attribution plane (accounting.py): per-(ns, db)
+            # resource meters with per-fingerprint drill-down. Fingerprints
+            # name statement shapes and namespaces name customers, so
+            # system-gated like /statements.
+            if not self._route_allowed("tenants"):
+                return
+            if self._system_gate() is None:
+                return
+            from urllib.parse import parse_qs
+
+            from surrealdb_tpu import accounting as _accounting
+
+            q = parse_qs(urlparse(self.path).query)
+            try:
+                limit = int(q.get("limit", [None])[0]) if q.get("limit") else 50
+            except (TypeError, ValueError):
+                limit = 50
+            sort = q.get("sort", ["exec_s"])[0]
+            if self._cluster_query():
+                from surrealdb_tpu.cluster.federation import federated_tenants
+
+                return self._send(
+                    200, federated_tenants(self.ds, limit=limit, sort=sort)
+                )
+            return self._send(200, _accounting.top(limit=limit, sort=sort))
         if path == "/slow":
             # structured slow-query log (ring buffer; dbs/executor.py) — the
             # /metrics-adjacent debug endpoint. Entries carry raw statement
@@ -608,12 +639,21 @@ class SurrealHandler(BaseHTTPRequestHandler):
             sess = self._authorized_session()
         except SurrealError as e:
             return self._send(401, {"error": str(e)})
-        text = self._body().decode()
+        body = self._body()
+        text = body.decode()
         try:
             out = self.ds.execute(text, sess)
         except SurrealError as e:
             return self._send(400, {"error": str(e)})
-        return self._send(200, out)
+        sent = self._send(200, out)
+        # wire cost: charged here, at the protocol edge, because only the
+        # edge knows the serialized sizes (the executor sees row counts)
+        from surrealdb_tpu import accounting
+
+        accounting.charge(
+            sess.ns, sess.db, bytes_in=float(len(body)), bytes_out=float(sent)
+        )
+        return None
 
     def _auth_route(self, kind: str):
         try:

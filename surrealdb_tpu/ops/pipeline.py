@@ -841,6 +841,12 @@ def run_pipeline(ctx, stm, tb: str) -> Optional[Tuple[List[Any], dict]]:
     telemetry.inc(
         "column_pipeline", outcome="grouped" if shape is not None else "ordered"
     )
+    # a columnar pipeline examines every mirrored row — it is a full scan
+    # in columnar clothing, so the tenant meter sees the same rows_scanned
+    # the iterator path would have tallied
+    from surrealdb_tpu import accounting
+
+    accounting.tally(rows_scanned=float(mirror.n))
     note = {
         "table": tb,
         "plan": "ColumnPipeline",

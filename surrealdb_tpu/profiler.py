@@ -51,6 +51,7 @@ _dropped = 0  # stacks folded into the overflow bucket
 _started_ts: Optional[float] = None
 _by_thread: Dict[str, int] = {}
 _by_fp: Dict[str, int] = {}
+_by_tenant: Dict[str, int] = {}  # "ns.db" -> samples (tenant accounting)
 _folded: Dict[Tuple[str, str], int] = {}  # (thread kind, stack) -> samples
 
 _started = False
@@ -114,7 +115,7 @@ def _loop() -> None:
 def sample_once() -> int:
     """Take one snapshot of every live thread's stack; returns the number
     of threads sampled. Exposed for deterministic tests."""
-    from surrealdb_tpu import cnf, stats
+    from surrealdb_tpu import accounting, cnf, stats
 
     self_ident = threading.get_ident()
     try:
@@ -122,26 +123,37 @@ def sample_once() -> int:
     except Exception:  # noqa: BLE001 — a failed snapshot skips one tick
         return 0
     names = {t.ident: t.name for t in threading.enumerate()}
-    batch: List[Tuple[str, str, Optional[str]]] = []
+    batch: List[Tuple[str, str, Optional[str], Optional[str]]] = []
     for ident, frame in frames.items():
         if ident == self_ident:
             continue  # never profile the profiler
         kind = _thread_kind(names.get(ident, "thread"))
         stack = _fold(frame)
-        batch.append((kind, stack, stats.active_fingerprint(ident)))
+        # tenant attribution rides the same cross-thread activation
+        # tables the fingerprint does — scatter-pool threads activate
+        # their statement's tenant, so their samples attribute too
+        tenant = accounting.active_tenant(ident)
+        batch.append((
+            kind, stack, stats.active_fingerprint(ident),
+            f"{tenant[0]}.{tenant[1]}" if tenant is not None else None,
+        ))
     if not batch:
         return 0
     cap = max(int(getattr(cnf, "PROFILE_MAX_STACKS", 512)), 16)
     global _samples_total, _ticks, _dropped
     with _lock:
         _ticks += 1
-        for kind, stack, fp in batch:
+        for kind, stack, fp, tenant in batch:
             _samples_total += 1
             _by_thread[kind] = _by_thread.get(kind, 0) + 1
             if fp is not None and (
                 fp in _by_fp or len(_by_fp) < _FP_SERIES_CAP
             ):
                 _by_fp[fp] = _by_fp.get(fp, 0) + 1
+            if tenant is not None and (
+                tenant in _by_tenant or len(_by_tenant) < _FP_SERIES_CAP
+            ):
+                _by_tenant[tenant] = _by_tenant.get(tenant, 0) + 1
             key = (kind, stack)
             if key in _folded or len(_folded) < cap:
                 _folded[key] = _folded.get(key, 0) + 1
@@ -200,6 +212,9 @@ def report(top: int = 50) -> dict:
             "by_fingerprint": dict(
                 sorted(_by_fp.items(), key=lambda kv: -kv[1])[:top]
             ),
+            "by_tenant": dict(
+                sorted(_by_tenant.items(), key=lambda kv: -kv[1])[:top]
+            ),
             "top": [
                 {"thread": kind, "stack": stack, "samples": n}
                 for (kind, stack), n in folded
@@ -239,4 +254,5 @@ def reset() -> None:
         _dropped = 0
         _by_thread.clear()
         _by_fp.clear()
+        _by_tenant.clear()
         _folded.clear()

@@ -109,6 +109,11 @@ HIERARCHY: Dict[str, int] = {
                                # pure fold-and-release; never nests with
                                # stats.store (the attribution table it
                                # reads is a lock-free dict)
+    "accounting.store": 85,    # tenant meter store (accounting.py):
+                               # leaf-style — charge() mutates and
+                               # releases; breach events/counters emit
+                               # AFTER release (events/telemetry are
+                               # LOWER levels and must never nest inside)
     "telemetry.registry": 86,  # metrics registry (the hottest leaf)
 }
 

@@ -1,0 +1,35 @@
+"""GL013 fixture: ad-hoc access to the tenant-accounting store."""
+
+import time
+
+import surrealdb_tpu.accounting
+import surrealdb_tpu.accounting as acct
+from surrealdb_tpu import accounting
+
+
+def sneak_entry(ns: str, db: str):
+    # reaching into the private store bypasses charge()'s lock discipline,
+    # the budget crossing detection and the conservation property
+    with accounting._lock:
+        e = accounting._store.get((ns, db))
+        if e is None:
+            e = accounting._store[(ns, db)] = accounting._Entry(ns, db)
+        e.meters["statements"] += 1
+        accounting._global["statements"] = 0.0
+
+
+def sneak_activation(ns: str, db: str):
+    # the profiler's attribution table has activate()/deactivate() doors
+    acct._active_by_thread[12345] = (ns, db)
+    acct._tally_by_thread[12345] = {"rows_scanned": 1.0}
+
+
+def sneak_budget_and_evictions():
+    acct._budget_cache.clear()
+    acct._evicted += 1
+    return time.time()
+
+
+def sneak_dotted():
+    # the plain-import dotted path must not dodge the rule either
+    return surrealdb_tpu.accounting._store

@@ -1,0 +1,29 @@
+"""GL013 clean twin: tenant accounting through the public doors only."""
+
+from surrealdb_tpu import accounting
+
+
+def charge_statement(ns: str, db: str, fp: str, dt: float):
+    tok = accounting.activate(ns, db)
+    prev = accounting.tally_begin()
+    try:
+        accounting.tally(rows_scanned=128)  # iterator chunk callback
+    finally:
+        scanned = accounting.tally_end(prev)
+        accounting.deactivate(tok)
+    accounting.charge(
+        ns, db, fingerprint=fp,
+        statements=1, exec_s=dt, rows_scanned=scanned.get("rows_scanned", 0.0),
+    )
+
+
+def read_views(ns: str, db: str):
+    # read surfaces are public API, not store pokes
+    return (
+        accounting.top(limit=5),
+        accounting.get(ns, db),
+        accounting.size(),
+        accounting.global_totals(),
+        accounting.snapshot(),
+        accounting.current_tenant(),
+    )

@@ -25,7 +25,7 @@ def rule_ids(findings):
 
 
 # ------------------------------------------------------------------ per rule
-@pytest.mark.parametrize("rule", ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008", "GL009", "GL010", "GL011", "GL012"])
+@pytest.mark.parametrize("rule", ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008", "GL009", "GL010", "GL011", "GL012", "GL013"])
 def test_rule_fires_on_bad_fixture_and_not_on_clean(rule):
     bad = lint(f"{rule.lower()}_bad.py", rules=[rule])
     assert rule in rule_ids(bad), f"{rule} failed to fire on its fixture"
@@ -147,11 +147,12 @@ def test_cli_exit_codes():
             os.path.join(FIXTURES, "gl009_bad.py"),
             os.path.join(FIXTURES, "gl011_bad.py"),
             os.path.join(FIXTURES, "gl012_bad.py"),
+            os.path.join(FIXTURES, "gl013_bad.py"),
         ],
         cwd=REPO, capture_output=True, text=True, env=env,
     )
     assert bad.returncode == 1, bad.stdout + bad.stderr
-    for rule in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008", "GL009", "GL011", "GL012"):
+    for rule in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008", "GL009", "GL011", "GL012", "GL013"):
         assert rule in bad.stdout, f"{rule} missing from CLI output"
     # --update-baseline refuses a restricted scope (it would silently drop
     # every grandfathered entry the restricted run can't see)
@@ -205,6 +206,24 @@ def test_gl012_flags_private_access_under_any_alias():
     assert lint("gl012_clean.py", rules=["GL012"]) == []
 
 
+def test_gl013_flags_private_access_under_any_alias():
+    keys = {f.key for f in lint("gl013_bad.py", rules=["GL013"])}
+    # all three import spellings (`import surrealdb_tpu.accounting as acct`,
+    # `from surrealdb_tpu import accounting` and the plain
+    # `import surrealdb_tpu.accounting` dotted path) are caught, per member
+    assert any(":sneak_dotted:_store" in k for k in keys), keys
+    assert any(k.endswith(":_store") for k in keys), keys
+    assert any(k.endswith(":_lock") for k in keys), keys
+    assert any(k.endswith(":_global") for k in keys), keys
+    assert any(k.endswith(":_Entry") for k in keys), keys
+    assert any(k.endswith(":_active_by_thread") for k in keys), keys
+    assert any(k.endswith(":_tally_by_thread") for k in keys), keys
+    assert any(k.endswith(":_budget_cache") for k in keys), keys
+    assert any(k.endswith(":_evicted") for k in keys), keys
+    # the public doors — charge/activate/tally/top/snapshot — stay clean
+    assert lint("gl013_clean.py", rules=["GL013"]) == []
+
+
 def test_gl011_hierarchy_matches_runtime():
     # the rule checks against the REAL declared hierarchy, so the static
     # and runtime halves can never drift
@@ -224,7 +243,7 @@ def test_gl009_registry_matches_runtime():
 def test_every_rule_has_doc_and_registration():
     assert set(rules_mod.RULES) == {
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008", "GL009", "GL010", "GL011", "GL012",
+        "GL008", "GL009", "GL010", "GL011", "GL012", "GL013",
     }
     for rid, (fn, doc) in rules_mod.RULES.items():
         assert callable(fn) and doc
