@@ -63,7 +63,7 @@ import time
 import numpy as np
 
 SCALE = float(os.environ.get("SURREAL_BENCH_SCALE", "1.0"))
-CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9,10,11").split(","))
+CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9,10,11,12").split(","))
 ROUND = os.environ.get("SURREAL_BENCH_ROUND", "r10")
 OUT_PATH = os.environ.get(
     "SURREAL_BENCH_OUT",
@@ -142,7 +142,22 @@ PROFILE = "--profile" in sys.argv[1:] or os.environ.get("SURREAL_PROFILE") == "1
 # ceiling bench_gate enforces. The embedded bundle is
 # surrealdb-tpu-bundle/7 (section 14 `tenants`), and `bench_diff
 # --tenants` names per-tenant share shifts between two artifacts.
-SCHEMA = "surrealdb-tpu-bench/13"
+# schema/14 (r18, advisor plane): new config 12 `advisor_shift` — a
+# SHIFTING workload (scan-heavy -> point-lookup -> vector-heavy phases
+# over dedicated tables, stats/accounting reset at each transition so a
+# phase is one observation window) whose line carries an `advisor`
+# object: per-phase proposal snapshots with the statements/tenants
+# embeds their evidence chains resolve against. The validator asserts
+# the phase-appropriate proposals (`index.create` in the scan phase;
+# its expiry plus `ivf.retrain` — a deliberately outgrown quantizer —
+# by the vector phase) and that every evidence pointer resolves
+# in-artifact. The config-2 line adds `advisor_overhead`: the paired
+# sweeps-live/parked A/B (at a deliberately hostile 0.25s interval)
+# whose <=3% ceiling bench_gate enforces, same contract as the profiler
+# and accounting planes. The embedded bundle is surrealdb-tpu-bundle/8
+# (section 15 `advisor`), and `bench_diff --advisor` names proposals
+# that appeared/resolved/flapped between two artifacts.
+SCHEMA = "surrealdb-tpu-bench/14"
 
 D = 768
 NI = max(int(1_000_000 * SCALE), 1024)  # item corpus (configs 2/4/5)
@@ -257,6 +272,11 @@ def _acct_begin(ds) -> dict:
     from surrealdb_tpu import accounting
 
     accounting.reset()
+    # and for the advisor plane: proposals derived from a prior config's
+    # evidence must not leak into this window's line
+    from surrealdb_tpu import advisor
+
+    advisor.reset()
     return {
         "t0": time.time(),
         "stats": ds.dispatch.stats(),
@@ -876,6 +896,8 @@ def bench_knn(ds, s, corpus, rng):
     prof_overhead = _profiler_overhead(ds, s, queries[:8])
     log("knn: accounting overhead A/B (tenant meters on vs off)")
     acct_overhead = _accounting_overhead(ds, s, queries[:8])
+    log("knn: advisor overhead A/B (sweeps live vs parked)")
+    adv_overhead = _advisor_overhead(ds, s, queries[:8])
 
     vsb = conc_qps / cpu_ann_conc_qps if cpu_ann_conc_qps else None
     emit(
@@ -902,6 +924,7 @@ def bench_knn(ds, s, corpus, rng):
             "cpu_exact_qps": round(cpu_exact_qps, 3),
             "profiler_overhead": prof_overhead,
             "accounting_overhead": acct_overhead,
+            "advisor_overhead": adv_overhead,
         }
     )
     return vsb, conc_qps, recall
@@ -980,6 +1003,50 @@ def _accounting_overhead(ds, s, queries, rounds=3):
     }
 
 
+def _advisor_overhead(ds, s, queries, rounds=3):
+    """Measured cost of the advisor sweep service on the engine path
+    (schema/14; the <=3% contract scripts/bench_gate.py enforces, same
+    as the profiler and accounting planes): the SAME query battery timed
+    with the sweep loop live vs parked (advisor.pause()), in alternating
+    paired rounds with the paired-minimum estimator of
+    _profiler_overhead. The live rounds run at a deliberately hostile
+    0.25s sweep interval so the measurement actually overlaps sweeps —
+    the default 5s cadence could dodge a sub-second round entirely and
+    report a vacuous zero."""
+    from surrealdb_tpu import advisor
+    from surrealdb_tpu import cnf as _cnf
+
+    saved = _cnf.ADVISOR_INTERVAL_SECS
+    _cnf.ADVISOR_INTERVAL_SECS = 0.25
+    ratios = []
+    last_on = last_off = None
+    try:
+        for _ in range(max(rounds, 1)):
+            advisor.resume()
+            t0 = time.perf_counter()
+            for sql, v in queries:
+                run(ds, s, sql, v)
+            last_on = time.perf_counter() - t0
+            advisor.pause()
+            t0 = time.perf_counter()
+            for sql, v in queries:
+                run(ds, s, sql, v)
+            last_off = time.perf_counter() - t0
+            if last_off > 0:
+                ratios.append(last_on / last_off)
+    finally:
+        _cnf.ADVISOR_INTERVAL_SECS = saved
+        advisor.resume()
+    best = min(ratios) if ratios else 1.0
+    return {
+        "rounds": len(ratios),
+        "queries_per_round": len(queries),
+        "on_s": round(last_on, 4) if last_on is not None else None,
+        "off_s": round(last_off, 4) if last_off is not None else None,
+        "overhead_pct": round(max(best - 1.0, 0.0) * 100.0, 2),
+    }
+
+
 def _tenants_embed() -> dict:
     """The window's tenant cost-attribution snapshot for a config line
     (schema/13): per-(ns, db) meters plus the global conservation totals
@@ -993,6 +1060,143 @@ def _tenants_embed() -> dict:
         "count": snap["tenants"],
         "evicted": snap["evicted"],
     }
+
+
+def bench_advisor_shift(ds, s, rng):
+    """Config 12 (schema/14): the advisor plane under a SHIFTING workload.
+    Three phases over dedicated tables — scan-heavy (repeated filtered
+    ORDER/LIMIT scans over an unindexed predicate), point-lookup (record
+    fetches; the scan evidence is gone), vector-heavy (kNN against a
+    quantizer deliberately outgrown past needs_retrain's 1.5x ratio) —
+    with stats/accounting reset at each transition so a phase is one
+    observation window, and advisor sweeps driven EXPLICITLY (the
+    background loop is parked) so the proposal lifecycle in the artifact
+    is deterministic: `index.create` must appear in phase 1, expire
+    during phase 2 (three evidence-free sweeps = the default decay), and
+    `ivf.retrain` must hold in phase 3. Each phase snapshot embeds the
+    statements/tenants state its evidence chains resolve against —
+    scripts/check_bench_artifact.py resolves every pointer in-artifact."""
+    from surrealdb_tpu import accounting, advisor, cnf, stats
+
+    nrows = max(int(8_000 * SCALE), 1024)
+    nvec = max(int(4_096 * SCALE), 512)
+    d = 32
+    phases: list = []
+
+    def snap_phase(name):
+        snap = advisor.snapshot(limit=20)
+        phases.append({
+            "phase": name,
+            "proposals": snap["proposals"],
+            "expired_ids": [r["id"] for r in snap["expired"]],
+            "statements": stats.statements(limit=8),
+            "tenants": accounting.top(limit=8),
+            "sweep": snap["last_sweep"],
+        })
+
+    advisor.pause()
+    try:
+        # ---- phase 1: scan-heavy --------------------------------------
+        log(f"advisor: phase 1 scan-heavy ({nrows} rows)")
+        run(ds, s, "DEFINE TABLE advq SCHEMALESS")
+        B = 4000
+        for i in range(0, nrows, B):
+            rows = [
+                {"id": j, "val": int(j % 997), "grp": int(j % 13)}
+                for j in range(i, min(i + B, nrows))
+            ]
+            run(ds, s, "INSERT INTO advq $rows RETURN NONE", {"rows": rows})
+        scan_sql = (
+            "SELECT id, val FROM advq WHERE val > 500 ORDER BY val DESC LIMIT 10"
+        )
+        nscan = 24
+        t0 = time.perf_counter()
+        for _ in range(nscan):
+            run(ds, s, scan_sql)
+        scan_qps = nscan / (time.perf_counter() - t0)
+        advisor.sweep_once(ds)
+        snap_phase("scan_heavy")
+
+        # ---- phase 2: point-lookup ------------------------------------
+        log("advisor: phase 2 point-lookup (scan evidence decays)")
+        stats.reset()
+        accounting.reset()
+        nlook = 24
+        t0 = time.perf_counter()
+        for i in range(nlook):
+            run(ds, s, f"SELECT * FROM advq:{(i * 37) % nrows}")
+        lookup_qps = nlook / (time.perf_counter() - t0)
+        for _ in range(max(cnf.ADVISOR_EXPIRE_SWEEPS, 1)):
+            advisor.sweep_once(ds)
+        snap_phase("point_lookup")
+
+        # ---- phase 3: vector-heavy with a stale quantizer -------------
+        log(f"advisor: phase 3 vector-heavy ({nvec} x {d}, outgrown IVF)")
+        stats.reset()
+        accounting.reset()
+        saved_min = cnf.TPU_ANN_MIN_ROWS
+        cnf.TPU_ANN_MIN_ROWS = 256
+        try:
+            run(
+                ds, s,
+                "DEFINE TABLE advitem SCHEMALESS; "
+                f"DEFINE INDEX aemb ON advitem FIELDS emb HNSW "
+                f"DIMENSION {d} DIST EUCLIDEAN EFC 64",
+            )
+            vecs = rng.standard_normal((nvec, d)).astype(np.float32)
+            half = nvec // 2
+            run(
+                ds, s, "INSERT INTO advitem $rows RETURN NONE",
+                {"rows": vec_rows(vecs[:half], range(half))},
+            )
+            knn_sql = "SELECT id FROM advitem WHERE emb <|5,16|> $q"
+            # train the quantizer on the half corpus...
+            run(ds, s, knn_sql, {"q": vecs[0].tolist()})
+            m = ds.index_stores.get(s.ns, s.db, "advitem", "aemb")
+            if m is not None:
+                m.wait_ivf(120)
+            # ...run the timed kNN load while it is READY...
+            nknn = 12
+            t0 = time.perf_counter()
+            for i in range(nknn):
+                run(ds, s, knn_sql, {"q": vecs[i % nvec].tolist()})
+            knn_qps = nknn / (time.perf_counter() - t0)
+            # ...then DOUBLE the corpus: size/trained_n = 2.0 > the 1.5
+            # needs_retrain ratio — the stale state ivf.retrain cites.
+            # NO query runs between this insert and the sweep: a kNN on a
+            # stale quantizer would kick the self-retrain (ensure_ivf)
+            # and the sweep would observe 'training', not 'stale'
+            run(
+                ds, s, "INSERT INTO advitem $rows RETURN NONE",
+                {"rows": vec_rows(vecs[half:], range(half, nvec))},
+            )
+        finally:
+            cnf.TPU_ANN_MIN_ROWS = saved_min
+        advisor.sweep_once(ds)
+        snap_phase("vector_heavy")
+    finally:
+        advisor.resume()
+
+    kinds_seen = sorted({p["kind"] for ph in phases for p in ph["proposals"]})
+    snap = advisor.snapshot(limit=20)
+    emit(
+        {
+            "metric": f"advisor_shift_{nrows}r_{nvec}v",
+            "value": float(len(kinds_seen)),
+            "unit": "proposal-kinds",
+            "vs_baseline": None,
+            "scan_qps": round(scan_qps, 2),
+            "lookup_qps": round(lookup_qps, 2),
+            "knn_qps": round(knn_qps, 2),
+            "proposal_kinds": kinds_seen,
+            "advisor": {
+                "phases": phases,
+                "expired": snap["expired"],
+                "sweeps": snap["sweeps"],
+            },
+        }
+    )
+    return None
 
 
 def bench_bm25(ds, s, rng):
@@ -2232,6 +2436,8 @@ def main() -> None:
         run_cfg("10", lambda: bench_elastic(rng))
     if "11" in CONFIGS:
         run_cfg("11", lambda: bench_multi_tenant(rng))
+    if "12" in CONFIGS:
+        run_cfg("12", lambda: bench_advisor_shift(ds, s, rng))
     if "5" in CONFIGS:
         run_cfg("5", lambda: bench_ml_scan(ds, s, rng))
     if "6" in CONFIGS:

@@ -41,11 +41,23 @@ regressions beyond the threshold, and PLAN-MIX FLIPS — the dominant scan
 decision changing between runs (columnar-pipeline -> row after a mirror
 decline or a degraded-write stand-down), the regression EXPLAIN can't
 show because nobody re-ran EXPLAIN. Each flagged fingerprint prints its
-normalized SQL, both mix vectors, and the in-window flip log.
+normalized SQL, both mix vectors, and the in-window flip log. Since
+schema /14 each entry also carries the planner cost hook's accumulated
+chosen/declined margin: the diff prints the per-call margin both sides
+and flags a THINNING margin (the decision getting marginal is the
+leading indicator of the next plan-mix flip).
+
+`--advisor` compares the two runs' advisor-plane embeds (schema /14
+config-12 `advisor` objects): proposals that APPEARED (new advice this
+round), RESOLVED (advice whose evidence decayed away — taken or moot),
+and FLAPPED (expired then re-armed — oscillating evidence the operator
+should tune thresholds for, not act on). Severity escalations between
+rounds are flagged too.
 
 Also importable: `diff(old_art, new_art, threshold) -> list[dict]`,
 `diff_bundles(old_bundle, new_bundle) -> dict`,
 `diff_statements(old_art, new_art, threshold) -> list[dict]`,
+`diff_advisor(old_art, new_art) -> dict`,
 `diff_federated(old, new) -> dict` and `peer_drift(bundle) -> list[str]`.
 """
 
@@ -594,15 +606,29 @@ def diff_statements(
                 f"{ne.get('plan_flips') or 0} (flip_log: "
                 f"{json.dumps(ne.get('flip_log') or [])})"
             )
+        # planner cost-hook margin (schema /14): a thinning per-call margin
+        # between the chosen and declined strategies is the leading
+        # indicator of the next plan-mix flip — flag it before it happens
+        o_margin = ((oe.get("cost") or {}).get("margin_per_call"))
+        n_margin = ((ne.get("cost") or {}).get("margin_per_call"))
+        d_margin = _rel(o_margin, n_margin)
+        if d_margin is not None and d_margin < -threshold:
+            flags.append(
+                f"cost margin/call thinned {o_margin} -> {n_margin} "
+                f"row-visits ({d_margin * 100:+.0f}%) — the plan decision "
+                "is getting marginal"
+            )
         rows.append(
             {
                 "fingerprint": fp,
                 "sql": ne.get("sql"),
                 "config": ne.get("config"),
                 "old": {"qps": round(o_qps, 2), "p99_ms": oe.get("p99_ms"),
-                        "mix": oe.get("plan_mix"), "dominant": o_dom},
+                        "mix": oe.get("plan_mix"), "dominant": o_dom,
+                        "margin_per_call": o_margin},
                 "new": {"qps": round(n_qps, 2), "p99_ms": ne.get("p99_ms"),
-                        "mix": ne.get("plan_mix"), "dominant": n_dom},
+                        "mix": ne.get("plan_mix"), "dominant": n_dom,
+                        "margin_per_call": n_margin},
                 "flags": flags,
             }
         )
@@ -625,6 +651,13 @@ def _main_statements(old: dict, new: dict, threshold: float) -> int:
             f"{r['old']['qps']} -> {r['new']['qps']} qps, "
             f"p99 {r['old']['p99_ms']} -> {r['new']['p99_ms']} ms"
         )
+        if r["old"].get("margin_per_call") is not None or r["new"].get(
+            "margin_per_call"
+        ) is not None:
+            head += (
+                f", margin/call {r['old'].get('margin_per_call')} -> "
+                f"{r['new'].get('margin_per_call')}"
+            )
         print(("FLAG  " if r["flags"] else "ok    ") + head)
         if r["flags"]:
             print(f"      sql: {str(r['sql'])[:120]}")
@@ -636,6 +669,100 @@ def _main_statements(old: dict, new: dict, threshold: float) -> int:
         f"(threshold {threshold * 100:.0f}%)"
     )
     return 1 if flagged else 0
+
+
+# ------------------------------------------------------------------ advisor
+def _advisor_state(art: dict) -> dict:
+    """One artifact's advisor plane, collapsed to {live, expired}: `live`
+    keys every proposal id seen in any config-12 phase snapshot to its
+    LATEST record (the lifecycle's end state for the round), `expired`
+    the ids the round's decay ring recorded. A proposal present in both
+    flapped within the round."""
+    live: Dict[str, dict] = {}
+    expired: Dict[str, dict] = {}
+    for r in art.get("results") or []:
+        adv = r.get("advisor")
+        if not isinstance(adv, dict):
+            continue
+        for ph in adv.get("phases") or []:
+            for p in (ph or {}).get("proposals") or []:
+                if not isinstance(p, dict) or not p.get("id"):
+                    continue
+                cur = live.get(p["id"])
+                if cur is None or (p.get("last_seen_ts") or 0) >= (
+                    cur.get("last_seen_ts") or 0
+                ):
+                    live[p["id"]] = p
+        for p in adv.get("expired") or []:
+            if isinstance(p, dict) and p.get("id"):
+                expired[p["id"]] = p
+    # an id that expired and never re-armed is not live at round end
+    for pid in list(live):
+        if pid in expired and (
+            (expired[pid].get("last_seen_ts") or 0)
+            >= (live[pid].get("last_seen_ts") or 0)
+        ):
+            del live[pid]
+    return {"live": live, "expired": expired}
+
+
+def _brief(p: dict) -> str:
+    return f"{p.get('kind')} {p.get('subject')} [{p.get('severity')}]"
+
+
+def diff_advisor(old: dict, new: dict) -> dict:
+    """Round-over-round advisor drift: which advice appeared, which
+    resolved (evidence decayed — taken or moot), which flapped (expired
+    then re-armed inside the new round: oscillating evidence means tune
+    the thresholds, don't act), and which escalated in severity."""
+    o, n = _advisor_state(old), _advisor_state(new)
+    out: Dict[str, Any] = {
+        "appeared": [], "resolved": [], "flapped": [], "escalated": [],
+        "flags": [],
+    }
+    rank = {"info": 0, "warn": 1, "critical": 2}
+    for pid in sorted(set(n["live"]) - set(o["live"])):
+        out["appeared"].append(n["live"][pid])
+        out["flags"].append(f"appeared: {_brief(n['live'][pid])}")
+    for pid in sorted(set(o["live"]) - set(n["live"]) - set(n["expired"])):
+        out["resolved"].append(o["live"][pid])
+    for pid in sorted(set(o["live"]) & set(n["expired"])):
+        out["resolved"].append(o["live"][pid])
+    for pid in sorted(set(n["live"]) & set(n["expired"])):
+        out["flapped"].append(n["live"][pid])
+        out["flags"].append(
+            f"flapped: {_brief(n['live'][pid])} — expired then re-armed "
+            "within the round (oscillating evidence; tune thresholds)"
+        )
+    for pid in sorted(set(o["live"]) & set(n["live"])):
+        op, np_ = o["live"][pid], n["live"][pid]
+        if rank.get(np_.get("severity"), 0) > rank.get(op.get("severity"), 0):
+            out["escalated"].append(np_)
+            out["flags"].append(
+                f"escalated: {_brief(np_)} (was {op.get('severity')})"
+            )
+    return out
+
+
+def _main_advisor(old: dict, new: dict) -> int:
+    if not any(
+        isinstance(r.get("advisor"), dict) for r in new.get("results") or []
+    ):
+        print(
+            "no advisor embeds in the new artifact "
+            "(schema /14 config-12 required)",
+            file=sys.stderr,
+        )
+        return 2
+    rep = diff_advisor(old, new)
+    for label in ("appeared", "resolved", "flapped", "escalated"):
+        for p in rep[label]:
+            print(f"{label:<9} {_brief(p)}  id={p.get('id')}")
+    print(
+        f"{len(rep['appeared'])} appeared, {len(rep['resolved'])} resolved, "
+        f"{len(rep['flapped'])} flapped, {len(rep['escalated'])} escalated"
+    )
+    return 1 if rep["flags"] else 0
 
 
 # ------------------------------------------------------------------ tenants
@@ -809,6 +936,12 @@ def main(argv: List[str]) -> int:
         "(schema /13): exec-share shifts, per-meter regressions and new "
         "budget breaches, named per (ns, db)",
     )
+    ap.add_argument(
+        "--advisor", action="store_true",
+        help="diff the two runs' advisor-plane embeds (schema /14): "
+        "proposals appeared / resolved / flapped / escalated between "
+        "rounds",
+    )
     try:
         ns = ap.parse_args(argv)
     except SystemExit:
@@ -828,6 +961,8 @@ def main(argv: List[str]) -> int:
         return _main_statements(old, new, threshold)
     if ns.tenants:
         return _main_tenants(old, new, threshold)
+    if ns.advisor:
+        return _main_advisor(old, new)
     rows = diff(old, new, threshold)
     if not rows:
         print("no comparable configs between the two artifacts", file=sys.stderr)

@@ -43,6 +43,9 @@ Env knobs:
     SURREAL_BENCH_GATE_PROFILER_OVERHEAD  sampling-profiler overhead ceiling
                                    in percent on the config-2 engine path
                                    (default 3.0 — the always-on contract)
+    SURREAL_BENCH_GATE_ADVISOR_OVERHEAD  advisor-sweep overhead ceiling in
+                                   percent on the config-2 engine path
+                                   (default 3.0 — same contract)
     SURREAL_BENCH_GATE_TIMEOUT     whole-run timeout seconds (default 1200)
 
 Exit code 0 = gate passed; 1 = gate failed (reasons on stderr).
@@ -88,6 +91,14 @@ PROFILER_OVERHEAD_CEILING = float(
 # estimator, see bench.py _accounting_overhead)
 ACCOUNTING_OVERHEAD_CEILING = float(
     os.environ.get("SURREAL_BENCH_GATE_ACCOUNTING_OVERHEAD", "3.0")
+)
+# advisor plane (schema/14): the sweep service's measured overhead on the
+# config-2 engine path must stay under this ceiling (percent; the ISSUE
+# 17 <=3% contract — same paired-minimum estimator, measured at a
+# deliberately hostile 0.25s sweep interval, see bench.py
+# _advisor_overhead)
+ADVISOR_OVERHEAD_CEILING = float(
+    os.environ.get("SURREAL_BENCH_GATE_ADVISOR_OVERHEAD", "3.0")
 )
 TIMEOUT = int(os.environ.get("SURREAL_BENCH_GATE_TIMEOUT", "1200"))
 
@@ -174,6 +185,15 @@ def main() -> int:
         failures.append(
             f"tenant-accounting overhead {acct_overhead}% > ceiling "
             f"{ACCOUNTING_OVERHEAD_CEILING}% (the always-on contract)"
+        )
+    vo = line.get("advisor_overhead") or {}
+    adv_overhead = vo.get("overhead_pct")
+    if adv_overhead is None:
+        failures.append("config 2 carries no advisor_overhead measurement")
+    elif adv_overhead > ADVISOR_OVERHEAD_CEILING:
+        failures.append(
+            f"advisor-sweep overhead {adv_overhead}% > ceiling "
+            f"{ADVISOR_OVERHEAD_CEILING}% (the always-on contract)"
         )
     # the statistics plane must have SEEN the window: a /12 artifact whose
     # config-2 line recorded no fingerprints means recording is broken
@@ -383,6 +403,7 @@ def main() -> int:
     summary = {
         "qps": qps,
         "profiler_overhead_pct": overhead,
+        "advisor_overhead_pct": adv_overhead,
         "recall_at_10": recall,
         "latency_ms": line.get("latency_ms"),
         "errors": errs,

@@ -23,7 +23,7 @@ import os
 import sys
 from typing import List
 
-SCHEMA = "surrealdb-tpu-bench/13"
+SCHEMA = "surrealdb-tpu-bench/14"
 # earlier rounds' committed artifacts stay validatable under their own rules
 KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/1",
@@ -38,6 +38,7 @@ KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/10",
     "surrealdb-tpu-bench/11",
     "surrealdb-tpu-bench/12",
+    "surrealdb-tpu-bench/13",
     SCHEMA,
 )
 
@@ -134,6 +135,26 @@ TENANT_PLANE_KEYS = (
 # conservation deviations the config-11 line must stay under (percent)
 TENANT_CONSERVATION_PCT = 1.0
 TENANT_ABUSIVE_SHARE = 0.9
+# schema/14 (advisor plane): the config-12 shifting-workload line must
+# carry the full observe->propose lifecycle — a non-empty `advisor`
+# object whose per-phase snapshots prove index.create appeared under the
+# scan-heavy window, EXPIRED once the workload shifted away, and
+# ivf.retrain held against the outgrown quantizer. Every evidence entry
+# must name a known plane with numeric value/threshold, and every
+# still-armed (miss_count == 0) proposal's fingerprint/tenant pointers
+# must resolve inside the SAME phase's statements/tenants embeds — an
+# evidence chain the artifact cannot replay is invalid, not advisory.
+# The config-2 line must carry the advisor-sweep overhead A/B; /14
+# bundles (bundle/8) must carry the `advisor` section.
+ADVISOR_PHASE_KEYS = (
+    "phase", "proposals", "expired_ids", "statements", "tenants", "sweep",
+)
+ADVISOR_PROPOSAL_KEYS = (
+    "id", "kind", "subject", "severity", "created_hlc", "evidence",
+    "armed", "miss_count",
+)
+ADVISOR_EVIDENCE_KEYS = ("plane", "metric", "window", "value", "threshold")
+ADVISOR_EVIDENCE_PLANES = ("stats", "accounting", "telemetry", "idx", "cluster")
 COMPILES_KEYS = ("on_demand", "prewarm", "events")
 BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
 BATCH_KEYS_V3 = BATCH_KEYS + ("width_dist", "pipeline_wait_s")
@@ -289,6 +310,149 @@ def _check_tenant_plane(where: str, metric: str, r: dict) -> List[str]:
     return problems
 
 
+def _check_advisor_plane(where: str, metric: str, r: dict) -> List[str]:
+    """The config-12 lifecycle contract (schema/14): the shifting workload
+    must make the advisor PROPOSE (index.create under scan pressure,
+    ivf.retrain against the stale quantizer), make stale advice EXPIRE,
+    and every live proposal's evidence must resolve against the embeds
+    captured in the same phase — the artifact replays the whole chain."""
+    problems: List[str] = []
+    adv = r.get("advisor")
+    if not isinstance(adv, dict) or not adv.get("phases"):
+        return [
+            f"{where} ({metric}): config-12 must carry a non-empty "
+            "'advisor' object with its per-phase lifecycle snapshots"
+        ]
+    phases = adv.get("phases")
+    if not isinstance(phases, list):
+        return [f"{where} ({metric}): advisor.phases must be a list"]
+    by_name: dict = {}
+    for j, ph in enumerate(phases):
+        pwhere = f"{where} ({metric}): advisor.phases[{j}]"
+        if not isinstance(ph, dict):
+            problems.append(f"{pwhere} is not an object")
+            continue
+        for key in ADVISOR_PHASE_KEYS:
+            if key not in ph:
+                problems.append(f"{pwhere} missing {key!r}")
+        by_name[str(ph.get("phase"))] = ph
+        fps_avail = {
+            e.get("fingerprint")
+            for e in (ph.get("statements") or [])
+            if isinstance(e, dict)
+        }
+        tenants_avail = {
+            (t.get("ns"), t.get("db"))
+            for t in (ph.get("tenants") or [])
+            if isinstance(t, dict)
+        }
+        for k, p in enumerate(ph.get("proposals") or []):
+            if not isinstance(p, dict):
+                problems.append(f"{pwhere}.proposals[{k}] is not an object")
+                continue
+            pid = p.get("id") or f"#{k}"
+            for key in ADVISOR_PROPOSAL_KEYS:
+                if key not in p:
+                    problems.append(
+                        f"{pwhere} proposal {pid}: missing {key!r}"
+                    )
+            ev = p.get("evidence")
+            if not isinstance(ev, list) or not ev:
+                problems.append(
+                    f"{pwhere} proposal {pid}: carries no evidence chain — "
+                    "advice without evidence is invalid by construction"
+                )
+                ev = []
+            for m, e in enumerate(ev):
+                if not isinstance(e, dict):
+                    problems.append(
+                        f"{pwhere} proposal {pid}: evidence[{m}] not an object"
+                    )
+                    continue
+                for key in ADVISOR_EVIDENCE_KEYS:
+                    if key not in e:
+                        problems.append(
+                            f"{pwhere} proposal {pid}: evidence[{m}] "
+                            f"missing {key!r}"
+                        )
+                if e.get("plane") not in ADVISOR_EVIDENCE_PLANES:
+                    problems.append(
+                        f"{pwhere} proposal {pid}: evidence[{m}] cites "
+                        f"unknown plane {e.get('plane')!r}"
+                    )
+                if not str(e.get("metric") or ""):
+                    problems.append(
+                        f"{pwhere} proposal {pid}: evidence[{m}] has an "
+                        "empty metric name"
+                    )
+                for key in ("value", "threshold"):
+                    if key in e and not isinstance(
+                        e.get(key), (int, float)
+                    ):
+                        problems.append(
+                            f"{pwhere} proposal {pid}: evidence[{m}].{key} "
+                            f"must be numeric (got {e.get(key)!r})"
+                        )
+            # in-artifact resolution: a proposal whose evidence was seen by
+            # THIS phase's sweep (miss_count == 0) must point at entries the
+            # same snapshot carries; decaying proposals cite a previous
+            # window by design and are exempt
+            if p.get("miss_count") == 0:
+                for fp in p.get("fingerprints") or []:
+                    if fp not in fps_avail:
+                        problems.append(
+                            f"{pwhere} proposal {pid}: cited fingerprint "
+                            f"{fp!r} does not resolve in the phase's "
+                            "statements embed"
+                        )
+                ten = p.get("tenant")
+                if ten is not None and tuple(ten) not in tenants_avail:
+                    problems.append(
+                        f"{pwhere} proposal {pid}: cited tenant {ten!r} "
+                        "does not resolve in the phase's tenants embed"
+                    )
+    p1 = by_name.get("scan_heavy")
+    p3 = by_name.get("vector_heavy")
+    if p1 is None or p3 is None or "point_lookup" not in by_name:
+        problems.append(
+            f"{where} ({metric}): advisor.phases must record the "
+            "scan_heavy, point_lookup and vector_heavy windows"
+        )
+        return problems
+    idx_ids = [
+        p.get("id")
+        for p in (p1.get("proposals") or [])
+        if isinstance(p, dict) and p.get("kind") == "index.create"
+    ]
+    if not idx_ids:
+        problems.append(
+            f"{where} ({metric}): phase scan_heavy produced no "
+            "index.create proposal — the scan pressure never became advice"
+        )
+    expired3 = set(p3.get("expired_ids") or [])
+    live3 = {
+        p.get("id") for p in (p3.get("proposals") or []) if isinstance(p, dict)
+    }
+    lingering = [
+        pid for pid in idx_ids if pid in live3 or pid not in expired3
+    ]
+    if idx_ids and lingering:
+        problems.append(
+            f"{where} ({metric}): index.create proposal(s) {lingering} "
+            "never expired after the workload shifted away — decay is "
+            "half of the lifecycle contract"
+        )
+    if not any(
+        isinstance(p, dict) and p.get("kind") == "ivf.retrain"
+        for p in (p3.get("proposals") or [])
+    ):
+        problems.append(
+            f"{where} ({metric}): phase vector_heavy carries no "
+            "ivf.retrain proposal — the outgrown quantizer went unnoticed"
+        )
+    return problems
+
+
 def validate(path: str) -> List[str]:
     problems: List[str] = []
     try:
@@ -302,7 +466,8 @@ def validate(path: str) -> List[str]:
     if art.get("schema") not in KNOWN_SCHEMAS:
         problems.append(f"schema is {art.get('schema')!r}, expected one of {KNOWN_SCHEMAS}")
     schema = art.get("schema")
-    v13 = schema == SCHEMA
+    v14 = schema == SCHEMA
+    v13 = v14 or schema == "surrealdb-tpu-bench/13"
     v12 = v13 or schema == "surrealdb-tpu-bench/12"
     v11 = v12 or schema == "surrealdb-tpu-bench/11"
     v10 = v11 or schema == "surrealdb-tpu-bench/10"
@@ -330,7 +495,10 @@ def validate(path: str) -> List[str]:
             problems.append("schema/5 artifact missing the embedded debug bundle")
         else:
             sections = (
-                BUNDLE_SECTIONS_V9 + ("statements", "profiler", "tenants")
+                BUNDLE_SECTIONS_V9
+                + ("statements", "profiler", "tenants", "advisor")
+                if v14
+                else BUNDLE_SECTIONS_V9 + ("statements", "profiler", "tenants")
                 if v13
                 else BUNDLE_SECTIONS_V9 + ("statements", "profiler")
                 if v12
@@ -698,6 +866,21 @@ def validate(path: str) -> List[str]:
                         )
         if v13 and str(r.get("config")) == "11" and metric.startswith("multi_tenant"):
             problems.extend(_check_tenant_plane(where, metric, r))
+        if v14 and str(r.get("config")) == "2" and metric.startswith("knn_qps"):
+            vo = r.get("advisor_overhead")
+            if not isinstance(vo, dict):
+                problems.append(
+                    f"{where} ({metric}): schema/14 config-2 must carry the "
+                    "'advisor_overhead' A/B object"
+                )
+            else:
+                for key in PROFILER_OVERHEAD_KEYS:
+                    if key not in vo:
+                        problems.append(
+                            f"{where} ({metric}): advisor_overhead missing {key!r}"
+                        )
+        if v14 and str(r.get("config")) == "12" and metric.startswith("advisor_shift"):
+            problems.extend(_check_advisor_plane(where, metric, r))
         if v4 and metric.startswith("filtered_scan"):
             for key in FILTERED_SCAN_KEYS:
                 if key not in r:

@@ -57,6 +57,10 @@ versioned document — the artifact you attach to any perf report:
                      time, rows and bytes, bg-task and scatter cost —
                      with global conservation totals, store size and
                      eviction count (new in bundle/7).
+15. `advisor`      — the advisor plane (advisor.py): live evidence-
+                     chained tuning proposals (observe-only), the
+                     proposal-kind catalog, the expired ring and sweep
+                     health (new in bundle/8).
 
 Served by `GET /debug/bundle` (system-user-gated) and embedded via
 `INFO FOR ROOT` (`system.bundle`); bench.py embeds one per artifact so a
@@ -76,13 +80,13 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-BUNDLE_SCHEMA = "surrealdb-tpu-bundle/7"
+BUNDLE_SCHEMA = "surrealdb-tpu-bundle/8"
 
 # the sections every consumer may rely on
 SECTIONS = (
     "traces", "slow_queries", "errors", "tasks", "compiles", "engine",
     "locks", "faults", "events", "kernel_audit", "flow_audit",
-    "statements", "profiler", "tenants",
+    "statements", "profiler", "tenants", "advisor",
 )
 
 
@@ -90,8 +94,8 @@ def debug_bundle(
     ds=None, trace_limit: int = 50, full_traces: int = 10
 ) -> Dict[str, Any]:
     from surrealdb_tpu import (
-        accounting, bg, compile_log, events, faults, profiler, stats,
-        telemetry, tracing,
+        accounting, advisor, bg, compile_log, events, faults, profiler,
+        stats, telemetry, tracing,
     )
     from surrealdb_tpu.utils import locks
 
@@ -123,6 +127,7 @@ def debug_bundle(
         "statements": stats.snapshot(),
         "profiler": profiler.report(),
         "tenants": accounting.snapshot(),
+        "advisor": advisor.snapshot(),
     }
     return out
 

@@ -134,14 +134,17 @@ def federated_statements(
     stats merged into one list, each entry tagged `node=<id>` (the /events
     merge shape), ordered by cumulative time (or the same `sort` keys the
     single-node view takes) — the cluster-wide answer to "which query
-    shapes are eating the cluster". Dead members are simply absent;
-    per-member entries stay separate (merging two nodes' latency
-    histograms would fabricate a cluster-wide quantile nobody measured)."""
+    shapes are eating the cluster". Per-member entries stay separate
+    (merging two nodes' latency histograms would fabricate a cluster-wide
+    quantile nobody measured). Dead members are MARKED unreachable (the
+    /metrics contract: the caller sees "this view is partial", never a
+    silent absence) — markers ride after the limit slice so they always
+    survive."""
     key = sort if sort in ("total_s", "calls", "errors", "max_ms") else "total_s"
     req: Dict[str, Any] = {"limit": limit, "sort": key}
     if fingerprint:
         req["fingerprint"] = fingerprint
-    gathered, _ = _gather(ds, "statements", req)
+    gathered, errors = _gather(ds, "statements", req)
     merged = []
     for nid, entries in gathered.items():
         if not isinstance(entries, list):
@@ -150,7 +153,9 @@ def federated_statements(
             if isinstance(e, dict):
                 merged.append(dict(e, node=nid))
     merged.sort(key=lambda e: (-(e.get(key) or 0), str(e.get("node"))))
-    return merged[: max(int(limit), 1)]
+    merged = merged[: max(int(limit), 1)]
+    merged.extend(_unreachable_markers(gathered, errors))
+    return merged
 
 
 def federated_tenants(ds, limit: int = 50, sort: str = "exec_s") -> list:
@@ -160,11 +165,12 @@ def federated_tenants(ds, limit: int = 50, sort: str = "exec_s") -> list:
     which nodes". Per-member entries stay separate rather than summed:
     a tenant hot on one node and idle elsewhere is the exact signal a
     merged total would erase (skewed placement vs genuinely heavy load).
-    Dead members are simply absent, like every federation surface."""
+    Dead members are MARKED unreachable (the /metrics contract), after
+    the limit slice so the markers always survive."""
     from surrealdb_tpu import accounting
 
     key = sort if sort in accounting.METERS else "exec_s"
-    gathered, _ = _gather(ds, "tenants", {"limit": limit, "sort": key})
+    gathered, errors = _gather(ds, "tenants", {"limit": limit, "sort": key})
     merged = []
     for nid, entries in gathered.items():
         if not isinstance(entries, list):
@@ -173,7 +179,56 @@ def federated_tenants(ds, limit: int = 50, sort: str = "exec_s") -> list:
             if isinstance(e, dict):
                 merged.append(dict(e, node=nid))
     merged.sort(key=lambda e: (-(e.get(key) or 0), str(e.get("node"))))
-    return merged[: max(int(limit), 1)]
+    merged = merged[: max(int(limit), 1)]
+    merged.extend(_unreachable_markers(gathered, errors))
+    return merged
+
+
+def _unreachable_markers(gathered: Dict[str, Any], errors: Dict[str, str]) -> list:
+    """One `{node, unreachable, error}` marker per member that produced
+    no payload — the list-shaped twin of federated_bundle's per-node
+    marker, shared by /statements, /tenants and /advisor."""
+    return [
+        {"node": nid, "unreachable": True,
+         "error": errors.get(nid, "no payload")}
+        for nid, payload in gathered.items()
+        if payload is None
+    ]
+
+
+def federated_advisor(ds, limit: int = 50) -> dict:
+    """`GET /advisor?cluster=1`: every member's live proposals, DEDUPED
+    by stable proposal id — the id is a digest of (kind, subject), so the
+    same condition observed from two nodes is ONE record tagged
+    `nodes=[...]` (evidence kept from the most-recently-seen reporter;
+    two nodes' evidence chains cite the same planes but each node's own
+    measurements, and fabricating a merged value would break the
+    resolve-in-artifact contract). Dead members are marked unreachable."""
+    gathered, errors = _gather(ds, "advisor", {"limit": limit})
+    by_id: Dict[str, dict] = {}
+    for nid in sorted(gathered.keys()):
+        entries = gathered[nid]
+        if not isinstance(entries, list):
+            continue
+        for e in entries:
+            if not isinstance(e, dict) or not e.get("id"):
+                continue
+            cur = by_id.get(e["id"])
+            if cur is None:
+                by_id[e["id"]] = dict(e, nodes=[nid])
+            else:
+                cur["nodes"].append(nid)
+                if (e.get("last_seen_ts") or 0) > (cur.get("last_seen_ts") or 0):
+                    nodes = cur["nodes"]
+                    by_id[e["id"]] = dict(e, nodes=nodes)
+    merged = sorted(
+        by_id.values(),
+        key=lambda r: (-(r.get("last_seen_ts") or 0), r["id"]),
+    )[: max(int(limit), 1)]
+    return {
+        "proposals": merged,
+        "unreachable": _unreachable_markers(gathered, errors),
+    }
 
 
 def federated_events(

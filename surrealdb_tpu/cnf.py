@@ -332,6 +332,29 @@ TENANT_BUDGET_DISPATCH_S = os.environ.get("SURREAL_TENANT_BUDGET_DISPATCH_S", ""
 TENANT_BUDGET_ROWS = os.environ.get("SURREAL_TENANT_BUDGET_ROWS", "")
 TENANT_BUDGET_BYTES = os.environ.get("SURREAL_TENANT_BUDGET_BYTES", "")
 
+# Advisor plane (advisor.py): the observe->propose half of a self-driving
+# engine. A supervised `bg:advisor` sweep re-derives evidence-chained
+# tuning proposals every ADVISOR_INTERVAL secs from the stats/accounting/
+# telemetry/vector/cluster planes — OBSERVE-ONLY, nothing is applied. A
+# proposal re-arms while its evidence persists and expires after
+# ADVISOR_EXPIRE_SWEEPS consecutive sweeps without it. The analyzer
+# thresholds: MIN_CALLS gates every per-fingerprint rule, SCAN_ROWS is
+# the per-call scanned-rows break-even floor for index.create,
+# DECLINE_MIN the per-sweep mirror-decline drift floor, SKEW_RATIO the
+# max/mean per-node scatter skew for cluster.rebalance, BREACH_MIN the
+# budget-breach recurrence floor. Measured sweep overhead on bench
+# config 2 must stay <=3% (scripts/bench_gate.py, same gate as the
+# profiler and accounting planes).
+ADVISOR = _env_bool("SURREAL_ADVISOR", True)
+ADVISOR_INTERVAL_SECS = _env_float("SURREAL_ADVISOR_INTERVAL", 5.0)
+ADVISOR_STORE_SIZE = _env_int("SURREAL_ADVISOR_STORE_SIZE", 128)
+ADVISOR_EXPIRE_SWEEPS = _env_int("SURREAL_ADVISOR_EXPIRE_SWEEPS", 3)
+ADVISOR_MIN_CALLS = _env_int("SURREAL_ADVISOR_MIN_CALLS", 8)
+ADVISOR_SCAN_ROWS = _env_int("SURREAL_ADVISOR_SCAN_ROWS", 512)
+ADVISOR_DECLINE_MIN = _env_int("SURREAL_ADVISOR_DECLINE_MIN", 32)
+ADVISOR_SKEW_RATIO = _env_float("SURREAL_ADVISOR_SKEW_RATIO", 3.0)
+ADVISOR_BREACH_MIN = _env_int("SURREAL_ADVISOR_BREACH_MIN", 3)
+
 # Flight recorder (bg.py + compile_log.py): background-task registry with
 # a watchdog that flips tasks to `stalled` past a per-kind deadline, and a
 # bounded XLA compile-event log (prewarm vs on-demand attribution).

@@ -135,6 +135,7 @@ HTTP_ROUTES = frozenset(
         "export", "import", "rpc", "version", "sql", "signin", "signup", "key",
         "ml", "graphql", "health", "sync", "status", "metrics", "slow",
         "trace", "traces", "debug", "cluster", "events", "statements", "tenants",
+        "advisor",
     }
 )
 

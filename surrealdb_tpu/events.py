@@ -62,6 +62,9 @@ KINDS: Dict[str, str] = {
     "stats.plan_flip": "a statement fingerprint's primary plan decision flipped",
     # tenant accounting plane
     "tenant.budget_exceeded": "a tenant crossed a soft budget limit (observe-only)",
+    # advisor plane (observe->propose; nothing is ever applied)
+    "advisor.proposal": "the advisor registered a new evidence-chained proposal",
+    "advisor.expired": "an advisor proposal's evidence decayed and it expired",
     # failpoints / chaos
     "fault.trip": "an armed failpoint site fired",
     # background machinery

@@ -355,6 +355,12 @@ class Datastore:
         from surrealdb_tpu import profiler as _profiler
 
         _profiler.ensure_started()
+        # advisor plane (advisor.py): observe->propose sweeps over this
+        # instance's planes; same one-shot process-global service shape
+        # (SURREAL_ADVISOR=0 keeps it off), later instances just register
+        from surrealdb_tpu import advisor as _advisor
+
+        _advisor.ensure_started(self)
         # cluster mode (surrealdb_tpu/cluster/): when attach()ed, execute()
         # routes through the distributed scatter/gather executor; the
         # internal /cluster channel and the executor's own sub-queries run

@@ -320,15 +320,32 @@ def choose_strategy(mirror, n_rows: int, shape: str) -> Tuple[str, dict]:
         "mirrored": mirror is not None,
         "min_rows": cnf.COLUMN_MIRROR_MIN_ROWS,
     }
+    # modeled per-call costs in row-visit units: the row path touches
+    # every row; the columnar path amortizes to a fraction of a visit per
+    # row but pays a fixed vectorized-dispatch overhead. Both estimates
+    # ride the note — the DECLINED option's cost alongside the chosen
+    # one — so the stats store can accumulate the margin per fingerprint
+    # and the advisor's break-even math gets the delta, not just the
+    # decision.
+    row_cost = float(n_rows)
+    col_cost = float(n_rows) * 0.25 + 64.0
     if n_rows < cnf.COLUMN_MIRROR_MIN_ROWS and mirror is None:
         note["decision"] = "row"
         note["why"] = "below mirror floor"
+        note["est_cost"] = {
+            "unit": "row-visits", "chosen": row_cost, "declined": col_cost,
+            "declined_option": "columnar", "margin": col_cost - row_cost,
+        }
         return "row", note
     if cnf.COLUMN_DEVICE:
         # a chip-backed mask/sort kernel would slot in here; today the
         # columnar host path is the proven fastest option on every target
         note["device"] = "declined: host columnar path (no measured win)"
     note["decision"] = "columnar"
+    note["est_cost"] = {
+        "unit": "row-visits", "chosen": col_cost, "declined": row_cost,
+        "declined_option": "row", "margin": row_cost - col_cost,
+    }
     return "columnar", note
 
 

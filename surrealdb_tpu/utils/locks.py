@@ -114,6 +114,13 @@ HIERARCHY: Dict[str, int] = {
                                # releases; breach events/counters emit
                                # AFTER release (events/telemetry are
                                # LOWER levels and must never nest inside)
+    "advisor.store": 85,       # advisor proposal store (advisor.py):
+                               # leaf-style — propose() mutates and
+                               # releases; proposal/expired events and
+                               # counters emit AFTER release, and sweeps
+                               # snapshot the stats/accounting planes
+                               # BEFORE touching this lock (same-level
+                               # leaves never nest)
     "telemetry.registry": 86,  # metrics registry (the hottest leaf)
 }
 

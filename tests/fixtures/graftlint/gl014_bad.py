@@ -1,0 +1,42 @@
+"""GL014 fixture: ad-hoc advisor-proposal construction."""
+
+import surrealdb_tpu.advisor
+import surrealdb_tpu.advisor as adv
+from surrealdb_tpu import advisor
+from surrealdb_tpu.advisor import propose as _propose
+
+
+def sneak_record(kind: str):
+    # reaching into the private store bypasses propose()'s stable-id
+    # lifecycle, the kind/evidence validation and the lock discipline
+    with advisor._lock:
+        advisor._store["deadbeef"] = {"id": "deadbeef", "kind": kind}
+        advisor._evicted += 1
+    advisor._expired_ring.clear()
+
+
+def sneak_dynamic_kind(kind: str):
+    # a dynamic kind dodges the closed registry
+    advisor.propose(kind, "t", evidence=[{"plane": "stats", "metric": "m"}])
+
+
+def sneak_unregistered_kind():
+    advisor.propose(
+        "fixture.made_up_kind", "t",
+        evidence=[{"plane": "stats", "metric": "m"}],
+    )
+
+
+def sneak_no_evidence():
+    # an evidence-free proposal is an opinion
+    adv.propose("index.create", "t")
+
+
+def sneak_empty_evidence():
+    # aliased direct import must not dodge the rule either
+    _propose("index.create", "t", evidence=[])
+
+
+def sneak_dotted():
+    # the plain-import dotted path must not dodge the rule either
+    return surrealdb_tpu.advisor._store

@@ -1,0 +1,38 @@
+"""GL014 clean twin: advisor proposals through the public doors only."""
+
+from surrealdb_tpu import advisor
+
+
+def propose_index(fp: str, calls: int):
+    return advisor.propose(
+        "index.create", f"person:{fp}",
+        evidence=[
+            {"plane": "stats", "metric": "calls", "window": "cumulative",
+             "value": calls, "threshold": 8},
+        ],
+        estimated_benefit={"unit": "row-visits", "value": 1024.0},
+        fingerprints=(fp,),
+    )
+
+
+def propose_quota(ns: str, db: str, breaches: int):
+    # keyword-form kind is fine as long as it is static and registered
+    return advisor.propose(
+        kind="tenant.quota_review", subject=f"{ns}.{db}",
+        evidence=[
+            {"plane": "accounting", "metric": "breaches.total",
+             "window": "cumulative", "value": breaches, "threshold": 3},
+        ],
+        tenant=(ns, db),
+    )
+
+
+def read_views():
+    # read surfaces are public API, not store pokes
+    return (
+        advisor.proposals(limit=5),
+        advisor.get("0" * 16),
+        advisor.size(),
+        advisor.snapshot(),
+        advisor.export_state(),
+    )
