@@ -157,7 +157,18 @@ PROFILE = "--profile" in sys.argv[1:] or os.environ.get("SURREAL_PROFILE") == "1
 # and accounting planes. The embedded bundle is surrealdb-tpu-bundle/8
 # (section 15 `advisor`), and `bench_diff --advisor` names proposals
 # that appeared/resolved/flapped between two artifacts.
-SCHEMA = "surrealdb-tpu-bench/14"
+# schema/15 (r19, plan cache): every config line carries a `plan_cache`
+# object — the fingerprint-keyed plan-cache window stats (hit/miss/route
+# counters, invalidation causes, verify outcomes, per-fingerprint
+# pre-kernel parse+plan averages warm vs cold) — because _acct_begin now
+# resets the cache's measurement window alongside the other planes. The
+# config-2/6/9 lines add `plan_cache_parity`: the SAME query battery run
+# cold (cache cleared) then warm (every shape installed), transcripts
+# byte-compared (`parity` must be true — 0 stale serves, measured not
+# assumed) with the warm hit rate and the cold-vs-warm pre-kernel split
+# whose >=2x floor scripts/bench_gate.py enforces on config 2. The
+# embedded bundle is surrealdb-tpu-bundle/9 (section 16 `plan_cache`).
+SCHEMA = "surrealdb-tpu-bench/15"
 
 D = 768
 NI = max(int(1_000_000 * SCALE), 1024)  # item corpus (configs 2/4/5)
@@ -277,6 +288,10 @@ def _acct_begin(ds) -> dict:
     from surrealdb_tpu import advisor
 
     advisor.reset()
+    # and for the plan cache: zero the window counters/timing but KEEP
+    # the installed entries — a config window measures its own hit rate
+    # and pre-kernel split without forgetting shapes earlier configs warmed
+    ds.plan_cache.reset_window()
     return {
         "t0": time.time(),
         "stats": ds.dispatch.stats(),
@@ -368,6 +383,9 @@ def _acct_delta(ds, before: dict) -> dict:
         # tenant cost-attribution plane (schema/13): this window's
         # per-(ns, db) meters + the conservation totals they must sum to
         "tenants": _tenants_embed(),
+        # plan-cache plane (schema/15): this window's hit/miss/verify
+        # counters + per-fingerprint pre-kernel averages (warm vs cold)
+        "plan_cache": ds.plan_cache.window_stats(),
         "bg_tasks": {
             "kinds": kinds,
             "tasks": [
@@ -898,6 +916,8 @@ def bench_knn(ds, s, corpus, rng):
     acct_overhead = _accounting_overhead(ds, s, queries[:8])
     log("knn: advisor overhead A/B (sweeps live vs parked)")
     adv_overhead = _advisor_overhead(ds, s, queries[:8])
+    log("knn: plan-cache parity (cold vs warm byte-compare)")
+    pc_parity = _plan_cache_parity(ds, s, queries[:8])
 
     vsb = conc_qps / cpu_ann_conc_qps if cpu_ann_conc_qps else None
     emit(
@@ -925,8 +945,10 @@ def bench_knn(ds, s, corpus, rng):
             "profiler_overhead": prof_overhead,
             "accounting_overhead": acct_overhead,
             "advisor_overhead": adv_overhead,
+            "plan_cache_parity": pc_parity,
         }
     )
+    assert pc_parity["parity"], "plan-cache warm serve diverged from cold parse"
     return vsb, conc_qps, recall
 
 
@@ -1044,6 +1066,70 @@ def _advisor_overhead(ds, s, queries, rounds=3):
         "on_s": round(last_on, 4) if last_on is not None else None,
         "off_s": round(last_off, 4) if last_off is not None else None,
         "overhead_pct": round(max(best - 1.0, 0.0) * 100.0, 2),
+    }
+
+
+def _plan_cache_parity(ds, s, queries, repeats=3):
+    """Schema/15 proof object for the fingerprint-keyed plan cache
+    (dbs/plan_cache.py): the SAME query battery run cold (cache cleared,
+    transcripts captured as the reference) then warmed (`repeats` extra
+    passes install every shape past PLAN_CACHE_MIN_HITS) then re-run in
+    a fresh measurement window, with every warm transcript byte-compared
+    against its cold twin. `parity` is the cache's correctness contract
+    MEASURED — a single stale serve flips it false and fails the
+    validator — and the cold/warm pre-kernel split is what bench_gate's
+    >=2x floor reads on config 2."""
+
+    def norm(out):
+        return json.dumps(
+            [{"status": r["status"], "result": r["result"]} for r in out],
+            sort_keys=True,
+            default=str,
+        )
+
+    pc = ds.plan_cache
+    # phase A: cold — capture reference transcripts with every parse
+    # recording a cold pre-kernel timing. clear() drops entries but NOT
+    # the window timing, so clearing before EACH query keeps a battery
+    # that shares one fingerprint (config 2) from self-installing
+    # mid-pass and serving its own tail warm — all len(queries) samples
+    # stay genuinely cold.
+    pc.clear()
+    pc.reset_window()
+    cold = []
+    for sql, v in queries:
+        pc.clear()
+        cold.append(norm(run(ds, s, sql, v)))
+    ws_cold = pc.window_stats()
+    # phase B: warm every shape (min-hits install threshold included)
+    for _ in range(max(repeats, 1)):
+        for sql, v in queries:
+            run(ds, s, sql, v)
+    # phase C: pure-warm window — serves only, byte-compared to phase A.
+    # The battery runs `repeats` times in this window so the warm average
+    # sees repeats*len(queries) samples — single-pass µs timings are too
+    # noisy for the gate's warm/cold ratio floor.
+    pc.reset_window()
+    warm = [norm(run(ds, s, sql, v)) for sql, v in queries]
+    for _ in range(max(repeats, 1) - 1):
+        for sql, v in queries:
+            run(ds, s, sql, v)
+    ws_warm = pc.window_stats()
+    mismatches = sum(1 for c, w in zip(cold, warm) if c != w)
+    cold_us = ws_cold["prekernel"]["cold_avg_us"]
+    warm_us = ws_warm["prekernel"]["warm_avg_us"]
+    return {
+        "parity": mismatches == 0,
+        "mismatches": mismatches,
+        "queries": len(queries),
+        "warm_hit_rate": ws_warm["hit_rate"],
+        "warm_hits": ws_warm["hits"],
+        "warm_misses": ws_warm["misses"],
+        "verifies": ws_warm["verifies"],
+        "prekernel_cold_us": cold_us,
+        "prekernel_warm_us": warm_us,
+        "speedup": round(cold_us / warm_us, 2) if cold_us and warm_us else None,
+        "per_fingerprint": ws_warm["fingerprints"][:8],
     }
 
 
@@ -1376,6 +1462,9 @@ def bench_filtered_scan(ds, s):
     # config is blind to them, and config 6's own metrics ran above
     sustained_ratio = round(v2_rate / r10_rate, 2) if r10_rate else None
 
+    log("filtered_scan: plan-cache parity (cold vs warm byte-compare)")
+    pc_parity = _plan_cache_parity(ds, s, [(sql, None), (csql, None)])
+
     ratio = col_qps / row_qps if row_qps else None
     emit(
         {
@@ -1395,8 +1484,10 @@ def bench_filtered_scan(ds, s):
                 "delta_vs_r10": sustained_ratio,
                 "parity_failures": pf0 + pf1,
             },
+            "plan_cache_parity": pc_parity,
         }
     )
+    assert pc_parity["parity"], "plan-cache warm serve diverged from cold parse"
     return ratio
 
 
@@ -1466,6 +1557,8 @@ def bench_ordered_agg(ds, s):
     }
     ratios = [v["ratio"] for v in out.values() if v["ratio"]]
     ratio = round(min(ratios), 2) if ratios else None
+    log("ordered_agg: plan-cache parity (cold vs warm byte-compare)")
+    pc_parity = _plan_cache_parity(ds, s, [(order_sql, None), (agg_sql, None)])
     emit(
         {
             "metric": f"ordered_agg_{NI}rows",
@@ -1477,8 +1570,10 @@ def bench_ordered_agg(ds, s):
             "pipeline": pipeline,
             "pipeline_engaged": engaged,
             "same_results": out["order"]["same_results"] and out["agg"]["same_results"],
+            "plan_cache_parity": pc_parity,
         }
     )
+    assert pc_parity["parity"], "plan-cache warm serve diverged from cold parse"
     assert out["order"]["same_results"], "ordered columnar result diverged"
     assert out["agg"]["same_results"], "aggregate columnar result diverged"
     assert engaged["ordered"] > 0 and engaged["grouped"] > 0, (

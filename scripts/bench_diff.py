@@ -54,6 +54,12 @@ and FLAPPED (expired then re-armed — oscillating evidence the operator
 should tune thresholds for, not act on). Severity escalations between
 rounds are flagged too.
 
+`--plan-cache` compares the two runs' plan-cache parity objects (schema
+/15 configs 2/6/9): a parity regression (a warm serve diverging from
+its cold parse) flags unconditionally; warm hit-rate drops, warm
+pre-kernel cost growth and serve-vs-reparse speedup losses flag beyond
+the threshold.
+
 Also importable: `diff(old_art, new_art, threshold) -> list[dict]`,
 `diff_bundles(old_bundle, new_bundle) -> dict`,
 `diff_statements(old_art, new_art, threshold) -> list[dict]`,
@@ -765,6 +771,90 @@ def _main_advisor(old: dict, new: dict) -> int:
     return 1 if rep["flags"] else 0
 
 
+# ------------------------------------------------------------------ plan cache
+def _plan_cache_by_config(art: dict) -> Dict[str, dict]:
+    """Every plan_cache_parity proof object embedded in an artifact's
+    config lines (schema /15, configs 2/6/9), keyed by config."""
+    out: Dict[str, dict] = {}
+    for r in art.get("results") or []:
+        pp = r.get("plan_cache_parity")
+        if isinstance(pp, dict) and r.get("config") is not None:
+            out[str(r["config"])] = dict(pp, metric=r.get("metric"))
+    return out
+
+
+def diff_plan_cache(old: dict, new: dict, threshold: float = 0.25) -> List[dict]:
+    """Per-config comparison of two artifacts' plan-cache parity objects:
+    parity regressions are flagged unconditionally (a warm serve that
+    started diverging is a correctness event, not a perf delta); hit-rate
+    drops and warm pre-kernel cost growth flag beyond the threshold."""
+    o_by, n_by = _plan_cache_by_config(old), _plan_cache_by_config(new)
+    rows: List[dict] = []
+    for cfg in sorted(set(o_by) & set(n_by)):
+        op, np_ = o_by[cfg], n_by[cfg]
+        flags: List[str] = []
+        if op.get("parity") is True and np_.get("parity") is not True:
+            flags.append(
+                f"PARITY REGRESSED: {np_.get('mismatches')} warm serve(s) "
+                "diverged from the cold parse"
+            )
+        d_hit = _rel(op.get("warm_hit_rate"), np_.get("warm_hit_rate"))
+        if d_hit is not None and d_hit < -threshold:
+            flags.append(
+                f"warm hit rate {op.get('warm_hit_rate')} -> "
+                f"{np_.get('warm_hit_rate')} ({d_hit * 100:+.0f}%)"
+            )
+        d_warm = _rel(op.get("prekernel_warm_us"), np_.get("prekernel_warm_us"))
+        if d_warm is not None and d_warm > threshold:
+            flags.append(
+                f"warm pre-kernel {op.get('prekernel_warm_us')}us -> "
+                f"{np_.get('prekernel_warm_us')}us ({d_warm * 100:+.0f}%) — "
+                "serving is getting slower"
+            )
+        d_sp = _rel(op.get("speedup"), np_.get("speedup"))
+        if d_sp is not None and d_sp < -threshold:
+            flags.append(
+                f"serve-vs-reparse speedup {op.get('speedup')}x -> "
+                f"{np_.get('speedup')}x ({d_sp * 100:+.0f}%)"
+            )
+        rows.append(
+            {
+                "config": cfg,
+                "metric": np_.get("metric"),
+                "old": op,
+                "new": np_,
+                "flags": flags,
+            }
+        )
+    return rows
+
+
+def _main_plan_cache(old: dict, new: dict, threshold: float) -> int:
+    rows = diff_plan_cache(old, new, threshold)
+    if not rows:
+        print(
+            "no shared plan_cache_parity configs between the two artifacts "
+            "(schema /15 configs 2/6/9 required)",
+            file=sys.stderr,
+        )
+        return 2
+    flagged = 0
+    for r in rows:
+        head = (
+            f"config {r['config']} ({r['metric']}): hit "
+            f"{r['old'].get('warm_hit_rate')} -> {r['new'].get('warm_hit_rate')}, "
+            f"warm {r['old'].get('prekernel_warm_us')} -> "
+            f"{r['new'].get('prekernel_warm_us')}us, speedup "
+            f"{r['old'].get('speedup')} -> {r['new'].get('speedup')}x"
+        )
+        print(("FLAG  " if r["flags"] else "ok    ") + head)
+        for fl in r["flags"]:
+            print(f"      - {fl}")
+        flagged += bool(r["flags"])
+    print(f"{flagged}/{len(rows)} config(s) flagged (threshold {threshold * 100:.0f}%)")
+    return 1 if flagged else 0
+
+
 # ------------------------------------------------------------------ tenants
 def _tenants_by_key(art: dict) -> Dict[str, dict]:
     """Every per-tenant meter entry embedded in an artifact's config lines
@@ -942,6 +1032,12 @@ def main(argv: List[str]) -> int:
         "proposals appeared / resolved / flapped / escalated between "
         "rounds",
     )
+    ap.add_argument(
+        "--plan-cache", action="store_true", dest="plan_cache",
+        help="diff the two runs' plan-cache parity objects (schema /15): "
+        "parity regressions, warm hit-rate drops, warm pre-kernel cost "
+        "growth, per config",
+    )
     try:
         ns = ap.parse_args(argv)
     except SystemExit:
@@ -963,6 +1059,8 @@ def main(argv: List[str]) -> int:
         return _main_tenants(old, new, threshold)
     if ns.advisor:
         return _main_advisor(old, new)
+    if ns.plan_cache:
+        return _main_plan_cache(old, new, threshold)
     rows = diff(old, new, threshold)
     if not rows:
         print("no comparable configs between the two artifacts", file=sys.stderr)

@@ -46,6 +46,14 @@ Env knobs:
     SURREAL_BENCH_GATE_ADVISOR_OVERHEAD  advisor-sweep overhead ceiling in
                                    percent on the config-2 engine path
                                    (default 3.0 — same contract)
+    SURREAL_BENCH_GATE_PLAN_CACHE_HIT  config-2 plan-cache warm hit-rate
+                                   floor on the parity battery (default 0.9)
+    SURREAL_BENCH_GATE_PLAN_CACHE_WARM_RATIO  warm/cold pre-kernel cost
+                                   ceiling (default 0.7 — looser than the
+                                   committed artifact's >=2x bar because
+                                   the gate re-measures µs-scale parse
+                                   timings on whatever container it runs
+                                   on; tighten via the env knob)
     SURREAL_BENCH_GATE_TIMEOUT     whole-run timeout seconds (default 1200)
 
 Exit code 0 = gate passed; 1 = gate failed (reasons on stderr).
@@ -99,6 +107,16 @@ ACCOUNTING_OVERHEAD_CEILING = float(
 # _advisor_overhead)
 ADVISOR_OVERHEAD_CEILING = float(
     os.environ.get("SURREAL_BENCH_GATE_ADVISOR_OVERHEAD", "3.0")
+)
+# plan cache (schema/15): the config-2 warm window must actually serve —
+# hit-rate floor on the parity battery — and a warm serve's pre-kernel
+# (parse+plan) cost must stay under this fraction of the cold parse's
+# (the >=2x speedup acceptance bar, expressed as a <=0.5x cost ratio)
+PLAN_CACHE_HIT_FLOOR = float(
+    os.environ.get("SURREAL_BENCH_GATE_PLAN_CACHE_HIT", "0.9")
+)
+PLAN_CACHE_WARM_COST_RATIO = float(
+    os.environ.get("SURREAL_BENCH_GATE_PLAN_CACHE_WARM_RATIO", "0.7")
 )
 TIMEOUT = int(os.environ.get("SURREAL_BENCH_GATE_TIMEOUT", "1200"))
 
@@ -195,6 +213,30 @@ def main() -> int:
             f"advisor-sweep overhead {adv_overhead}% > ceiling "
             f"{ADVISOR_OVERHEAD_CEILING}% (the always-on contract)"
         )
+    # plan cache (schema/15): the parity object is the validator's problem
+    # structurally; the gate enforces the PERF floors — the warm window
+    # must serve (hit rate) and serving must actually be cheaper than
+    # parsing (warm pre-kernel <= ratio * cold pre-kernel)
+    pp = line.get("plan_cache_parity") or {}
+    pc_hit = pp.get("warm_hit_rate")
+    if pc_hit is None:
+        failures.append("config 2 carries no plan_cache_parity measurement")
+    else:
+        if pc_hit < PLAN_CACHE_HIT_FLOOR:
+            failures.append(
+                f"plan-cache warm hit rate {pc_hit} < floor {PLAN_CACHE_HIT_FLOOR}"
+            )
+        cold_us, warm_us = pp.get("prekernel_cold_us"), pp.get("prekernel_warm_us")
+        if not cold_us or warm_us is None:
+            failures.append(
+                "plan-cache parity carries no cold/warm pre-kernel split"
+            )
+        elif warm_us > cold_us * PLAN_CACHE_WARM_COST_RATIO:
+            failures.append(
+                f"plan-cache warm pre-kernel {warm_us}us > "
+                f"{PLAN_CACHE_WARM_COST_RATIO} * cold {cold_us}us — serving "
+                "is not beating re-parsing"
+            )
     # the statistics plane must have SEEN the window: a /12 artifact whose
     # config-2 line recorded no fingerprints means recording is broken
     st = line.get("statements") or {}
@@ -410,6 +452,7 @@ def main() -> int:
         "retries": line.get("retries"),
         "splits": line.get("splits"),
         "width_dist": (line.get("batch") or {}).get("width_dist"),
+        "plan_cache": pp,
         "filtered_scan": scan_summary,
         "ingest_rate_rows_s": line.get("ingest_rate_rows_s"),
         "ingest": ingest_summary,

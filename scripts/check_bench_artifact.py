@@ -23,7 +23,7 @@ import os
 import sys
 from typing import List
 
-SCHEMA = "surrealdb-tpu-bench/14"
+SCHEMA = "surrealdb-tpu-bench/15"
 # earlier rounds' committed artifacts stay validatable under their own rules
 KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/1",
@@ -39,6 +39,7 @@ KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/11",
     "surrealdb-tpu-bench/12",
     "surrealdb-tpu-bench/13",
+    "surrealdb-tpu-bench/14",
     SCHEMA,
 )
 
@@ -155,6 +156,21 @@ ADVISOR_PROPOSAL_KEYS = (
 )
 ADVISOR_EVIDENCE_KEYS = ("plane", "metric", "window", "value", "threshold")
 ADVISOR_EVIDENCE_PLANES = ("stats", "accounting", "telemetry", "idx", "cluster")
+# schema/15 (plan cache): every config line embeds its window's plan-cache
+# stats; the configs that re-run a fixed battery (2 knn, 6 filtered_scan,
+# 9 ordered_agg) must carry the cold-vs-warm `plan_cache_parity` proof
+# object with parity == true (a single stale warm serve is an INVALID
+# artifact, not a perf number) and a measured warm hit rate. /15 bundles
+# (bundle/9) must carry the `plan_cache` section.
+PLAN_CACHE_EMBED_KEYS = (
+    "enabled", "entries", "hits", "route_hits", "misses", "hit_rate",
+    "invalidations", "verifies", "prekernel",
+)
+PLAN_CACHE_PARITY_KEYS = (
+    "parity", "mismatches", "queries", "warm_hit_rate",
+    "prekernel_cold_us", "prekernel_warm_us", "speedup",
+)
+PLAN_CACHE_PARITY_CONFIGS = ("2", "6", "9")
 COMPILES_KEYS = ("on_demand", "prewarm", "events")
 BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
 BATCH_KEYS_V3 = BATCH_KEYS + ("width_dist", "pipeline_wait_s")
@@ -466,7 +482,8 @@ def validate(path: str) -> List[str]:
     if art.get("schema") not in KNOWN_SCHEMAS:
         problems.append(f"schema is {art.get('schema')!r}, expected one of {KNOWN_SCHEMAS}")
     schema = art.get("schema")
-    v14 = schema == SCHEMA
+    v15 = schema == SCHEMA
+    v14 = v15 or schema == "surrealdb-tpu-bench/14"
     v13 = v14 or schema == "surrealdb-tpu-bench/13"
     v12 = v13 or schema == "surrealdb-tpu-bench/12"
     v11 = v12 or schema == "surrealdb-tpu-bench/11"
@@ -496,6 +513,9 @@ def validate(path: str) -> List[str]:
         else:
             sections = (
                 BUNDLE_SECTIONS_V9
+                + ("statements", "profiler", "tenants", "advisor", "plan_cache")
+                if v15
+                else BUNDLE_SECTIONS_V9
                 + ("statements", "profiler", "tenants", "advisor")
                 if v14
                 else BUNDLE_SECTIONS_V9 + ("statements", "profiler", "tenants")
@@ -881,6 +901,45 @@ def validate(path: str) -> List[str]:
                         )
         if v14 and str(r.get("config")) == "12" and metric.startswith("advisor_shift"):
             problems.extend(_check_advisor_plane(where, metric, r))
+        if v15:
+            pcw = r.get("plan_cache")
+            if not isinstance(pcw, dict):
+                problems.append(
+                    f"{where} ({metric}): schema/15 config lines must carry "
+                    "the 'plan_cache' window-stats object"
+                )
+            else:
+                for key in PLAN_CACHE_EMBED_KEYS:
+                    if key not in pcw:
+                        problems.append(
+                            f"{where} ({metric}): plan_cache missing {key!r}"
+                        )
+        if v15 and str(r.get("config")) in PLAN_CACHE_PARITY_CONFIGS:
+            pp = r.get("plan_cache_parity")
+            if not isinstance(pp, dict):
+                problems.append(
+                    f"{where} ({metric}): schema/15 config "
+                    f"{r.get('config')} must carry the 'plan_cache_parity' "
+                    "cold-vs-warm proof object"
+                )
+            else:
+                for key in PLAN_CACHE_PARITY_KEYS:
+                    if key not in pp:
+                        problems.append(
+                            f"{where} ({metric}): plan_cache_parity missing {key!r}"
+                        )
+                if pp.get("parity") is not True:
+                    problems.append(
+                        f"{where} ({metric}): plan_cache_parity.parity must "
+                        "be true (a warm serve diverged byte-wise from its "
+                        "cold parse — a stale plan served)"
+                    )
+                if not isinstance(pp.get("warm_hit_rate"), (int, float)):
+                    problems.append(
+                        f"{where} ({metric}): plan_cache_parity.warm_hit_rate "
+                        "must be a measured number (the warm window never "
+                        "actually served from the cache)"
+                    )
         if v4 and metric.startswith("filtered_scan"):
             for key in FILTERED_SCAN_KEYS:
                 if key not in r:

@@ -1140,3 +1140,88 @@ def gl014(modules: List[Module]) -> List[Finding]:
                     )
                 )
     return out
+
+
+# ------------------------------------------------------------------ GL015
+# The plan/pipeline cache (surrealdb_tpu/dbs/plan_cache.py) has ONE write
+# door: the PlanCache methods themselves (fetch/observe/install_*/
+# bump_generation/ddl_begin/ddl_end/on_plan_flip/note_epoch/clear). They
+# own the lock discipline (mutate under plan_cache.store, emit eviction
+# events/counters only after release) and the validation-on-serve
+# contract — generation/epoch/scope stamps checked on every serve. An
+# ad-hoc writer reaching into the private tables (`_entries`, `_gen`,
+# route maps, the timing windows) would bypass both and could serve a
+# stale plan, the one failure mode the cache is built to make impossible.
+# Outside plan_cache.py, touching any private member of the module OR of
+# a PlanCache INSTANCE (any attribute chain ending in `.plan_cache`, the
+# datastore's handle) is a finding.
+GL015_ALLOWED_FILES = frozenset({"surrealdb_tpu/dbs/plan_cache.py"})
+GL015_PC_MODULE = "surrealdb_tpu.dbs.plan_cache"
+GL015_PRIVATE = frozenset(
+    {"_entries", "_warm", "_by_stmt", "_index_defs", "_gen", "_inflight",
+     "_epoch", "_timing", "_hits", "_misses", "_invalidations", "_verifies",
+     "_evlog", "_lock", "_caches", "_serve_digest", "_serve_lexed",
+     "_route_for", "_emit_evict", "_note_timing"}
+)
+
+
+def _gl015_pc_aliases(m: Module) -> Set[str]:
+    """Every local NAME the plan_cache module is bound to in this file
+    (mirrors _gl012_stats_aliases)."""
+    out: Set[str] = set()
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == GL015_PC_MODULE and a.asname:
+                    out.add(a.asname)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                if (
+                    f"{node.module}.{a.name}" == GL015_PC_MODULE
+                    or (a.name == "plan_cache"
+                        and node.module == "surrealdb_tpu.dbs")
+                ):
+                    out.add(a.asname or a.name)
+    return out
+
+
+@_rule("GL015", "plan-cache state mutated outside the cache's write door")
+def gl015(modules: List[Module]) -> List[Finding]:
+    out: List[Finding] = []
+    for m in modules:
+        if m.rel in GL015_ALLOWED_FILES:
+            continue
+        aliases = _gl015_pc_aliases(m)
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if node.attr not in GL015_PRIVATE:
+                continue
+            # module-level access: plan_cache._caches via alias or the
+            # dotted form a plain `import surrealdb_tpu.dbs.plan_cache`
+            # enables
+            via_alias = (
+                isinstance(node.value, ast.Name) and node.value.id in aliases
+            )
+            via_dotted = _gl012_dotted(node.value) == GL015_PC_MODULE
+            # instance access: any chain ENDING in `.plan_cache` is the
+            # datastore's cache handle (ds.plan_cache._entries,
+            # self.ds.plan_cache._lock, ctx.executor.ds.plan_cache._gen…)
+            via_instance = (
+                isinstance(node.value, ast.Attribute)
+                and node.value.attr == "plan_cache"
+            )
+            if not (via_alias or via_dotted or via_instance):
+                continue
+            out.append(
+                Finding(
+                    "GL015", m.rel, node.lineno, node.col_offset,
+                    f"plan_cache.{node.attr} accessed outside "
+                    "dbs/plan_cache.py — plan-cache state must go through "
+                    "the PlanCache write door (the methods that keep the "
+                    "lock discipline and the validation-on-serve stamps "
+                    "honest; a bypass can serve a stale plan)",
+                    f"GL015:{m.rel}:{m.enclosing_def(node)}:{node.attr}",
+                )
+            )
+    return out

@@ -78,6 +78,7 @@ KINDS: Dict[str, str] = {
     "mirror.field_budget": "column-mirror declines are drifting up (field budget)",
     "cluster.rebalance": "sustained per-shard load skew (epoch-safe target named)",
     "tenant.quota_review": "a tenant's soft-budget breaches keep recurring",
+    "plan_cache.review": "a hot statement shape misses or thrashes the plan cache",
 }
 
 SEVERITIES = ("info", "warn", "critical")
@@ -598,6 +599,64 @@ def _quota_candidates(tenants: List[dict]) -> List[dict]:
     return out
 
 
+def _plan_cache_candidates(ds) -> List[dict]:
+    """Plan-cache pathologies worth a human look: fingerprints whose
+    entries mostly MISS (unparameterizable literal churn, verify demotion)
+    and fingerprints that keep getting EVICTED (plan-mix flips, DDL storms
+    — the cache installs, something invalidates, repeat). Observe-only:
+    the fix is a schema/statement change or a knob, never applied here."""
+    from surrealdb_tpu import cnf
+
+    pc = getattr(ds, "plan_cache", None) if ds is not None else None
+    if pc is None:
+        return []
+    min_calls = max(int(getattr(cnf, "ADVISOR_MIN_CALLS", 8)), 1)
+    out: List[dict] = []
+    for row in pc.review_rows(min_calls=min_calls):
+        fp = row["fingerprint"]
+        if row["kind"] == "low_hit_rate":
+            out.append({
+                "kind": "plan_cache.review",
+                "subject": f"low_hit_rate:{fp}",
+                "severity": "info",
+                "evidence": [
+                    {"plane": "stats", "metric": f"plan_cache.hit_rate.{fp}",
+                     "window": "cumulative", "value": row["hit_rate"],
+                     "threshold": 0.5},
+                    {"plane": "telemetry", "metric": "plan_cache_misses",
+                     "window": "cumulative", "value": row["misses"],
+                     "threshold": min_calls},
+                ],
+                "estimated_benefit": {
+                    "unit": "replans-avoided/window", "value": row["misses"],
+                },
+                "fingerprints": (fp,),
+            })
+        elif row["kind"] == "thrash":
+            out.append({
+                "kind": "plan_cache.review",
+                "subject": f"thrash:{fp}",
+                "severity": "warn",
+                "evidence": [
+                    {"plane": "telemetry",
+                     "metric": "plan_cache_invalidations",
+                     "window": "recent", "value": row["evictions"],
+                     "threshold": 2},
+                    {"plane": "stats",
+                     "metric": f"plan_cache.evict_causes.{fp}",
+                     "window": "recent",
+                     "value": ",".join(row.get("causes") or []),
+                     "threshold": None},
+                ],
+                "estimated_benefit": {
+                    "unit": "reinstalls-avoided/window",
+                    "value": row["evictions"],
+                },
+                "fingerprints": (fp,),
+            })
+    return out
+
+
 # ------------------------------------------------------------------ the sweep
 def sweep_once(ds=None) -> dict:
     """One read-only analyzer pass: snapshot every source plane, derive
@@ -630,6 +689,7 @@ def sweep_once(ds=None) -> dict:
         candidates += _mirror_candidates()
         candidates += _rebalance_candidates(ds, tenants)
         candidates += _quota_candidates(tenants)
+        candidates += _plan_cache_candidates(ds)
         for c in candidates:
             rec = propose(
                 c["kind"], c["subject"],

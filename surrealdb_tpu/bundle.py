@@ -61,6 +61,11 @@ versioned document — the artifact you attach to any perf report:
                      chained tuning proposals (observe-only), the
                      proposal-kind catalog, the expired ring and sweep
                      health (new in bundle/8).
+16. `plan_cache`   — the fingerprint-keyed plan & pipeline cache
+                     (dbs/plan_cache.py): hit/miss/invalidation
+                     counters by cause, entry/variant/route counts,
+                     per-fingerprint warm-vs-cold pre-kernel timings
+                     and the recent eviction log (new in bundle/9).
 
 Served by `GET /debug/bundle` (system-user-gated) and embedded via
 `INFO FOR ROOT` (`system.bundle`); bench.py embeds one per artifact so a
@@ -80,13 +85,13 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-BUNDLE_SCHEMA = "surrealdb-tpu-bundle/8"
+BUNDLE_SCHEMA = "surrealdb-tpu-bundle/9"
 
 # the sections every consumer may rely on
 SECTIONS = (
     "traces", "slow_queries", "errors", "tasks", "compiles", "engine",
     "locks", "faults", "events", "kernel_audit", "flow_audit",
-    "statements", "profiler", "tenants", "advisor",
+    "statements", "profiler", "tenants", "advisor", "plan_cache",
 )
 
 
@@ -128,6 +133,9 @@ def debug_bundle(
         "profiler": profiler.report(),
         "tenants": accounting.snapshot(),
         "advisor": advisor.snapshot(),
+        "plan_cache": ds.plan_cache.snapshot()
+        if ds is not None
+        else {"enabled": False, "available": False},
     }
     return out
 

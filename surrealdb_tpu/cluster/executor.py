@@ -57,6 +57,7 @@ import contextvars
 import random as _random
 import threading
 import time as _time
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -380,6 +381,16 @@ class ClusterExecutor:
         # (reset_degradation) — repair proves convergence, so the
         # pushdowns resume instead of standing down forever.
         self._degradation0 = self._write_degradation()
+        # epoch-guarded scatter-route cache (the cluster half of the plan
+        # cache, dbs/plan_cache.py): SELECT classification — the graph /
+        # colocated / agg / knn / bm25 / scan branch plus the refuse-wrong
+        # errors — is a pure function of the statement SHAPE (literals
+        # never change it), so it is cached per fingerprint and the AST
+        # shape walks are skipped on repeat. A membership epoch bump
+        # clears it (and notifies the datastore's plan cache).
+        self._class_lock = threading.Lock()
+        self._class_cache: "OrderedDict[str, tuple]" = OrderedDict()
+        self._class_epoch: Optional[int] = None
 
     def reset_degradation(self) -> None:
         """Re-arm the pipeline pushdowns after repair proved the replicas
@@ -1359,59 +1370,34 @@ class ClusterExecutor:
         if getattr(stm, "fetch", None):
             return _err("FETCH is not supported in cluster mode yet")
 
-        if _has_subquery(getattr(stm, "cond", None)):
-            # the scattered WHERE would resolve the inner SELECT over each
-            # shard's PARTIAL data — wrong (often empty) membership sets
-            return _err(
-                "subqueries in WHERE evaluate per shard — not supported in "
-                "cluster mode (materialize the inner SELECT into a $param "
-                "first)"
-            )
-        if _has_inbound_graph(getattr(stm, "cond", None)):
-            # a row's OUTBOUND pointers are local to its owner (RELATE
-            # routing), so outbound graph conds evaluate correctly per
-            # shard — but INBOUND pointers live on the edge source's owner
-            # and a per-shard check silently drops matches
-            return _err(
-                "inbound (<- / <->) graph traversal in WHERE reads pointer "
-                "keys on other shards — not supported in cluster mode"
-            )
+        decision = self._classified(stm)
+        if decision[0] == "err":
+            return _err(decision[1])
 
-        knn = _find_operator(getattr(stm, "cond", None), KnnOp)
-        matches = _find_operator(getattr(stm, "cond", None), MatchesOp)
-
-        graph = self._graph_shape(stm)
-        if graph is not None:
+        if decision[0] == "graph":
+            # re-derive the shape from THIS request's parse — decision
+            # tuples are plain data; AST nodes are never cached
+            graph = self._graph_shape(stm)
+            if graph is None:  # shape drifted from the cached decision
+                return self._dispatch_select(
+                    self._classify_select(stm), stm, session, vars
+                )
             self._set_scatter_kind("graph")
             with telemetry.span("cluster_scatter", kind="graph"):
                 return self._graph_select(stm, session, vars, graph)
 
-        shape = self._projection_shape(stm)
-        if shape == "unsupported":
-            # a subquery / ml:: call in the projection would evaluate over
-            # each shard's PARTIAL data (and imported models are per-node)
-            return _err(
-                "subquery/ml projections evaluate per shard — not supported "
-                "in cluster mode"
-            )
-        if shape == "colocated":
-            if getattr(stm, "group", None) or getattr(stm, "group_all", False):
-                # each shard would aggregate its slice and the coordinator
-                # cannot merge arbitrary graph-projection aggregates —
-                # concatenated partials are wrong
-                return _err(
-                    "GROUP over graph projections aggregates per shard — "
-                    "not supported in cluster mode"
-                )
+        return self._dispatch_select(decision, stm, session, vars)
+
+    def _dispatch_select(self, decision: tuple, stm, session, vars) -> dict:
+        from surrealdb_tpu import telemetry
+
+        if decision[0] == "err":
+            return _err(decision[1])
+        if decision[0] == "colocated":
             self._set_scatter_kind("colocated")
             with telemetry.span("cluster_scatter", kind="colocated"):
                 return self._colocated_select(stm, session, vars)
-
-        if (
-            knn is None
-            and matches is None
-            and (getattr(stm, "group", None) or getattr(stm, "group_all", False))
-        ):
+        if decision[0] == "agg":
             # GROUP BY aggregate pushdown: each shard returns partial
             # aggregates over its rows and the coordinator merges partials
             # instead of shipping + replaying every surviving row. Shapes
@@ -1420,8 +1406,18 @@ class ClusterExecutor:
             resp = self._agg_pushdown(stm, session, vars)
             if resp is not None:
                 return resp
-
-        kind = "knn" if knn is not None else ("bm25" if matches is not None else "scan")
+        kind = decision[0] if decision[0] in ("knn", "bm25") else "scan"
+        # operator nodes come from the fresh parse, never the cache
+        knn = _find_operator(getattr(stm, "cond", None), KnnOp) if kind == "knn" else None
+        matches = (
+            _find_operator(getattr(stm, "cond", None), MatchesOp)
+            if kind == "bm25"
+            else None
+        )
+        if kind == "knn" and knn is None:
+            kind = "scan"
+        if kind == "bm25" and matches is None:
+            kind = "scan"
         self._set_scatter_kind(kind)
         with telemetry.span("cluster_scatter", kind=kind):
             if knn is not None:
@@ -1429,6 +1425,113 @@ class ClusterExecutor:
             if matches is not None:
                 return self._scatter_select(stm, session, vars, matches=matches)
             return self._scatter_select(stm, session, vars)
+
+    # ------------------------------------------- SELECT classification
+    # The scatter branch for a SELECT — graph / colocated / agg / knn /
+    # bm25 / scan, plus the refuse-wrong errors — depends only on the
+    # statement SHAPE (which clauses exist, which operators appear),
+    # never on literal values, so it is a pure function of the statement
+    # fingerprint. _classified() caches the decision tuple per
+    # fingerprint, guarded by the membership epoch: a node joining or
+    # leaving clears every cached route (and tells the datastore's plan
+    # cache, which stamps epochs on its own routes). Only plain tuples
+    # are cached — graph shapes and knn/matches operator NODES are
+    # re-derived from each request's fresh parse at dispatch.
+
+    _CLASS_CAP = 512
+
+    def _classify_select(self, stm) -> tuple:
+        if getattr(stm, "fetch", None):
+            return ("err", "FETCH is not supported in cluster mode yet")
+        if _has_subquery(getattr(stm, "cond", None)):
+            # the scattered WHERE would resolve the inner SELECT over each
+            # shard's PARTIAL data — wrong (often empty) membership sets
+            return (
+                "err",
+                "subqueries in WHERE evaluate per shard — not supported in "
+                "cluster mode (materialize the inner SELECT into a $param "
+                "first)",
+            )
+        if _has_inbound_graph(getattr(stm, "cond", None)):
+            # a row's OUTBOUND pointers are local to its owner (RELATE
+            # routing), so outbound graph conds evaluate correctly per
+            # shard — but INBOUND pointers live on the edge source's owner
+            # and a per-shard check silently drops matches
+            return (
+                "err",
+                "inbound (<- / <->) graph traversal in WHERE reads pointer "
+                "keys on other shards — not supported in cluster mode",
+            )
+
+        if self._graph_shape(stm) is not None:
+            return ("graph",)
+
+        shape = self._projection_shape(stm)
+        if shape == "unsupported":
+            # a subquery / ml:: call in the projection would evaluate over
+            # each shard's PARTIAL data (and imported models are per-node)
+            return (
+                "err",
+                "subquery/ml projections evaluate per shard — not supported "
+                "in cluster mode",
+            )
+        grouped = bool(getattr(stm, "group", None)) or bool(
+            getattr(stm, "group_all", False)
+        )
+        if shape == "colocated":
+            if grouped:
+                # each shard would aggregate its slice and the coordinator
+                # cannot merge arbitrary graph-projection aggregates —
+                # concatenated partials are wrong
+                return (
+                    "err",
+                    "GROUP over graph projections aggregates per shard — "
+                    "not supported in cluster mode",
+                )
+            return ("colocated",)
+
+        knn = _find_operator(getattr(stm, "cond", None), KnnOp)
+        matches = _find_operator(getattr(stm, "cond", None), MatchesOp)
+        if knn is None and matches is None and grouped:
+            return ("agg",)
+        if knn is not None:
+            return ("knn",)
+        if matches is not None:
+            return ("bm25",)
+        return ("scan",)
+
+    def _classified(self, stm) -> tuple:
+        from surrealdb_tpu import telemetry
+
+        ctx = _STMT.get(None)
+        fp = getattr(ctx, "fp", None) if ctx is not None else None
+        if fp is None or not cnf.PLAN_CACHE:
+            return self._classify_select(stm)
+        ep = self.node.membership.epoch
+        stale = 0
+        with self._class_lock:
+            if self._class_epoch != ep:
+                stale = len(self._class_cache)
+                self._class_cache.clear()
+                self._class_epoch = ep
+            hit = self._class_cache.get(fp)
+            if hit is not None:
+                self._class_cache.move_to_end(fp)
+        # telemetry + cross-plane notification AFTER the lock releases
+        if stale:
+            telemetry.inc("plan_cache_invalidations", stale, cause="epoch")
+            self.ds.plan_cache.note_epoch(ep)
+        if hit is not None:
+            telemetry.inc("plan_cache_hits", kind="cluster_route")
+            return hit
+        decision = self._classify_select(stm)
+        with self._class_lock:
+            if self._class_epoch == ep:
+                self._class_cache[fp] = decision
+                self._class_cache.move_to_end(fp)
+                while len(self._class_cache) > self._CLASS_CAP:
+                    self._class_cache.popitem(last=False)
+        return decision
 
     @staticmethod
     def _set_scatter_kind(kind: str) -> None:

@@ -352,7 +352,9 @@ def _op_statements(ds, req):
         fingerprint=str(fp) if fp else None,
         sort=str(req.get("sort") or "total_s"),
     )
-    return {"json": _json.dumps(out, default=str)}
+    # each member annotates its OWN rows with its plan-cache state (cache
+    # contents are per-node), so the federated merge carries them for free
+    return {"json": _json.dumps(ds.plan_cache.annotate(out), default=str)}
 
 
 def _op_tenants(ds, req):

@@ -355,6 +355,18 @@ ADVISOR_DECLINE_MIN = _env_int("SURREAL_ADVISOR_DECLINE_MIN", 32)
 ADVISOR_SKEW_RATIO = _env_float("SURREAL_ADVISOR_SKEW_RATIO", 3.0)
 ADVISOR_BREACH_MIN = _env_int("SURREAL_ADVISOR_BREACH_MIN", 3)
 
+# Plan & pipeline cache (dbs/plan_cache.py): fingerprint-keyed cache of
+# the front-of-pipeline artifact chain (parsed AST template with literal
+# slots, resolved plan route, compiled predicate/stage programs, index
+# defs). Correctness is validation-on-serve, never TTL — every serve
+# checks schema/index generation, tenant scope, mirror serve state and
+# cluster epoch; a PR 15 plan-mix flip evicts the fingerprint. CAP bounds
+# the per-datastore entry LRU; MIN_HITS is how many executions a
+# fingerprint needs before its artifacts are installed (1 = first sight).
+PLAN_CACHE = _env_bool("SURREAL_PLAN_CACHE", True)
+PLAN_CACHE_CAP = _env_int("SURREAL_PLAN_CACHE_CAP", 512)
+PLAN_CACHE_MIN_HITS = _env_int("SURREAL_PLAN_CACHE_MIN_HITS", 2)
+
 # Flight recorder (bg.py + compile_log.py): background-task registry with
 # a watchdog that flips tasks to `stalled` past a per-kind deadline, and a
 # bounded XLA compile-event log (prewarm vs on-demand attribution).

@@ -21,13 +21,17 @@ from surrealdb_tpu.err import (
     SurrealError,
 )
 from surrealdb_tpu.sql.statements import (
+    AlterStatement,
     BeginStatement,
     CancelStatement,
     CommitStatement,
+    DefineStatement,
     KillStatement,
     LiveStatement,
     OptionStatement,
     Query,
+    RebuildStatement,
+    RemoveStatement,
     UseStatement,
 )
 from surrealdb_tpu.sql.value import NONE, is_none
@@ -61,6 +65,15 @@ class Executor:
         self.txn = None
         self.explicit = False  # inside BEGIN..COMMIT
         self.failed: Optional[str] = None  # error text that poisoned the txn
+        # plan-cache serve state (dbs/plan_cache.py): per-execution slot
+        # bindings for a shared template AST (read by SlotLiteral.compute
+        # through ctx.executor), whether this execution was served warm,
+        # and the schema-generation token captured at statement start
+        # (plan artifacts installed under a stale token are refused)
+        self.slot_values: Optional[tuple] = None
+        self.cache_warm = False
+        self.plan_gen: Optional[tuple] = None
+        self._ddl_open: List[tuple] = []  # DDL brackets held to COMMIT/CANCEL
         self._buffered: List[dict] = []  # responses inside the explicit txn
         self._notifications: List[Any] = []
 
@@ -77,12 +90,23 @@ class Executor:
             self.txn.commit()
             self._flush_notifications()
         self.txn = None
+        self._close_ddl_brackets()
 
     def _cancel(self) -> None:
         if self.txn is not None and not self.txn.done:
             self.txn.cancel()
         self.txn = None
         self._notifications = []
+        self._close_ddl_brackets()
+
+    def _close_ddl_brackets(self) -> None:
+        """Release plan-cache DDL brackets held across an explicit txn
+        (the schema change is now committed or cancelled either way)."""
+        if self._ddl_open:
+            pc = self.ds.plan_cache
+            for ns, db in self._ddl_open:
+                pc.ddl_end(ns, db)
+            self._ddl_open = []
 
     # ------------------------------------------------------------ notifications
     def buffer_notification(self, n) -> None:
@@ -210,12 +234,33 @@ class Executor:
         # the iterator's rows-scanned scratch, flushed below
         atok = accounting.activate(self.session.ns, self.session.db)
         tally0 = accounting.tally_begin()
+        # plan cache: capture the schema-generation token this statement
+        # plans under; DDL brackets itself so artifacts raced against a
+        # concurrent schema change can never install (dbs/plan_cache.py)
+        pc = self.ds.plan_cache
+        ddl = isinstance(
+            stm,
+            (DefineStatement, RemoveStatement, AlterStatement,
+             RebuildStatement),
+        )
+        self.plan_gen = pc.gen_token(self.session.ns, self.session.db)
+        if ddl:
+            pc.ddl_begin(self.session.ns, self.session.db)
         try:
             resp = self._execute_statement(ctx, stm)
         finally:
             scanned = accounting.tally_end(tally0)
             accounting.deactivate(atok)
             stats.deactivate(tok)
+            if ddl:
+                if self.explicit:
+                    # the schema change lands at COMMIT (or dies at
+                    # CANCEL): hold the bracket open until then
+                    self._ddl_open.append(
+                        (self.session.ns, self.session.db)
+                    )
+                else:
+                    pc.ddl_end(self.session.ns, self.session.db)
         dt = time.perf_counter() - t0
         cpu_s = time.thread_time() - cpu0
         # drained ONCE per statement: the stats record and the slow-query

@@ -117,34 +117,64 @@ def select_compute(ctx, stm) -> Any:
                 detail["plan_notes"] = notes
             return plan + [{"operation": "Execute", "detail": detail}]
 
-        from surrealdb_tpu.ml.exec import try_columnar_ml_scan
+        # plan-cache dispatch skeleton (dbs/plan_cache.py): when this
+        # statement IS a cached template, start the front ladder at the
+        # front that resolved it cold — the ones before it declined on
+        # shape and need not re-check. front_for validated the route
+        # (generation, epoch, tenant scope, periodic revalidation); a
+        # cached front that now declines just continues down the ladder.
+        from surrealdb_tpu.dbs.plan_cache import active_plan_cache
 
-        fast = try_columnar_ml_scan(c, stm, sources)
-        if fast is not None:
-            return _only(stm, fast)
+        pc = active_plan_cache(c)
+        front = pc.front_for(c, stm) if pc is not None else None
+        start_at = {"ml": 0, "count": 1, "pipeline": 2, "plan": 3}.get(
+            front or "ml", 0
+        )
+
+        if start_at <= 0:
+            from surrealdb_tpu.ml.exec import try_columnar_ml_scan
+
+            fast = try_columnar_ml_scan(c, stm, sources)
+            if fast is not None:
+                if pc is not None:
+                    pc.note_front(c, stm, "ml")
+                return _only(stm, fast)
 
         # filtered count over a mirrored table: one mask popcount, no
         # documents (idx/column_mirror.py; exact per-row fallback inside)
-        from surrealdb_tpu.idx.column_mirror import try_columnar_count
+        if start_at <= 1:
+            from surrealdb_tpu.idx.column_mirror import try_columnar_count
 
-        fast = try_columnar_count(c, stm, sources)
-        if fast is not None:
-            return _only(stm, fast)
+            fast = try_columnar_count(c, stm, sources)
+            if fast is not None:
+                if pc is not None:
+                    pc.note_front(c, stm, "count")
+                return _only(stm, fast)
 
         # whole-pipeline columnar lowering (ops/pipeline.py): ORDER BY +
         # START/LIMIT as mask -> argsort/top-k, GROUP BY aggregates as
         # factorize + segment-reduce, plain projections read off the
         # columns — declines (counted) keep the planner/row path
-        if len(sources) == 1 and isinstance(sources[0], ITable):
+        if start_at <= 2 and len(sources) == 1 and isinstance(
+            sources[0], ITable
+        ):
             from surrealdb_tpu.ops.pipeline import run_pipeline
 
             res = run_pipeline(c, stm, sources[0].tb)
             if res is not None:
+                if pc is not None:
+                    pc.note_front(c, stm, "pipeline")
                 return _only(stm, res[0])
+            if front == "pipeline" and pc is not None:
+                # the cached pipeline route was declined downstream (the
+                # mirror said no): re-resolve cold from here on
+                pc.drop_route(c, stm, "mirror")
 
         from surrealdb_tpu.idx.planner import plan_sources
 
         sources = plan_sources(c, stm, sources)
+        if pc is not None:
+            pc.note_front(c, stm, "plan")
 
         from surrealdb_tpu.dbs.iterator import IIndex
         from surrealdb_tpu.idx.planner import OrderPushdownBailout

@@ -60,6 +60,8 @@ KINDS: Dict[str, str] = {
     "cluster.tombstone_gc": "expired tombstones swept after a clean repair pass",
     # workload statistics plane
     "stats.plan_flip": "a statement fingerprint's primary plan decision flipped",
+    # plan & pipeline cache (dbs/plan_cache.py)
+    "plan_cache.evict": "a cached plan was evicted (plan flip / DDL / epoch / capacity)",
     # tenant accounting plane
     "tenant.budget_exceeded": "a tenant crossed a soft budget limit (observe-only)",
     # advisor plane (observe->propose; nothing is ever applied)

@@ -141,6 +141,9 @@ class IndexBuilder:
 
             self._flip_status(key, session, ns, db, tb, name, "ready")
             self._set(key, status="ready", count=count, finished=time.time())
+            # the index just became servable: cached plans (and prefetched
+            # index defs) that planned without it are now stale
+            self.ds.plan_cache.bump_generation(ns, db)
         except Exception as e:  # surface failures through INFO — both
             # the live status and the persisted def (so a stuck 'building'
             # never lies about an aborted build)

@@ -268,6 +268,9 @@ def plan_sources(ctx, stm, sources: List[Any]) -> List[Any]:
     from surrealdb_tpu import telemetry
 
     out: List[Any] = []
+    import time as _time
+
+    t0 = _time.perf_counter()
     with telemetry.span("plan"):
         for s in sources:
             if not isinstance(s, ITable):
@@ -303,6 +306,19 @@ def plan_sources(ctx, stm, sources: List[Any]) -> List[Any]:
                     }
                 telemetry.note_plan(note)
                 out.append(IIndex(s.tb, plan))
+    # plan-cache pre-kernel accounting: planner time per fingerprint,
+    # warm (template served from cache) vs cold
+    from surrealdb_tpu.dbs.plan_cache import active_plan_cache
+
+    pc = active_plan_cache(ctx)
+    if pc is not None:
+        from surrealdb_tpu import stats as _stats
+
+        pc.note_plan_time(
+            _stats.active_fingerprint(),
+            (_time.perf_counter() - t0) * 1e6,
+            bool(getattr(getattr(ctx, "executor", None), "cache_warm", False)),
+        )
     return out
 
 
@@ -319,8 +335,18 @@ def build_plan(ctx, stm, tb: str, with_) -> Optional[Any]:
 
 def _build_index_plan(ctx, stm, tb: str, with_) -> Optional[Any]:
     ns, db = ctx.ns_db()
-    txn = ctx.txn()
-    indexes = txn.all_tb_indexes(ns, db, tb)
+    # plan-cache schema prefetch: the raw index-def probe for this table
+    # is generation-stamped, so hot statements skip the per-execution KV
+    # scan (DDL and the builder's ready flip bump the generation)
+    from surrealdb_tpu.dbs.plan_cache import active_plan_cache
+
+    pc = active_plan_cache(ctx)
+    indexes = pc.index_defs_for(ctx, ns, db, tb) if pc is not None else None
+    if indexes is None:
+        txn = ctx.txn()
+        indexes = txn.all_tb_indexes(ns, db, tb)
+        if pc is not None:
+            pc.install_index_defs(ctx, ns, db, tb, indexes)
     # an index mid-build (CONCURRENTLY) must not serve reads yet
     indexes = [ix for ix in indexes if ix.get("status", "ready") == "ready"]
     if not indexes:

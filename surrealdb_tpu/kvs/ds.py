@@ -332,6 +332,13 @@ class Datastore:
         self.column_mirrors.bind_ds(self)
         # cross-query device dispatch coalescing (dbs/dispatch.py)
         self.dispatch = DispatchQueue()
+        # fingerprint-keyed plan & pipeline cache (dbs/plan_cache.py):
+        # hot statement shapes serve their template AST, dispatch
+        # skeleton, pipeline lowering, and planner schema prefetch
+        # without re-parsing or re-planning (validation-on-serve)
+        from surrealdb_tpu.dbs.plan_cache import PlanCache
+
+        self.plan_cache = PlanCache(self)
         # background index builds (DEFINE INDEX ... CONCURRENTLY)
         self.index_builder = IndexBuilder(self)
         # serializes backend commit + mirror-delta application so two
@@ -439,13 +446,41 @@ class Datastore:
         # The sql label is trace-only (tracing never feeds metric families,
         # so truncated statement text can't mint unbounded series).
         with tracing.request("execute", sql=text[:120]):
+            # plan-cache front: a hot shape serves its shared template AST
+            # (with this text's literal values bound as executor slots)
+            # and skips the parse entirely; cold parses are observed so
+            # the shape installs once it crosses the min-hits floor
+            served = self.plan_cache.fetch(text)
+            if served is not None:
+                return self.process(
+                    served.query,
+                    session or Session.owner(),
+                    vars,
+                    slot_values=served.slot_values,
+                    cache_warm=True,
+                )
+            t0 = _time.perf_counter()
             ast = parse_query(text)
+            self.plan_cache.observe(
+                text, ast, (_time.perf_counter() - t0) * 1e6
+            )
             return self.process(ast, session or Session.owner(), vars)
 
-    def process(self, ast, session, vars: Optional[Dict[str, Any]] = None) -> List[dict]:
+    def process(
+        self,
+        ast,
+        session,
+        vars: Optional[Dict[str, Any]] = None,
+        slot_values: Optional[tuple] = None,
+        cache_warm: bool = False,
+    ) -> List[dict]:
         from surrealdb_tpu.dbs.executor import Executor
 
         ex = Executor(self, session, vars or {})
+        # plan-cache slot bindings ride the per-query executor (every
+        # child Context shares it), never the shared template AST
+        ex.slot_values = slot_values
+        ex.cache_warm = cache_warm
         return ex.execute(ast)
 
     def compute(self, expr, session, vars: Optional[Dict[str, Any]] = None):

@@ -446,9 +446,10 @@ class SurrealHandler(BaseHTTPRequestHandler):
                         self.ds, limit=limit, fingerprint=fp, sort=sort
                     ),
                 )
-            return self._send(
-                200, _stats.statements(limit=limit, fingerprint=fp, sort=sort)
-            )
+            rows = _stats.statements(limit=limit, fingerprint=fp, sort=sort)
+            # plan-cache plane: annotate each shape with its cache state
+            # (cached? variants? which dispatch fronts serve warm?)
+            return self._send(200, self.ds.plan_cache.annotate(rows))
         if path == "/tenants":
             # tenant cost-attribution plane (accounting.py): per-(ns, db)
             # resource meters with per-fingerprint drill-down. Fingerprints

@@ -818,12 +818,51 @@ def analyze_select(ctx, stm, tb: str) -> Tuple[Optional[Lowering], Optional[str]
 # ------------------------------------------------------------------ execution
 def run_pipeline(ctx, stm, tb: str) -> Optional[Tuple[List[Any], dict]]:
     """Execute one fully-lowerable SELECT over the column mirror. Returns
-    (rows, stage notes) or None (decline — reason already counted)."""
-    low, reason = analyze_select(ctx, stm, tb)
+    (rows, stage notes) or None (decline — reason already counted).
+
+    When `stm` is a plan-cache template with a validated pipeline route,
+    the cached Lowering is served instead of re-running analyze_select:
+    the shape/order/projection resolution and the duplicate index probe
+    are skipped, and only the compiled mask program's CONSTANTS re-bind
+    against the live context (predicates.CompiledPredicate.rebind)."""
+    from surrealdb_tpu import stats as _stats
+    from surrealdb_tpu.dbs.plan_cache import active_plan_cache
+
+    pc = active_plan_cache(ctx)
+    cached = pc.lowering_for(ctx, stm) if pc is not None else None
+    t0 = _time.perf_counter()
+    low = None
+    if cached is not None:
+        low = cached
+        if low.compiled is not None:
+            rb = low.compiled.rebind(ctx)
+            if rb is None:
+                # a re-derived constant fell outside the lowerable
+                # fragment: this serve must re-analyze cold
+                low = cached = None
+            else:
+                low = Lowering(low.shape, low.specs, low.proj, rb, low.cond)
+    warm = bool(getattr(getattr(ctx, "executor", None), "cache_warm", False))
     if low is None:
-        if reason is not None:
-            _outcome(reason)
-        return None
+        low, reason = analyze_select(ctx, stm, tb)
+        if pc is not None:
+            pc.note_plan_time(
+                _stats.active_fingerprint(),
+                (_time.perf_counter() - t0) * 1e6,
+                warm,
+            )
+        if low is None:
+            if reason is not None:
+                _outcome(reason)
+            return None
+        if pc is not None:
+            pc.install_pipeline(ctx, stm, low)
+    else:
+        pc.note_plan_time(
+            _stats.active_fingerprint(),
+            (_time.perf_counter() - t0) * 1e6,
+            warm,
+        )
     shape, specs, ordered_proj = low.shape, low.specs, low.proj
     compiled, cond = low.compiled, low.cond
 

@@ -76,12 +76,16 @@ class _Node:
 
 
 class _Leaf(_Node):
-    __slots__ = ("path", "op", "const")
+    # `src` is the constant's SOURCE expression (Literal / Param / ArrayLit)
+    # so a cached predicate program can re-derive `const` per execution
+    # (rebind below) — the program is reusable, the mask content is not
+    __slots__ = ("path", "op", "const", "src")
 
-    def __init__(self, path: str, op: str, const: Any):
+    def __init__(self, path: str, op: str, const: Any, src: Optional[Expr] = None):
         self.path = path
-        self.op = op  # one of _CMP_OPS, "in", "truthy"
+        self.op = op  # one of _CMP_OPS, "in", "truthy", "contains"
         self.const = const
+        self.src = src
 
 
 class _Bool(_Node):
@@ -106,6 +110,19 @@ class CompiledPredicate:
         self.root = root
         self.paths = paths
         self.source = source
+
+    def rebind(self, ctx) -> Optional["CompiledPredicate"]:
+        """A fresh predicate with every leaf constant RE-derived from its
+        source expression under `ctx` — the plan cache's per-execution
+        binding step: the compiled program (tree shape, paths, ops) is
+        reused, the constants ($params, literal slots) are not. Returns a
+        new instance (cached programs are shared across threads; rebinding
+        in place would race) or None when a re-derived constant falls
+        outside the lowerable fragment (caller re-plans cold)."""
+        root = _rebind_node(ctx, self.root)
+        if root is None:
+            return None
+        return CompiledPredicate(root, self.paths, self.source)
 
     def evaluate(self, columns) -> Tuple[np.ndarray, np.ndarray]:
         """columns: {path: Column} covering self.paths (idx/column_mirror)."""
@@ -166,7 +183,7 @@ def _compile_node(ctx, e: Expr, paths: Set[str]) -> Optional[_Node]:
             if len(path.split(".")) > _depth_limit():
                 return None
             paths.add(path)
-            leaf = _Leaf(path, "contains", item)
+            leaf = _Leaf(path, "contains", item, src=e.r)
             if op in ("CONTAINSNOT", "∌"):
                 return _Bool("not", [leaf])
             return leaf
@@ -183,7 +200,7 @@ def _compile_node(ctx, e: Expr, paths: Set[str]) -> Optional[_Node]:
             if len(path.split(".")) > _depth_limit():
                 return None
             paths.add(path)
-            leaf = _Leaf(path, "in", list(items))
+            leaf = _Leaf(path, "in", list(items), src=e.r)
             if op in ("NOT IN", "NOTINSIDE", "∉"):
                 return _Bool("not", [leaf])
             return leaf
@@ -209,10 +226,10 @@ def _cmp_leaf(ctx, e: BinaryOp, paths: Set[str]) -> Optional[_Leaf]:
 
     op = e.op
     if isinstance(e.l, Idiom) and _is_const(e.r):
-        path, const = _lower_path(e.l), _const_value(ctx, e.r)
+        path, const, src = _lower_path(e.l), _const_value(ctx, e.r), e.r
     elif isinstance(e.r, Idiom) and _is_const(e.l):
         flip = {"=": "=", "!=": "!=", "<": ">", "<=": ">=", ">": "<", ">=": "<="}
-        path, const, op = _lower_path(e.r), _const_value(ctx, e.l), flip[op]
+        path, const, op, src = _lower_path(e.r), _const_value(ctx, e.l), flip[op], e.l
     else:
         return None
     if path is None or not _scalar_const(const):
@@ -220,7 +237,7 @@ def _cmp_leaf(ctx, e: BinaryOp, paths: Set[str]) -> Optional[_Leaf]:
     if len(path.split(".")) > _depth_limit():
         return None
     paths.add(path)
-    return _Leaf(path, op, const)
+    return _Leaf(path, op, const, src=src)
 
 
 def _lower_path(e) -> Optional[str]:
@@ -260,6 +277,36 @@ def _scalar_const(v) -> bool:
     if isinstance(v, Datetime):
         return True
     return False
+
+
+def _rebind_node(ctx, n: _Node) -> Optional[_Node]:
+    """Clone a compiled node tree with leaf constants re-derived from their
+    source expressions. The same validation compile applied runs again: a
+    $param that was a scalar last execution may be an object this one."""
+    if isinstance(n, _Bool):
+        kids = []
+        for k in n.kids:
+            rk = _rebind_node(ctx, k)
+            if rk is None:
+                return None
+            kids.append(rk)
+        return _Bool(n.op, kids)
+    assert isinstance(n, _Leaf)
+    if n.src is None:  # truthy leaves carry no constant
+        return _Leaf(n.path, n.op, n.const, src=None)
+    const = _const_value(ctx, n.src)
+    if n.op == "in":
+        if not isinstance(const, (list, tuple)):
+            return None
+        if any(not _scalar_const(x) for x in const):
+            return None
+        const = list(const)
+    elif n.op == "contains":
+        if not (isinstance(const, str) and type(const) is str):
+            return None
+    elif not _scalar_const(const):
+        return None
+    return _Leaf(n.path, n.op, const, src=n.src)
 
 
 # ------------------------------------------------------------------ evaluate

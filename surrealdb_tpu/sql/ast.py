@@ -73,6 +73,27 @@ class Literal(Expr):
         return isinstance(other, Literal) and value_eq(self.value, other.value)
 
 
+class SlotLiteral(Literal):
+    """A literal parameterized by the plan cache (dbs/plan_cache.py): slot
+    `i` of the statement shape's literal-token sequence. A cached template
+    AST is SHARED across executions of every same-fingerprint text, so the
+    active execution's values ride the per-query Executor (set by the
+    datastore before process()), never this node — `value` keeps the
+    first-seen text's literal as the unbound default (repr/explain)."""
+
+    __slots__ = ("slot",)
+
+    def __init__(self, slot: int, value):
+        super().__init__(value)
+        self.slot = slot
+
+    def compute(self, ctx):
+        sv = getattr(ctx.executor, "slot_values", None)
+        if sv is not None and self.slot < len(sv):
+            return sv[self.slot]
+        return self.value
+
+
 class ArrayLit(Expr):
     __slots__ = ("items",)
 

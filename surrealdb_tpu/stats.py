@@ -442,6 +442,13 @@ def record(
             sql=text[:120],
             **{"from": flip[0], "to": flip[1]},
         )
+        # a flipped primary means every cached plan decision for this
+        # shape is suspect: evict the fingerprint's plan-cache entry
+        # (dbs/plan_cache.py; also outside the store lock — the plan
+        # cache's own lock is a peer level-85 leaf and must not nest)
+        from surrealdb_tpu.dbs import plan_cache as _plan_cache
+
+        _plan_cache.on_plan_flip(fp)
 
 
 def _note_evictions(n: int) -> None:

@@ -2277,6 +2277,46 @@ def parse_query(text: str) -> S.Query:
         raise ParseError("query is too deeply nested") from None
 
 
+# The plan cache's token vocabulary (dbs/plan_cache.py). SIGNATURE kinds
+# are every token whose VALUE the statement fingerprint erases or folds
+# (stats._normalize): literals erase to "?", params to "$?", keyword
+# identifiers case-fold. Two same-fingerprint texts can therefore differ
+# ONLY at these positions — operators are preserved verbatim by the
+# fingerprint, so they can never differ. BINDABLE kinds are the subset
+# whose converted value is exactly what an ast.Literal node would hold,
+# i.e. the ones a cached template can re-bind per execution; the rest
+# (idents, param names, regexes) must match the template verbatim.
+SIGNATURE_TOKEN_KINDS = frozenset(
+    {"IDENT", "PARAM", "NUMBER", "STRING", "DURATION",
+     "DATETIME", "UUID", "BYTES", "REGEX", "SCRIPT"}
+)
+BINDABLE_TOKEN_KINDS = frozenset(
+    {"NUMBER", "STRING", "DURATION", "DATETIME", "UUID", "BYTES"}
+)
+
+
+def lex_literal_slots(text: str) -> Optional[Tuple[Tuple[str, ...], Tuple[Any, ...]]]:
+    """The plan cache's lex-only front (dbs/plan_cache.py): tokenize one
+    statement and return the (kinds, values) sequence of its SIGNATURE
+    tokens in source order, or None when the text does not lex. A warm
+    serve of a new same-fingerprint text pays THIS instead of a full
+    parse — bindable values slot into the cached template AST, everything
+    else is compared verbatim against the template's signature."""
+    try:
+        tokens = lex(text)
+    except (ParseError, RecursionError):
+        return None
+    kinds: List[str] = []
+    values: List[Any] = []
+    for t in tokens:
+        if t.kind == "EOF":
+            break
+        if t.kind in SIGNATURE_TOKEN_KINDS:
+            kinds.append(t.kind)
+            values.append(t.value)
+    return tuple(kinds), tuple(values)
+
+
 def parse_expr_text(text: str) -> A.Expr:
     try:
         p = Parser(text)

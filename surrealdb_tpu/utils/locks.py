@@ -114,6 +114,13 @@ HIERARCHY: Dict[str, int] = {
                                # releases; breach events/counters emit
                                # AFTER release (events/telemetry are
                                # LOWER levels and must never nest inside)
+    "plan_cache.store": 85,    # plan & pipeline cache (dbs/plan_cache.py):
+                               # leaf-style — lookups/installs mutate the
+                               # entry LRU and release; eviction events
+                               # and counters emit AFTER release (events/
+                               # telemetry are LOWER levels and must never
+                               # nest inside); never nests with the other
+                               # level-85 observability leaves
     "advisor.store": 85,       # advisor proposal store (advisor.py):
                                # leaf-style — propose() mutates and
                                # releases; proposal/expired events and
