@@ -63,7 +63,7 @@ import time
 import numpy as np
 
 SCALE = float(os.environ.get("SURREAL_BENCH_SCALE", "1.0"))
-CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9,10,11,12").split(","))
+CONFIGS = set(os.environ.get("SURREAL_BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9,10,11,12,13").split(","))
 ROUND = os.environ.get("SURREAL_BENCH_ROUND", "r10")
 OUT_PATH = os.environ.get(
     "SURREAL_BENCH_OUT",
@@ -168,7 +168,19 @@ PROFILE = "--profile" in sys.argv[1:] or os.environ.get("SURREAL_PROFILE") == "1
 # assumed) with the warm hit rate and the cold-vs-warm pre-kernel split
 # whose >=2x floor scripts/bench_gate.py enforces on config 2. The
 # embedded bundle is surrealdb-tpu-bundle/9 (section 16 `plan_cache`).
-SCHEMA = "surrealdb-tpu-bench/15"
+# schema/16 (r20, C1M network plane): new config 13 `c1m_net` — the
+# event-loop ingress at connection scale: >=20k idle in-process
+# connections attached (per-connection memory measured under
+# tracemalloc), then >=2k active connections each completing an HTTP
+# request with ZERO errors (accept-to-first-byte p50/p99 from the
+# loop's own TTFB ring), then the per-tenant weighted-fair QoS proof —
+# a victim tenant's fixed battery timed solo and again under an
+# abusive tenant's sustained flood (quota-capped, bounded admission
+# queue): the victim's contended p99 must stay within bench_gate's 3x
+# ceiling while the abuser's overflow is SHED (counted 503s, never
+# unbounded buffering). The embedded bundle is surrealdb-tpu-bundle/10
+# (section 17 `net`: live servers + admission/QoS state).
+SCHEMA = "surrealdb-tpu-bench/16"
 
 D = 768
 NI = max(int(1_000_000 * SCALE), 1024)  # item corpus (configs 2/4/5)
@@ -2377,6 +2389,179 @@ def bench_elastic(rng):
     return None  # a survival property, not a vs-CPU speedup
 
 
+def bench_c1m_net():
+    """Config 13 (schema/16): the C1M network plane at connection scale.
+
+    Three phases against a dedicated event-loop server (its own Datastore;
+    the corpus configs are irrelevant to ingress):
+      1. idle scale   — attach >= 20k in-memory connections (the loop's
+         virtual-conn path: the full ingress state machine minus the
+         kernel socket, because the container's hard RLIMIT_NOFILE caps
+         real fds at 20000) and measure per-connection memory under
+         tracemalloc.
+      2. active burst — >= 2k further connections each complete one HTTP
+         /sql request with the idle herd still attached; zero errors is a
+         validator rule, and the loop's own TTFB ring yields
+         accept-to-first-byte p50/p99.
+      3. QoS isolation — a victim tenant's fixed battery timed SOLO, then
+         again while an abusive tenant floods through a deliberately
+         tight quota (inflight 4, admission queue 8): the abuser's
+         overflow must be shed (counted 503s), and the victim's
+         contended p99 must stay within bench_gate's 3x-of-solo ceiling.
+    """
+    import threading
+    import tracemalloc
+
+    from surrealdb_tpu import cnf as _cnf
+    from surrealdb_tpu.net import qos as _qos
+    from surrealdb_tpu.net.server import serve
+
+    IDLE_N = 20_000
+    ACTIVE_N = 2_000
+    ACTIVE_TENANTS = 32  # spread: per-tenant load stays under default quotas
+
+    def req(body: str, ns: str) -> bytes:
+        payload = body.encode()
+        return (
+            f"POST /sql HTTP/1.1\r\nHost: bench\r\nsurreal-ns: {ns}\r\n"
+            f"surreal-db: app\r\nContent-Length: {len(payload)}\r\n\r\n"
+        ).encode() + payload
+
+    _qos.reset()
+    srv = serve(auth_enabled=False, port=0).start_background()
+    if not srv.loop_mode:
+        raise RuntimeError("c1m_net needs the event-loop ingress (SURREAL_NET_LOOP)")
+    loops = srv.netloop.loops
+    saved = (_cnf.NET_TENANT_INFLIGHT, _cnf.NET_ADMIT_QUEUE, _cnf.NET_TENANT_RATE)
+    try:
+        # ---- phase 1: idle connection scale + per-conn memory ----------
+        log(f"c1m_net: attaching {IDLE_N} idle connections (tracemalloc)")
+        tracemalloc.start()
+        m0, _ = tracemalloc.get_traced_memory()
+        idle = [loops[i % len(loops)].attach_virtual() for i in range(IDLE_N)]
+        m1, _ = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        per_conn_bytes = (m1 - m0) / IDLE_N
+
+        # ---- phase 2: active burst over the idle herd ------------------
+        log(f"c1m_net: active burst of {ACTIVE_N} connections")
+        active = [loops[i % len(loops)].attach_virtual() for i in range(ACTIVE_N)]
+        bufs = [b""] * ACTIVE_N
+        t0 = time.perf_counter()
+        for i, vc in enumerate(active):
+            vc.feed(req("RETURN 1;", f"ns{i % ACTIVE_TENANTS}"))
+        pending = set(range(ACTIVE_N))
+        deadline = time.time() + 180
+        while pending and time.time() < deadline:
+            for i in list(pending):
+                bufs[i] += active[i].take_output()
+                if b"HTTP/1.1 " in bufs[i]:
+                    pending.discard(i)
+            if pending:
+                time.sleep(0.002)
+        active_dt = time.perf_counter() - t0
+        errors = len(pending) + sum(
+            1 for i, b in enumerate(bufs) if i not in pending and b"HTTP/1.1 200" not in b
+        )
+        peak_conns = srv.netloop.total_conns()
+        ttfb = srv.netloop.ttfb_quantiles()
+        qos_after_active = _qos.snapshot()
+
+        # ---- phase 3: victim battery solo vs under an abusive tenant ---
+        def battery(vc, ns, n):
+            buf, times = b"", []
+            for j in range(n):
+                tq = time.perf_counter()
+                # a deterministic 2ms work floor: the isolation ratio then
+                # measures scheduling, not the noise floor of a no-op
+                vc.feed(req("RETURN sleep(2ms) OR 9;", ns))
+                while buf.count(b"HTTP/1.1 ") <= j:
+                    buf += vc.take_output()
+                    if time.perf_counter() - tq > 30:
+                        raise RuntimeError(f"victim request {j} stalled")
+                    time.sleep(0.0002)
+                times.append(time.perf_counter() - tq)
+            return times
+
+        log("c1m_net: victim battery solo")
+        solo = battery(loops[0].attach_virtual(), "victim", 100)
+
+        log("c1m_net: victim battery under abusive-tenant flood")
+        _cnf.NET_TENANT_INFLIGHT, _cnf.NET_ADMIT_QUEUE = 4, 8
+        stop = threading.Event()
+        abuse_fed = [0]
+        aconns = [loops[i % len(loops)].attach_virtual() for i in range(24)]
+
+        def abuse():
+            while not stop.is_set():
+                for vc in aconns:
+                    vc.feed(req("RETURN sleep(10ms) OR 1;", "abuser"))
+                    abuse_fed[0] += 1
+                stop.wait(0.01)
+
+        flood = threading.Thread(target=abuse)
+        flood.start()
+        time.sleep(0.3)  # let the flood saturate its quota + queue first
+        try:
+            contended = battery(loops[0].attach_virtual(), "victim", 100)
+        finally:
+            stop.set()
+            flood.join()
+
+        qos_final = _qos.snapshot()
+        by_tenant = {(t["ns"], t["db"]): t for t in qos_final["top"]}
+        abuser = by_tenant.get(("abuser", "app"), {})
+        victim = by_tenant.get(("victim", "app"), {})
+        solo_p = _pcts(solo)
+        cont_p = _pcts(contended)
+        ratio = (
+            round(cont_p["p99"] / solo_p["p99"], 2)
+            if solo_p["p99"] and cont_p["p99"]
+            else None
+        )
+        emit(
+            {
+                "metric": f"c1m_net_{IDLE_N + ACTIVE_N}conns",
+                "value": round(ACTIVE_N / active_dt, 1),
+                "unit": "req/s",
+                "vs_baseline": None,
+                "net": {
+                    "loops": len(loops),
+                    "idle_conns": IDLE_N,
+                    "active_conns": ACTIVE_N,
+                    "peak_open_conns": peak_conns,
+                    "errors": errors,
+                    "per_conn_bytes": round(per_conn_bytes, 1),
+                    "accept_to_first_byte": ttfb,
+                    "active_qos": {
+                        "admitted": qos_after_active["totals"]["admitted"],
+                        "shed": qos_after_active["totals"]["shed"],
+                    },
+                    "victim": {
+                        "solo_ms": solo_p,
+                        "contended_ms": cont_p,
+                        "p99_ratio": ratio,
+                        "admitted": victim.get("admitted"),
+                        "shed": victim.get("shed", 0),
+                    },
+                    "abuser": {
+                        "fed": abuse_fed[0],
+                        "admitted": abuser.get("admitted", 0),
+                        "shed": abuser.get("shed", 0),
+                        "throttled": abuser.get("throttled", 0),
+                    },
+                    "qos_totals": qos_final["totals"],
+                },
+            }
+        )
+        del idle, active, aconns
+        return None
+    finally:
+        _cnf.NET_TENANT_INFLIGHT, _cnf.NET_ADMIT_QUEUE, _cnf.NET_TENANT_RATE = saved
+        srv.shutdown()
+        _qos.reset()
+
+
 def bench_ml_scan(ds, s, rng):
     from surrealdb_tpu.ml.exec import import_model
 
@@ -2539,6 +2724,11 @@ def main() -> None:
         run_cfg("6", lambda: bench_filtered_scan(ds, s))
     if "9" in CONFIGS:
         run_cfg("9", lambda: bench_ordered_agg(ds, s))
+    if "13" in CONFIGS:
+        # after at least one corpus ingest so the line's run-cumulative
+        # ingest_rate_rows_s stays a positive schema/7 fact
+        need_corpus()
+        run_cfg("13", lambda: bench_c1m_net())
     if "4" in CONFIGS:
         ingest_hybrid_edges(ds, s, rng)
         wait_ann_ready(ds)

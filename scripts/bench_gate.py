@@ -54,6 +54,11 @@ Env knobs:
                                    the gate re-measures µs-scale parse
                                    timings on whatever container it runs
                                    on; tighten via the env knob)
+    SURREAL_BENCH_GATE_NET_VICTIM_RATIO  config-13 victim-tenant contended
+                                   p99 ceiling as a multiple of its solo
+                                   p99 (default 3.0 — the C1M QoS
+                                   isolation bar: an abusive tenant's
+                                   flood may cost the victim at most 3x)
     SURREAL_BENCH_GATE_TIMEOUT     whole-run timeout seconds (default 1200)
 
 Exit code 0 = gate passed; 1 = gate failed (reasons on stderr).
@@ -118,6 +123,13 @@ PLAN_CACHE_HIT_FLOOR = float(
 PLAN_CACHE_WARM_COST_RATIO = float(
     os.environ.get("SURREAL_BENCH_GATE_PLAN_CACHE_WARM_RATIO", "0.7")
 )
+# C1M network plane (schema/16): the victim tenant's p99 under an abusive
+# tenant's flood must stay within this multiple of its solo p99, the
+# active burst must complete error-free, and the abuser's overflow must
+# have been shed (pushed back on, not buffered)
+NET_VICTIM_RATIO = float(
+    os.environ.get("SURREAL_BENCH_GATE_NET_VICTIM_RATIO", "3.0")
+)
 TIMEOUT = int(os.environ.get("SURREAL_BENCH_GATE_TIMEOUT", "1200"))
 
 
@@ -127,7 +139,7 @@ def main() -> int:
     env.update(
         {
             "SURREAL_BENCH_SCALE": SCALE,
-            "SURREAL_BENCH_CONFIGS": "2,6,8,9,10",
+            "SURREAL_BENCH_CONFIGS": "2,6,8,9,10,13",
             "SURREAL_BENCH_ROUND": "gate",
             "SURREAL_BENCH_OUT": out,
         }
@@ -442,8 +454,54 @@ def main() -> int:
         if any(perrs.values()):
             failures.append(f"ordered_agg errors != 0: {perrs}")
 
+    # ---- config 13: C1M network-plane floors (schema/16) --------------
+    net_summary = None
+    net_line = next(
+        (
+            r
+            for r in art["results"]
+            if str(r.get("config")) == "13"
+            and str(r.get("metric", "")).startswith("c1m_net")
+        ),
+        None,
+    )
+    if net_line is None:
+        failures.append("no config-13 c1m_net line in artifact")
+    else:
+        net = net_line.get("net") or {}
+        net_summary = {
+            "idle_conns": net.get("idle_conns"),
+            "active_conns": net.get("active_conns"),
+            "per_conn_bytes": net.get("per_conn_bytes"),
+            "accept_to_first_byte": net.get("accept_to_first_byte"),
+            "victim": net.get("victim"),
+            "abuser_shed": (net.get("abuser") or {}).get("shed"),
+        }
+        # re-check the validator's hard rules, then the gate-only ceiling
+        if net.get("errors") != 0:
+            failures.append(f"c1m_net active-burst errors {net.get('errors')} != 0")
+        vic = net.get("victim") or {}
+        ratio = vic.get("p99_ratio")
+        if ratio is None:
+            failures.append("c1m_net carries no victim p99_ratio measurement")
+        elif ratio > NET_VICTIM_RATIO:
+            failures.append(
+                f"victim-tenant contended p99 is {ratio}x its solo p99 > "
+                f"ceiling {NET_VICTIM_RATIO}x — the abusive tenant broke "
+                "through the weighted-fair admission plane"
+            )
+        if vic.get("shed"):
+            failures.append(
+                f"victim tenant was shed {vic.get('shed')} time(s) under the flood"
+            )
+        if not (net.get("abuser") or {}).get("shed"):
+            failures.append(
+                "c1m_net abuser.shed == 0 — the flood was never pushed back on"
+            )
+
     summary = {
         "qps": qps,
+        "c1m_net": net_summary,
         "profiler_overhead_pct": overhead,
         "advisor_overhead_pct": adv_overhead,
         "recall_at_10": recall,

@@ -23,7 +23,7 @@ import os
 import sys
 from typing import List
 
-SCHEMA = "surrealdb-tpu-bench/15"
+SCHEMA = "surrealdb-tpu-bench/16"
 # earlier rounds' committed artifacts stay validatable under their own rules
 KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/1",
@@ -40,6 +40,7 @@ KNOWN_SCHEMAS = (
     "surrealdb-tpu-bench/12",
     "surrealdb-tpu-bench/13",
     "surrealdb-tpu-bench/14",
+    "surrealdb-tpu-bench/15",
     SCHEMA,
 )
 
@@ -171,6 +172,21 @@ PLAN_CACHE_PARITY_KEYS = (
     "prekernel_cold_us", "prekernel_warm_us", "speedup",
 )
 PLAN_CACHE_PARITY_CONFIGS = ("2", "6", "9")
+# schema/16 (C1M network plane): the config-13 line must carry the `net`
+# object proving CONNECTION scale (>= 20k idle attached, >= 2k active
+# each completing with ZERO errors — errors == 0 is a validity rule),
+# measured per-connection memory, the loop's accept-to-first-byte
+# quantiles, and the cross-tenant isolation evidence: the victim
+# tenant's solo + contended batteries with their p99 ratio, and the
+# abusive tenant's overflow visibly SHED (shed > 0 — a flood the QoS
+# plane never pushed back on proves nothing). /16 bundles (bundle/10)
+# must carry the `net` section (live servers + admission state).
+C1M_NET_KEYS = (
+    "loops", "idle_conns", "active_conns", "errors", "per_conn_bytes",
+    "accept_to_first_byte", "victim", "abuser", "qos_totals",
+)
+C1M_IDLE_FLOOR = 20_000
+C1M_ACTIVE_FLOOR = 2_000
 COMPILES_KEYS = ("on_demand", "prewarm", "events")
 BATCH_KEYS = ("submitted", "dispatches", "batched", "mean_width")
 BATCH_KEYS_V3 = BATCH_KEYS + ("width_dist", "pipeline_wait_s")
@@ -469,6 +485,97 @@ def _check_advisor_plane(where: str, metric: str, r: dict) -> List[str]:
     return problems
 
 
+def _check_net_plane(where: str, metric: str, r: dict) -> List[str]:
+    """The config-13 connection-scale contract (schema/16): >= 20k idle +
+    >= 2k active connections with zero errors, measured per-connection
+    memory, accept-to-first-byte quantiles from the loop's own ring, and
+    the weighted-fair isolation proof — victim batteries on both sides of
+    an abusive flood whose overflow was visibly shed."""
+    problems: List[str] = []
+    net = r.get("net")
+    if not isinstance(net, dict):
+        return [
+            f"{where} ({metric}): config-13 must carry the 'net' object "
+            "(connection scale + QoS isolation evidence)"
+        ]
+    for key in C1M_NET_KEYS:
+        if key not in net:
+            problems.append(f"{where} ({metric}): net missing {key!r}")
+    idle = net.get("idle_conns")
+    if not isinstance(idle, int) or idle < C1M_IDLE_FLOOR:
+        problems.append(
+            f"{where} ({metric}): net.idle_conns must be >= {C1M_IDLE_FLOOR} "
+            f"(got {idle!r}) — the window never reached connection scale"
+        )
+    act = net.get("active_conns")
+    if not isinstance(act, int) or act < C1M_ACTIVE_FLOOR:
+        problems.append(
+            f"{where} ({metric}): net.active_conns must be >= "
+            f"{C1M_ACTIVE_FLOOR} (got {act!r})"
+        )
+    if net.get("errors") != 0:
+        problems.append(
+            f"{where} ({metric}): net.errors must be 0 (got "
+            f"{net.get('errors')!r}) — an active connection failed its "
+            "request at scale"
+        )
+    pcb = net.get("per_conn_bytes")
+    if not isinstance(pcb, (int, float)) or pcb <= 0:
+        problems.append(
+            f"{where} ({metric}): net.per_conn_bytes must be a positive "
+            "tracemalloc measurement"
+        )
+    ttfb = net.get("accept_to_first_byte")
+    if not isinstance(ttfb, dict) or not isinstance(
+        ttfb.get("p99_ms"), (int, float)
+    ):
+        problems.append(
+            f"{where} ({metric}): net.accept_to_first_byte must carry "
+            "measured p50/p99 quantiles"
+        )
+    elif (ttfb.get("samples") or 0) < C1M_ACTIVE_FLOOR:
+        problems.append(
+            f"{where} ({metric}): accept_to_first_byte.samples "
+            f"{ttfb.get('samples')!r} < {C1M_ACTIVE_FLOOR} — the quantiles "
+            "do not cover the active burst"
+        )
+    vic = net.get("victim")
+    if not isinstance(vic, dict):
+        problems.append(f"{where} ({metric}): net.victim must be an object")
+    else:
+        for side in ("solo_ms", "contended_ms"):
+            obj = vic.get(side)
+            if not isinstance(obj, dict) or not isinstance(
+                obj.get("p99"), (int, float)
+            ):
+                problems.append(
+                    f"{where} ({metric}): victim.{side} must carry a "
+                    "measured p99"
+                )
+        if not isinstance(vic.get("p99_ratio"), (int, float)):
+            problems.append(
+                f"{where} ({metric}): victim.p99_ratio must be the measured "
+                "contended/solo quotient (bench_gate ceilings it)"
+            )
+        if vic.get("shed"):
+            problems.append(
+                f"{where} ({metric}): the victim tenant was shed "
+                f"{vic.get('shed')} time(s) — isolation failed in kind, "
+                "not just in degree"
+            )
+    ab = net.get("abuser")
+    if not isinstance(ab, dict) or not isinstance(ab.get("shed"), int):
+        problems.append(
+            f"{where} ({metric}): net.abuser must carry its shed count"
+        )
+    elif ab["shed"] <= 0:
+        problems.append(
+            f"{where} ({metric}): abuser.shed must be > 0 — a flood the "
+            "admission plane never pushed back on proves no isolation"
+        )
+    return problems
+
+
 def validate(path: str) -> List[str]:
     problems: List[str] = []
     try:
@@ -482,7 +589,8 @@ def validate(path: str) -> List[str]:
     if art.get("schema") not in KNOWN_SCHEMAS:
         problems.append(f"schema is {art.get('schema')!r}, expected one of {KNOWN_SCHEMAS}")
     schema = art.get("schema")
-    v15 = schema == SCHEMA
+    v16 = schema == SCHEMA
+    v15 = v16 or schema == "surrealdb-tpu-bench/15"
     v14 = v15 or schema == "surrealdb-tpu-bench/14"
     v13 = v14 or schema == "surrealdb-tpu-bench/13"
     v12 = v13 or schema == "surrealdb-tpu-bench/12"
@@ -513,6 +621,9 @@ def validate(path: str) -> List[str]:
         else:
             sections = (
                 BUNDLE_SECTIONS_V9
+                + ("statements", "profiler", "tenants", "advisor", "plan_cache", "net")
+                if v16
+                else BUNDLE_SECTIONS_V9
                 + ("statements", "profiler", "tenants", "advisor", "plan_cache")
                 if v15
                 else BUNDLE_SECTIONS_V9
@@ -901,6 +1012,8 @@ def validate(path: str) -> List[str]:
                         )
         if v14 and str(r.get("config")) == "12" and metric.startswith("advisor_shift"):
             problems.extend(_check_advisor_plane(where, metric, r))
+        if v16 and str(r.get("config")) == "13" and metric.startswith("c1m_net"):
+            problems.extend(_check_net_plane(where, metric, r))
         if v15:
             pcw = r.get("plan_cache")
             if not isinstance(pcw, dict):

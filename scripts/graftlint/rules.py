@@ -1225,3 +1225,73 @@ def gl015(modules: List[Module]) -> List[Finding]:
                 )
             )
     return out
+
+
+# ------------------------------------------------------------------ GL016
+# Event-loop-marked modules (module-level `EVENT_LOOP_MODULE = True`, e.g.
+# surrealdb_tpu/net/loop.py) multiplex 100k+ sockets on a handful of
+# threads: ONE blocking call stalls every connection the thread owns. Two
+# classes of finding inside a marked module:
+#   - blocking socket calls — `.recv()`, `.sendall()`, `.accept()` (and
+#     recv variants) anywhere except inside a `_nb_`-prefixed nonblocking
+#     wrapper function, which is where EAGAIN is actually handled;
+#   - `time.sleep` ANYWHERE — loop pacing belongs to selector timeouts
+#     and `Event.wait`, which a shutdown can interrupt; a sleep can't be.
+GL016_MARKER = "EVENT_LOOP_MODULE"
+GL016_BLOCKING = frozenset({"recv", "recv_into", "recvfrom", "sendall", "accept"})
+
+
+def _gl016_marked(m: Module) -> bool:
+    """True for modules declaring `EVENT_LOOP_MODULE = True` at top level."""
+    for node in m.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id == GL016_MARKER
+                    and isinstance(node.value, ast.Constant)
+                    and bool(node.value.value)
+                ):
+                    return True
+    return False
+
+
+@_rule("GL016", "blocking socket call / time.sleep in an event-loop module")
+def gl016(modules: List[Module]) -> List[Finding]:
+    out: List[Finding] = []
+    for m in modules:
+        if not _gl016_marked(m):
+            continue
+        sleep_direct = "sleep" in _from_imports(m, "time")
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            recv, attr = _call_name(node)
+            fn = m.enclosing_def(node) or ""
+            if attr in GL016_BLOCKING and not fn.split(".")[-1].startswith("_nb_"):
+                out.append(
+                    Finding(
+                        "GL016", m.rel, node.lineno, node.col_offset,
+                        f"blocking socket .{attr}() on an event-loop thread "
+                        "— one blocked call stalls every connection this "
+                        "loop owns; go through a `_nb_*` nonblocking "
+                        "wrapper that handles EAGAIN",
+                        f"GL016:{m.rel}:{fn}:{attr}",
+                    )
+                )
+            is_sleep = attr == "sleep" and (
+                (recv is not None and "time" in recv)
+                or (recv is None and sleep_direct)
+            )
+            if is_sleep:
+                out.append(
+                    Finding(
+                        "GL016", m.rel, node.lineno, node.col_offset,
+                        "time.sleep in an event-loop module — pace with "
+                        "selector timeouts or Event.wait (interruptible at "
+                        "shutdown); a sleeping loop thread is a stalled "
+                        "ingress",
+                        f"GL016:{m.rel}:{fn}:sleep",
+                    )
+                )
+    return out

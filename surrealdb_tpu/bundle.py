@@ -85,13 +85,13 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-BUNDLE_SCHEMA = "surrealdb-tpu-bundle/9"
+BUNDLE_SCHEMA = "surrealdb-tpu-bundle/10"
 
 # the sections every consumer may rely on
 SECTIONS = (
     "traces", "slow_queries", "errors", "tasks", "compiles", "engine",
     "locks", "faults", "events", "kernel_audit", "flow_audit",
-    "statements", "profiler", "tenants", "advisor", "plan_cache",
+    "statements", "profiler", "tenants", "advisor", "plan_cache", "net",
 )
 
 
@@ -136,8 +136,27 @@ def debug_bundle(
         "plan_cache": ds.plan_cache.snapshot()
         if ds is not None
         else {"enabled": False, "available": False},
+        "net": _net_state(),
     }
     return out
+
+
+def _net_state() -> Dict[str, Any]:
+    """The network plane: live event-loop servers (conn counts, accept-to-
+    first-byte quantiles) + the per-tenant weighted-fair admission state
+    (sheds/throttles per tenant — the first read in a noisy-neighbor
+    incident). Import is lazy and guarded: a bundle from a process that
+    never served a socket still gets a well-formed section."""
+    try:
+        from surrealdb_tpu.net import loop as _loop
+
+        return _loop.snapshot()
+    except Exception:  # noqa: BLE001 — a bundle section must never
+        # take down the whole diagnostic export
+        from surrealdb_tpu import telemetry
+
+        telemetry.inc("scrape_section_errors", section="net")
+        return {"enabled": False, "servers": [], "qos": {}}
 
 
 _flow_audit_cache: Optional[Dict[str, Any]] = None

@@ -182,7 +182,22 @@ class ClusterClient:
             # ThreadingHTTPServer log spurious ConnectionResetErrors
             hdrs = {"Content-Type": "application/cbor", "Connection": "close"}
             if self.config.secret:
-                hdrs["x-surreal-cluster-key"] = self.config.secret
+                from surrealdb_tpu.cluster.config import derive_node_key
+
+                # per-node derived credential, never the bare shared secret:
+                # the receiver recomputes HMAC(secret, node:epoch) from these
+                # two headers and constant-time-compares
+                epoch = 0
+                if self.epoch_provider is not None:
+                    try:
+                        epoch = int(self.epoch_provider())
+                    except Exception:  # noqa: BLE001 — membership not yet
+                        epoch = 0  # attached: epoch-1 boot credential
+                hdrs["x-surreal-cluster-node"] = self.config.node_id
+                hdrs["x-surreal-cluster-epoch"] = str(epoch)
+                hdrs["x-surreal-cluster-key"] = derive_node_key(
+                    self.config.secret, self.config.node_id, epoch
+                )
             if headers:
                 hdrs.update(headers)
             conn.request("POST", path, body=body, headers=hdrs)

@@ -16,15 +16,31 @@ membership at runtime through epoch-versioned join/leave/replace
       "secret": "shared-internal-secret"
     }
 
-`secret` authenticates the internal `/cluster` channel (every request
-carries it as `x-surreal-cluster-key`); operator/user auth still applies at
-the public ingress of whichever node coordinates.
+`secret` authenticates the internal `/cluster` channel — but it is NEVER
+sent on the wire. Each request carries a per-node derived key
+(`derive_node_key`: HMAC-SHA256 over `node_id:epoch` keyed by the secret)
+plus the `x-surreal-cluster-node`/`x-surreal-cluster-epoch` inputs it was
+derived from; the receiver recomputes and constant-time-compares. A
+captured header therefore exposes one node's one-epoch credential, not the
+cluster-wide secret a bare-secret header used to hand to any on-path
+observer, and rotation is as cheap as an epoch bump. Operator/user auth
+still applies at the public ingress of whichever node coordinates.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import json
 from typing import Any, Dict, List, Optional
+
+
+def derive_node_key(secret: str, node_id: str, epoch: Any) -> str:
+    """The per-node `/cluster` channel credential: HMAC-SHA256 keyed by the
+    shared secret over `"{node_id}:{epoch}"`, hex-encoded. Sender and
+    receiver both derive it; the shared secret itself stays off the wire."""
+    msg = f"{node_id}:{epoch}".encode()
+    return hmac.new(secret.encode(), msg, hashlib.sha256).hexdigest()
 
 
 class ClusterConfigError(ValueError):

@@ -408,5 +408,43 @@ WEBSOCKET_MAX_CONCURRENT_REQUESTS = _env_int(
     "SURREAL_WEBSOCKET_MAX_CONCURRENT_REQUESTS", 24
 )
 
+# C1M network plane (net/loop.py): selector-based event-loop ingress.
+# NET_LOOP picks the ingress: the nonblocking accept/read/write loop
+# multiplexing every HTTP + WS socket (default), or the legacy
+# thread-per-connection ThreadingHTTPServer (0; TLS always falls back —
+# nonblocking TLS handshakes are out of scope). NET_LOOPS shards sockets
+# across that many loops; NET_EXECUTORS bounds the worker pool that runs
+# fully-decoded requests (the loop itself never executes a statement).
+NET_LOOP = _env_bool("SURREAL_NET_LOOP", True)
+NET_LOOPS = _env_int("SURREAL_NET_LOOPS", 1)
+NET_EXECUTORS = _env_int("SURREAL_NET_EXECUTORS", 8)
+# Overload contracts — every bound sheds CLEANLY (counted close, never
+# unbounded memory): MAX_CONNS caps concurrently-open sockets (accepts
+# beyond it close immediately); HEADER_TIMEOUT bounds how long a
+# connection may dribble request headers (slowloris); WRITE_BUF_MAX caps
+# a connection's queued-unsent response bytes (a reader that never drains
+# gets a backpressure close); READ_SLACK is the header/framing allowance
+# on top of HTTP_MAX_BODY_SIZE for the per-connection read buffer.
+NET_MAX_CONNS = _env_int("SURREAL_NET_MAX_CONNS", 110_000)
+NET_HEADER_TIMEOUT_SECS = _env_float("SURREAL_NET_HEADER_TIMEOUT", 10.0)
+NET_WRITE_BUF_MAX = _env_int("SURREAL_NET_WRITE_BUF_MAX", 4 * 1024 * 1024)
+NET_READ_SLACK = _env_int("SURREAL_NET_READ_SLACK", 64 * 1024)
+# Per-tenant weighted-fair admission (net/qos.py): each (ns, db) gets a
+# token bucket (RATE tokens/s refill, BURST capacity; RATE=0 disables
+# rate limiting) and an in-flight quota; past either, requests queue
+# (up to ADMIT_QUEUE per tenant, then shed) and drain by deficit
+# round-robin — each round a tenant earns QUANTUM_MS of estimated
+# statement cost scaled by its weight (see net/qos.py:tenant_weight;
+# expensive tenants earn less). Internal cluster RPCs ride a dedicated
+# class with its own in-flight bound so scatter traffic can't be
+# starved by tenants.
+NET_QOS = _env_bool("SURREAL_NET_QOS", True)
+NET_TENANT_RATE = _env_float("SURREAL_NET_TENANT_RATE", 0.0)
+NET_TENANT_BURST = _env_float("SURREAL_NET_TENANT_BURST", 64.0)
+NET_TENANT_INFLIGHT = _env_int("SURREAL_NET_TENANT_INFLIGHT", 16)
+NET_ADMIT_QUEUE = _env_int("SURREAL_NET_ADMIT_QUEUE", 64)
+NET_QOS_QUANTUM_MS = _env_float("SURREAL_NET_QOS_QUANTUM_MS", 5.0)
+NET_INTERNAL_INFLIGHT = _env_int("SURREAL_NET_INTERNAL_INFLIGHT", 32)
+
 # Version of the storage format written by this build
 STORAGE_VERSION = 1

@@ -64,6 +64,12 @@ KINDS: Dict[str, str] = {
     "plan_cache.evict": "a cached plan was evicted (plan flip / DDL / epoch / capacity)",
     # tenant accounting plane
     "tenant.budget_exceeded": "a tenant crossed a soft budget limit (observe-only)",
+    # network plane (net/loop.py + net/qos.py)
+    "net.admission_shed": "per-tenant admission control shed a request",
+    "net.throttle": "a tenant hit its rate/in-flight quota and was queued",
+    "net.backpressure_close": "a connection's write queue overflowed its bound and was closed",
+    "net.overload_close": "ingress shed a connection (accept cap or header deadline)",
+    "cluster.auth_reject": "an internal /cluster request failed per-node key auth",
     # advisor plane (observe->propose; nothing is ever applied)
     "advisor.proposal": "the advisor registered a new evidence-chained proposal",
     "advisor.expired": "an advisor proposal's evidence decayed and it expired",

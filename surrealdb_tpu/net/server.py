@@ -600,10 +600,21 @@ class SurrealHandler(BaseHTTPRequestHandler):
             if secret:
                 import hmac as _hmac
 
-                # constant-time compare: this header is the ONLY gate on a
-                # system-privilege channel; `!=` short-circuits per byte
+                from surrealdb_tpu import events, telemetry
+                from surrealdb_tpu.cluster.config import derive_node_key
+
+                # per-node derived credential: recompute HMAC(secret,
+                # node:epoch) from the request's own derivation inputs and
+                # constant-time compare — the shared secret never rides the
+                # wire, so a captured header is one node's one-epoch
+                # credential, not cluster-wide system privilege
                 given = self.headers.get("x-surreal-cluster-key") or ""
-                if not _hmac.compare_digest(given, secret):
+                node = self.headers.get("x-surreal-cluster-node") or ""
+                epoch = self.headers.get("x-surreal-cluster-epoch") or "0"
+                expect = derive_node_key(secret, node, epoch)
+                if not given or not _hmac.compare_digest(given, expect):
+                    telemetry.inc("cluster_auth_rejects")
+                    events.emit("cluster.auth_reject", node=node)
                     return self._send(401, {"error": "bad cluster key"})
             from surrealdb_tpu.cluster import rpc as _cluster_rpc
             from surrealdb_tpu.rpc import cbor as _cbor
@@ -1100,11 +1111,41 @@ class SurrealHandler(BaseHTTPRequestHandler):
             alive["v"] = False
             pool.shutdown()
             telemetry.gauge_add("ws_connections", -1)
+            # disconnect sweep: KILL this connection's remaining live
+            # queries — every close/error path used to leak them into the
+            # notification hub forever
+            ctx.close()
         self.close_connection = True
 
 
+class _LoopHttpd:
+    """`httpd`-shaped facade over the event-loop ingress. Embedders (and
+    a decade of tests) reach through `server.httpd` for the bound handler
+    class (`.RequestHandlerClass.ds`) and abrupt teardown
+    (`.server_close()`); loop mode keeps both spellings working."""
+
+    def __init__(self, handler_cls, netloop):
+        self.RequestHandlerClass = handler_cls
+        self._netloop = netloop
+        self.server_address = (netloop.host, netloop.port)
+
+    def serve_forever(self) -> None:
+        self._netloop.serve_forever()
+
+    def shutdown(self) -> None:
+        self._netloop.shutdown()
+
+    def server_close(self) -> None:
+        self._netloop.server_close()
+
+
 class Server:
-    """Embedded server handle (reference: `surreal start`)."""
+    """Embedded server handle (reference: `surreal start`).
+
+    Ingress is the selector event loop (net/loop.py) unless
+    `SURREAL_NET_LOOP=0` or TLS is configured — TLS handshakes are
+    blocking per-socket work, so certificates keep the thread-per-
+    connection ingress (documented fallback, not a silent downgrade)."""
 
     def __init__(
         self,
@@ -1116,20 +1157,32 @@ class Server:
         tls_key: Optional[str] = None,
         cors_origins="*",
     ):
+        from surrealdb_tpu import cnf
+
         handler = type(
             "BoundHandler",
             (SurrealHandler,),
             {"ds": ds, "auth_enabled": auth_enabled, "cors_origins": cors_origins},
         )
-        self.httpd = ThreadingHTTPServer((host, port), handler)
         self.tls = bool(tls_cert)
-        if tls_cert:
-            # TLS termination (reference: surreal start --web-crt/--web-key)
-            import ssl
+        self.loop_mode = bool(cnf.NET_LOOP) and not tls_cert
+        if self.loop_mode:
+            from surrealdb_tpu.net.loop import EventLoopServer
 
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            ctx.load_cert_chain(tls_cert, tls_key or tls_cert)
-            self.httpd.socket = ctx.wrap_socket(self.httpd.socket, server_side=True)
+            self.netloop = EventLoopServer(handler, host, port)
+            self.httpd = _LoopHttpd(handler, self.netloop)
+        else:
+            self.netloop = None
+            self.httpd = ThreadingHTTPServer((host, port), handler)
+            if tls_cert:
+                # TLS termination (reference: surreal start --web-crt/--web-key)
+                import ssl
+
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                ctx.load_cert_chain(tls_cert, tls_key or tls_cert)
+                self.httpd.socket = ctx.wrap_socket(
+                    self.httpd.socket, server_side=True
+                )
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
         # node membership bootstrap (reference ds.rs:623): register this
@@ -1169,8 +1222,14 @@ class Server:
         return f"{scheme}://{self.host}:{self.port}"
 
     def start_background(self) -> "Server":
+        if self.netloop is not None:
+            # the loops ARE the background threads (bg:net_loop:N services)
+            self.netloop.start()
+            return self
         from surrealdb_tpu import bg
 
+        # detached accept loop: requests mint their own traces inside
+        # graftflow: disable=GF002
         self._thread = bg.spawn_service(
             "http_serve", f"{self.host}:{self.port}", self.httpd.serve_forever
         )

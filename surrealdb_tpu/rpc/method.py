@@ -53,6 +53,22 @@ class RpcContext:
         self.vars: Dict[str, Any] = {}
         self.live_ids: set = set()  # live queries owned by this connection
 
+    def close(self) -> None:
+        """Disconnect sweep: KILL every live query this connection still
+        owns. Without this, a WS close/error path leaves the registrations
+        live forever — the notification hub keeps buffering matches for a
+        subscriber that will never drain them (the r19 leak). Each kill is
+        independent: one failure (live id already archived by a node
+        takeover) must not strand the rest."""
+        from surrealdb_tpu import telemetry
+
+        ids, self.live_ids = list(self.live_ids), set()
+        for live_id in ids:
+            try:
+                self._query("KILL $_id", {"_id": _as_uuid(live_id)})
+            except Exception:  # noqa: BLE001 — already-dead registration
+                telemetry.inc("live_disconnect_kill_errors")
+
     # ------------------------------------------------------------ dispatch
     def execute(self, method: str, params: Optional[List[Any]] = None) -> Any:
         from surrealdb_tpu import telemetry, tracing

@@ -71,7 +71,13 @@ HIERARCHY: Dict[str, int] = {
     "idx.builder": 52,         # concurrent index-build status map
     "ml.cache": 54,            # loaded-model cache
     "iam.jwks": 56,            # JWKS fetch cache
+    "net.loop": 57,            # event-loop connection registry + per-conn
+                               # write queues (mutate-and-release; only the
+                               # observability leaves may nest inside)
     "notification.hub": 58,    # live-query channel map
+    "net.qos": 59,             # per-tenant admission queues + token buckets
+                               # (leaf-style: decision under the lock,
+                               # events/counters emit AFTER release)
     "sdk.ws_client": 60,       # SDK WS pending/notification maps
     "cluster.membership": 61,  # membership epoch + ring versions (snapshot-
                                # and-release: held for pure reads/installs,

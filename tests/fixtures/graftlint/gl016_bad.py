@@ -1,0 +1,39 @@
+"""GL016 fixture: blocking calls inside an event-loop-marked module."""
+
+import socket
+import time
+from time import sleep
+
+EVENT_LOOP_MODULE = True
+
+
+def drain(sock):
+    # blocking recv on the loop thread: every other socket this loop
+    # owns stalls until this one produces bytes
+    data = sock.recv(4096)
+    sock.sendall(b"ack")
+    return data
+
+
+def take_one(listener):
+    # blocking accept outside a _nb_ wrapper
+    conn, addr = listener.accept()
+    return conn, addr
+
+
+class Pump:
+    def tick(self, sock):
+        buf = bytearray(64)
+        sock.recv_into(buf)
+        # sleeping on a loop thread is a stalled ingress, and shutdown
+        # can't interrupt it the way it can an Event.wait
+        time.sleep(0.05)
+        sleep(0.01)
+        return buf
+
+
+def fine_elsewhere():
+    # non-socket, non-sleep calls are not findings
+    s = socket.socket()
+    s.setblocking(False)
+    return s

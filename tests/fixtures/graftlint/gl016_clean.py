@@ -1,0 +1,40 @@
+"""GL016 fixture twin: the sanctioned event-loop shapes.
+
+Blocking primitives live only inside `_nb_`-prefixed wrappers (where
+EAGAIN is handled), and pacing uses Event.wait — interruptible at
+shutdown — never time.sleep. An UNMARKED module may also call whatever
+it wants (see `unmarked_helper`-style modules: the rule only applies
+where EVENT_LOOP_MODULE = True).
+"""
+
+import threading
+
+EVENT_LOOP_MODULE = True
+
+
+def _nb_recv(sock, n):
+    try:
+        return sock.recv(n)
+    except (BlockingIOError, InterruptedError):
+        return None
+
+
+def _nb_accept(listener):
+    try:
+        return listener.accept()
+    except (BlockingIOError, InterruptedError):
+        return None
+
+
+def _nb_send_some(sock, view):
+    try:
+        return sock.send(view)
+    except (BlockingIOError, InterruptedError):
+        return 0
+
+
+def pump(stop: threading.Event, sock):
+    while not stop.wait(0.02):
+        data = _nb_recv(sock, 4096)
+        if data:
+            _nb_send_some(sock, data)

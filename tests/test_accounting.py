@@ -437,7 +437,7 @@ def test_info_for_root_and_bundle_section(ds):
     assert any(e["ns"] == "t" for e in info["system"]["tenants"])
     from surrealdb_tpu.bundle import BUNDLE_SCHEMA, debug_bundle
 
-    assert BUNDLE_SCHEMA == "surrealdb-tpu-bundle/9"
+    assert BUNDLE_SCHEMA == "surrealdb-tpu-bundle/10"
     b = debug_bundle(ds)
     assert b["tenants"]["tenants"] >= 1 and b["tenants"]["top"]
     assert "global" in b["tenants"]

@@ -260,11 +260,11 @@ def test_federated_metrics_relabels_every_node(cluster2):
 def test_federated_bundle_marks_dead_node_unreachable(cluster2, monkeypatch):
     seed_items(cluster2, n=24)
     fb = federated_bundle(cluster2.coord)
-    assert fb["schema"] == "surrealdb-tpu-bundle/9" and fb["cluster"] is True
+    assert fb["schema"] == "surrealdb-tpu-bundle/10" and fb["cluster"] is True
     assert fb["coordinator"] == "n1" and set(fb["nodes"]) == {"n1", "n2"}
     for nid in ("n1", "n2"):
         b = fb["nodes"][nid]
-        assert b.get("schema") == "surrealdb-tpu-bundle/9"
+        assert b.get("schema") == "surrealdb-tpu-bundle/10"
         assert "events" in b and "traces" in b and "engine" in b
 
     monkeypatch.setattr(cnf, "CLUSTER_RPC_TIMEOUT_SECS", 1.5)
@@ -274,7 +274,7 @@ def test_federated_bundle_marks_dead_node_unreachable(cluster2, monkeypatch):
     fb2 = json.loads(body)
     assert fb2["nodes"]["n2"].get("unreachable") is True
     assert fb2["nodes"]["n2"].get("error")
-    assert fb2["nodes"]["n1"].get("schema") == "surrealdb-tpu-bundle/9"
+    assert fb2["nodes"]["n1"].get("schema") == "surrealdb-tpu-bundle/10"
 
 
 def test_events_endpoint_and_federation(cluster2):
@@ -351,7 +351,7 @@ def test_trace_complete_and_timeline_ordered_under_mid_scatter_kill(
     monkeypatch.setattr(cnf, "CLUSTER_RPC_TIMEOUT_SECS", 1.0)
     fb = federated_bundle(cluster3.coord)
     assert fb["nodes"]["n3"].get("unreachable") is True
-    assert fb["nodes"]["n1"].get("schema") == "surrealdb-tpu-bundle/9"
+    assert fb["nodes"]["n1"].get("schema") == "surrealdb-tpu-bundle/10"
 
 
 # ------------------------------------------------------------ profile store

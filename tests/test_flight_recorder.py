@@ -309,7 +309,7 @@ def test_bundle_has_all_six_sections(ds):
     b = debug_bundle(ds)
     for sec in SECTIONS:
         assert sec in b, sec
-    assert b["schema"] == "surrealdb-tpu-bundle/9"
+    assert b["schema"] == "surrealdb-tpu-bundle/10"
     assert b["engine"]["dispatch"]["stats"]["submitted"] >= 0
     assert "memory_bytes" in b["engine"]
     # a ds-less bundle (the tier-1 failure hook) still carries every section

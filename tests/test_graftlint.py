@@ -25,7 +25,7 @@ def rule_ids(findings):
 
 
 # ------------------------------------------------------------------ per rule
-@pytest.mark.parametrize("rule", ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014", "GL015"])
+@pytest.mark.parametrize("rule", ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014", "GL015", "GL016"])
 def test_rule_fires_on_bad_fixture_and_not_on_clean(rule):
     bad = lint(f"{rule.lower()}_bad.py", rules=[rule])
     assert rule in rule_ids(bad), f"{rule} failed to fire on its fixture"
@@ -149,11 +149,12 @@ def test_cli_exit_codes():
             os.path.join(FIXTURES, "gl012_bad.py"),
             os.path.join(FIXTURES, "gl013_bad.py"),
             os.path.join(FIXTURES, "gl014_bad.py"),
+            os.path.join(FIXTURES, "gl016_bad.py"),
         ],
         cwd=REPO, capture_output=True, text=True, env=env,
     )
     assert bad.returncode == 1, bad.stdout + bad.stderr
-    for rule in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008", "GL009", "GL011", "GL012", "GL013", "GL014"):
+    for rule in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008", "GL009", "GL011", "GL012", "GL013", "GL014", "GL016"):
         assert rule in bad.stdout, f"{rule} missing from CLI output"
     # --update-baseline refuses a restricted scope (it would silently drop
     # every grandfathered entry the restricted run can't see)
@@ -246,6 +247,45 @@ def test_gl014_flags_store_pokes_and_call_site_hygiene():
     assert lint("gl014_clean.py", rules=["GL014"]) == []
 
 
+def test_gl016_flags_blocking_sockets_and_sleep_only_when_marked(tmp_path):
+    keys = {f.key for f in lint("gl016_bad.py", rules=["GL016"])}
+    assert any(k.endswith(":drain:recv") for k in keys), keys
+    assert any(k.endswith(":drain:sendall") for k in keys), keys
+    assert any(k.endswith(":take_one:accept") for k in keys), keys
+    assert any(k.endswith(":Pump.tick:recv_into") for k in keys), keys
+    # both sleep spellings (time.sleep and the direct import) are caught
+    assert sum(1 for k in keys if k.endswith(":sleep")) >= 1, keys
+    # _nb_ wrappers + Event.wait pacing pass clean
+    assert lint("gl016_clean.py", rules=["GL016"]) == []
+    # an UNMARKED module with identical blocking calls is out of scope —
+    # the rule is about loop threads, not sockets in general
+    f = tmp_path / "unmarked.py"
+    f.write_text(
+        "import time\n"
+        "def drain(sock):\n"
+        "    time.sleep(1)\n"
+        "    return sock.recv(4096)\n"
+    )
+    assert engine.lint_paths([str(f)], rules=["GL016"]) == []
+
+
+def test_gl016_loop_module_is_marked_and_clean():
+    # the real event-loop ingress carries the marker and holds itself to
+    # the rule it anchors
+    import ast as _ast
+
+    path = os.path.join(REPO, "surrealdb_tpu", "net", "loop.py")
+    with open(path) as fh:
+        src = fh.read()
+    tree = _ast.parse(src)
+    assert any(
+        isinstance(n, _ast.Assign)
+        and any(getattr(t, "id", "") == "EVENT_LOOP_MODULE" for t in n.targets)
+        for n in tree.body
+    )
+    assert engine.lint_paths([path], rules=["GL016"]) == []
+
+
 def test_gl014_registry_matches_runtime():
     # the rule checks against the REAL registry, so the static and runtime
     # halves can never drift
@@ -274,7 +314,7 @@ def test_every_rule_has_doc_and_registration():
     assert set(rules_mod.RULES) == {
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
         "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014",
-        "GL015",
+        "GL015", "GL016",
     }
     for rid, (fn, doc) in rules_mod.RULES.items():
         assert callable(fn) and doc

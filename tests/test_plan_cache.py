@@ -465,7 +465,7 @@ def test_statements_annotation_and_bundle_section(ds):
     from surrealdb_tpu.bundle import debug_bundle
 
     b = debug_bundle(ds)
-    assert b["schema"] == "surrealdb-tpu-bundle/9"
+    assert b["schema"] == "surrealdb-tpu-bundle/10"
     assert b["plan_cache"]["enabled"] is True
     assert b["plan_cache"]["hits"]["ast"] >= 1, b["plan_cache"]
 

@@ -457,7 +457,7 @@ def test_info_for_root_and_bundle_sections(ds):
     )
     from surrealdb_tpu.bundle import BUNDLE_SCHEMA, debug_bundle
 
-    assert BUNDLE_SCHEMA == "surrealdb-tpu-bundle/9"
+    assert BUNDLE_SCHEMA == "surrealdb-tpu-bundle/10"
     b = debug_bundle(ds)
     assert b["statements"]["fingerprints"] >= 1
     assert b["statements"]["top"]
